@@ -1,0 +1,74 @@
+"""Comm resolution, tier dispatch, and token threading shared by all ops.
+
+The reference threads an explicit XLA token through every op
+(/root/reference/mpi4jax/_src/collective_ops/allreduce.py:63-64,101-104); its
+experimental notoken layer uses ordered effects instead (SURVEY.md §2.2).
+Here the *primary* API is tokenless:
+
+- mesh tier: ordering holds by SPMD construction (one program, one order);
+- world tier: primitives carry an ordered effect, the compiler threads the
+  runtime token.
+
+The ``token=`` kwarg is still accepted on every op for migration and for
+expressing extra ordering constraints the dataflow doesn't: tokens are plain
+scalar arrays tied to op inputs/outputs with ``lax.optimization_barrier``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.mesh import MeshComm, get_default_comm
+from ..runtime.transport import WorldComm
+
+
+def resolve_comm(comm):
+    if comm is None:
+        comm = get_default_comm()
+    if not isinstance(comm, (MeshComm, WorldComm)):
+        raise TypeError(
+            f"comm must be a mpi4jax_tpu communicator (MeshComm or "
+            f"WorldComm), got {type(comm).__qualname__}"
+        )
+    return comm
+
+
+def is_mesh(comm) -> bool:
+    return isinstance(comm, MeshComm)
+
+
+def create_token(x=None):
+    """A fresh ordering token (a zero scalar; tied to ``x`` if given)."""
+    token = jnp.zeros((), jnp.uint32)
+    if x is not None:
+        token, _ = lax.optimization_barrier((token, x))
+    return token
+
+
+def token_in(token, *arrays):
+    """Make ``arrays`` depend on ``token`` (ops wait for the token)."""
+    if token is None:
+        return arrays if len(arrays) != 1 else arrays[0]
+    tied = lax.optimization_barrier((token, *arrays))[1:]
+    return tied if len(tied) != 1 else tied[0]
+
+
+def token_out(token, *results):
+    """A new token that carries a dependency on ``results``."""
+    if token is None:
+        token = jnp.zeros((), jnp.uint32)
+    return lax.optimization_barrier((token, *results))[0]
+
+
+def maybe_tokenized(fn, x, token):
+    """Run op body ``fn(x)`` with optional token threading.
+
+    Returns ``fn(x)`` when ``token is None`` (primary API), else
+    ``(fn(x'), token')`` with the token tied through the op.
+    """
+    if token is None:
+        return fn(x)
+    x = token_in(token, x)
+    result = fn(x)
+    return result, token_out(token, result)

@@ -1,0 +1,184 @@
+"""Mesh/ICI-tier implementations of the 12 ops as XLA collectives.
+
+This is the TPU-native core of the framework.  Where the reference lowers
+every op to a host custom call into libmpi
+(/root/reference/mpi4jax/_src/collective_ops/*.py → mpi_xla_bridge.pyx), here
+each op *is* an XLA collective inside ``shard_map``: the compiler schedules it
+onto ICI, fuses around it, and — because every rank runs the same SPMD
+program — ordering and deadlock-freedom hold by construction (the property
+the reference's token system exists to provide, docs/sharp-bits.rst there).
+
+All functions below must be called inside ``shard_map`` (or ``spmd``) with
+``axis`` bound.  ``rank`` is ``lax.axis_index(axis)`` (traced, uniform
+program), ``size`` is ``lax.axis_size(axis)`` (static).
+
+Collective mapping (reference op → XLA collective):
+
+==============  =====================================================
+allreduce       ``lax.psum/pmax/pmin``; generic ops all-gather+reduce
+allgather       ``lax.all_gather(axis=0)``
+alltoall        ``lax.all_to_all(split_axis=0, concat_axis=0)``
+bcast           masked ``psum`` (only root contributes)
+reduce          allreduce + select (non-root keeps its input)
+scan            Hillis–Steele ladder of ``lax.ppermute`` (log2 steps)
+scatter         ``lax.all_to_all`` + static root row
+gather          allgather (result replicated — SPMD divergence, DESIGN.md)
+sendrecv        ``lax.ppermute``
+barrier         cross-rank psum dependency (SPMD programs need no barrier)
+send/recv       rejected — meaningless as separate calls in one SPMD
+                program; world tier provides exact reference semantics
+==============  =====================================================
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils import dtypes as _dtypes
+from .reduce_ops import ReduceOp, SUM
+
+
+def _rank(axis):
+    return lax.axis_index(axis)
+
+
+def _size(axis) -> int:
+    return lax.axis_size(axis)
+
+
+def _masked(x, keep):
+    """x where keep (scalar traced bool) else zeros, preserving dtype."""
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
+def allreduce(x, op: ReduceOp, axis):
+    op.check_dtype(x.dtype)
+    if op.lax_kind == "sum":
+        return lax.psum(x, axis)
+    if op.lax_kind == "max":
+        return lax.pmax(x, axis)
+    if op.lax_kind == "min":
+        return lax.pmin(x, axis)
+    if op.domain == "bool":
+        # Logical ops ride the fused min/max collectives on a 0/1 view
+        # (truthiness, so integer inputs behave like MPI's logical ops).
+        bits = (x != 0).astype(jnp.uint8)
+        if op.name == "LAND":
+            out = lax.pmin(bits, axis)
+        elif op.name == "LOR":
+            out = lax.pmax(bits, axis)
+        else:  # LXOR: parity of the count of true values
+            out = (lax.psum(bits.astype(jnp.uint32), axis) % 2).astype(jnp.uint8)
+        return out.astype(x.dtype)
+    # PROD / bitwise: no fused XLA collective — gather then reduce locally.
+    stacked = lax.all_gather(x, axis, axis=0, tiled=False)
+    return op.reduce(stacked).astype(x.dtype)
+
+
+def allgather(x, axis):
+    return lax.all_gather(x, axis, axis=0, tiled=False)
+
+
+def alltoall(x, axis):
+    size = _size(axis)
+    if x.ndim < 1 or x.shape[0] != size:
+        raise ValueError(
+            f"alltoall requires leading axis == communicator size ({size}), "
+            f"got shape {x.shape}"
+        )
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0)
+
+
+def bcast(x, root: int, axis):
+    _dtypes.check_supported(x.dtype)
+    r = _rank(axis)
+    if x.dtype == jnp.bool_:
+        return lax.psum(_masked(x.astype(jnp.uint8), r == root), axis) != 0
+    return lax.psum(_masked(x, r == root), axis)
+
+
+def reduce(x, op: ReduceOp, root: int, axis):
+    # Reference contract: root receives the reduction, other ranks get their
+    # input back unchanged (rank-dependent *values*, uniform shapes — SPMD ok).
+    full = allreduce(x, op, axis)
+    return jnp.where(_rank(axis) == root, full, x)
+
+
+def gather(x, root: int, axis):
+    # SPMD divergence (DESIGN.md): result (size, *shape) is materialized on
+    # every rank; the root's view equals the reference's root result.
+    del root
+    return lax.all_gather(x, axis, axis=0, tiled=False)
+
+
+def scatter(x, root: int, axis):
+    size = _size(axis)
+    if x.ndim < 1 or x.shape[0] != size:
+        raise ValueError(
+            f"scatter requires input shape (size, ...) = ({size}, ...) on "
+            f"every rank (only root's values are read), got {x.shape}"
+        )
+    # all_to_all row j of the result holds rank j's chunk addressed to us;
+    # row `root` is therefore exactly MPI_Scatter's result.  One collective,
+    # O(|x|) traffic per rank — cheaper than bcast-then-slice (2·|x|).
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0)[root]
+
+
+def scan(x, op: ReduceOp, axis):
+    """Inclusive prefix reduction across ranks (MPI_Scan).
+
+    Hillis–Steele over ``ppermute``: log2(size) shift-and-combine steps, each
+    one ICI hop of the full buffer.  Ranks below the shift distance keep
+    their partial (ppermute delivers zeros to ranks with no source; the mask
+    keeps identity-correctness for non-SUM ops).
+    """
+    op.check_dtype(x.dtype)
+    size = _size(axis)
+    r = _rank(axis)
+    acc = x
+    shift = 1
+    while shift < size:
+        shifted = lax.ppermute(
+            acc, axis, [(i, i + shift) for i in range(size - shift)]
+        )
+        acc = jnp.where(r >= shift, op.combine(acc, shifted), acc)
+        shift *= 2
+    return acc.astype(x.dtype)
+
+
+def sendrecv(x, perm, axis):
+    """Combined send+recv along a static rank permutation (lax.ppermute).
+
+    ``perm`` is a sequence of (source, dest) pairs — the SPMD expression of
+    the reference's per-rank (source, dest) arguments
+    (/root/reference/mpi4jax/_src/collective_ops/sendrecv.py:46-125).  Ranks
+    not appearing as a destination receive zeros.
+    """
+    return lax.ppermute(x, axis, perm)
+
+
+def barrier(axis, tie=None):
+    # A compiled SPMD program needs no rank barrier for correctness; this
+    # returns a zero scalar that carries a genuine cross-rank data dependency
+    # so callers can sequence host-visible work after it.  ``tie`` (e.g. a
+    # token) is ordered before the barrier when given.
+    z = jnp.zeros((), jnp.int32)
+    if tie is not None:
+        z = lax.optimization_barrier((z, tie))[0]
+    return lax.psum(z, axis)
+
+
+def ring_perm(size: int, shift: int = 1, wrap: bool = True):
+    """(source, dest) pairs sending each rank's data to ``rank + shift``."""
+    pairs = []
+    for i in range(size):
+        j = i + shift
+        if wrap:
+            pairs.append((i, j % size))
+        elif 0 <= j < size:
+            pairs.append((i, j))
+    return pairs

@@ -1,0 +1,71 @@
+"""World-tier op implementations (multi-process, native transport).
+
+Each op here is a JAX primitive carrying an ordered effect
+(utils/effects.py), lowered to a custom call / host callback into the native
+C++ transport — the structural twin of the reference's Cython bridge stack
+(/root/reference/mpi4jax/_src/xla_bridge/).
+
+Status: primitives land with the native transport (native/); until then every
+entry raises with guidance so the mesh tier (the TPU fast path) is never
+blocked on it.
+"""
+
+from __future__ import annotations
+
+_MSG = (
+    "the world tier (one process per rank over the native transport) for "
+    "'{op}' is not built in this checkout stage; use the mesh tier "
+    "(mpi4jax_tpu.spmd over a device Mesh) instead"
+)
+
+
+def _todo(op):
+    raise NotImplementedError(_MSG.format(op=op))
+
+
+def allreduce(x, op, comm):
+    _todo("allreduce")
+
+
+def allgather(x, comm):
+    _todo("allgather")
+
+
+def alltoall(x, comm):
+    _todo("alltoall")
+
+
+def barrier(comm, token):
+    _todo("barrier")
+
+
+def bcast(x, root, comm):
+    _todo("bcast")
+
+
+def reduce(x, op, root, comm):
+    _todo("reduce")
+
+
+def gather(x, root, comm):
+    _todo("gather")
+
+
+def scatter(x, root, comm):
+    _todo("scatter")
+
+
+def scan(x, op, comm):
+    _todo("scan")
+
+
+def send(x, dest, tag, comm, token):
+    _todo("send")
+
+
+def recv(x, source, tag, comm, token):
+    _todo("recv")
+
+
+def sendrecv_dispatch(x, *, perm, shift, wrap, comm, token):
+    _todo("sendrecv")

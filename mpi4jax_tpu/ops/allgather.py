@@ -1,0 +1,25 @@
+"""allgather — concatenate every rank's array along a new leading axis.
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/allgather.py (output
+shape ``(nproc, *in_shape)``, :100-101,181-188).  Mesh tier is a single
+``lax.all_gather`` over ICI.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch, _mesh_impl
+
+
+def allgather(x, *, comm=None, token=None):
+    """Gather ``x`` from all ranks; every rank receives ``(size, *x.shape)``."""
+    x = _validation.check_array("x", x)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        body = lambda v: _mesh_impl.allgather(v, comm.axis)
+    else:
+        from . import _world_impl
+
+        body = lambda v: _world_impl.allgather(v, comm)
+    return _dispatch.maybe_tokenized(body, x, token)

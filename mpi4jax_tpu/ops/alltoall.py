@@ -1,0 +1,30 @@
+"""alltoall — transpose chunks across ranks (the FFT-slab / Ulysses move).
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/alltoall.py (leading
+axis must equal nproc :71-73,99-101).  Mesh tier is a single
+``lax.all_to_all`` — on TPU this is the bisection-bandwidth collective that
+sequence-parallel attention (parallel/ulysses.py) and spectral transposes
+ride.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch, _mesh_impl
+
+
+def alltoall(x, *, comm=None, token=None):
+    """Exchange chunks: output row ``j`` is rank ``j``'s input row ``rank``.
+
+    ``x`` must have shape ``(size, ...)`` on every rank.
+    """
+    x = _validation.check_array("x", x)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        body = lambda v: _mesh_impl.alltoall(v, comm.axis)
+    else:
+        from . import _world_impl
+
+        body = lambda v: _world_impl.alltoall(v, comm)
+    return _dispatch.maybe_tokenized(body, x, token)

@@ -1,0 +1,33 @@
+"""barrier — synchronize all ranks.
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/barrier.py (the only
+op with no array I/O, :116-117).  A compiled SPMD program needs no barrier
+for correctness; the mesh tier emits a cross-rank psum dependency so
+subsequent host-visible effects are ordered after all ranks arrive.  The
+world tier performs a real rendezvous in the native transport.
+"""
+
+from __future__ import annotations
+
+from . import _dispatch, _mesh_impl
+
+
+def barrier(*, comm=None, token=None):
+    """Block until every rank reaches the barrier.
+
+    Returns ``None`` (primary API) or a new token (if ``token`` given).
+    """
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        sync = _mesh_impl.barrier(comm.axis, tie=token)
+        if token is not None:
+            return _dispatch.token_out(token, sync)
+        return None
+
+    from . import _world_impl
+
+    sync = _world_impl.barrier(comm, token)
+    if token is not None:
+        return _dispatch.token_out(token, sync)
+    return None

@@ -1,0 +1,29 @@
+"""bcast — broadcast the root's array to every rank.
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/bcast.py (root passes
+its ``x`` through, other ranks receive root's data, :76-81,180-192; the
+rank-dependent dummy-output trick there is a per-process-compilation artifact
+that SPMD does not need).  Mesh tier: a masked ``lax.psum`` — only the root's
+shard contributes, one fused ICI collective.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch, _mesh_impl
+
+
+def bcast(x, root=0, *, comm=None, token=None):
+    """Every rank receives root's ``x``; all ranks must pass the same shape."""
+    x = _validation.check_array("x", x)
+    root = _validation.check_static_int("root", root)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        body = lambda v: _mesh_impl.bcast(v, root, comm.axis)
+    else:
+        from . import _world_impl
+
+        _validation.check_in_range("root", root, comm.size())
+        body = lambda v: _world_impl.bcast(v, root, comm)
+    return _dispatch.maybe_tokenized(body, x, token)

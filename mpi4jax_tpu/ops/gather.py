@@ -1,0 +1,34 @@
+"""gather — collect every rank's array at the root.
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/gather.py (root gets
+``(nproc, *in)``, others a dummy, :86-96,213-226).  SPMD divergence
+(DESIGN.md): the mesh tier returns the full gathered array on *every* rank —
+a superset of the reference contract with identical memory cost on TPU
+(``lax.all_gather`` materializes the result wherever it runs).
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch, _mesh_impl
+
+
+def gather(x, root=0, *, comm=None, token=None):
+    """Gather ``x`` from all ranks; result ``(size, *x.shape)``.
+
+    Mesh tier: result replicated on every rank (the root's view equals the
+    reference's root result).  World tier: root receives the gathered array,
+    other ranks get their input back (exact reference contract).
+    """
+    x = _validation.check_array("x", x)
+    root = _validation.check_static_int("root", root)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        body = lambda v: _mesh_impl.gather(v, root, comm.axis)
+    else:
+        from . import _world_impl
+
+        _validation.check_in_range("root", root, comm.size())
+        body = lambda v: _world_impl.gather(v, root, comm)
+    return _dispatch.maybe_tokenized(body, x, token)

@@ -1,0 +1,38 @@
+"""recv — point-to-point receive.
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/recv.py (output takes
+the shape/dtype of the dummy input ``x``, :197-201).
+
+Like :mod:`.send`, a lone ``recv`` requires per-rank programs — world tier
+only; the mesh tier points to :func:`mpi4jax_tpu.sendrecv`.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch
+
+
+def recv(x, source, tag=0, *, comm=None, token=None):
+    """Receive into the shape/dtype of ``x`` from rank ``source``.
+
+    World tier only (one process per rank); see module docstring.
+    """
+    x = _validation.check_array("x", x)
+    source = _validation.check_static_int("source", source)
+    tag = _validation.check_static_int("tag", tag)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        raise NotImplementedError(
+            "recv() has no meaning inside a single SPMD program: every rank "
+            "executes the same code, so there is no separate sender. Use "
+            "sendrecv(x, perm=...) (compiled to lax.ppermute over ICI), or "
+            "run one process per rank via `python -m "
+            "mpi4jax_tpu.runtime.launch` for MPMD send/recv."
+        )
+
+    from . import _world_impl
+
+    _validation.check_in_range("source", source, comm.size())
+    return _world_impl.recv(x, source, tag, comm, token)

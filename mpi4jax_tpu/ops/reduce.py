@@ -1,0 +1,31 @@
+"""reduce — reduce across ranks, result delivered to the root.
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/reduce.py
+(rank-dependent output: root gets the reduction, other ranks get their input
+back, :71-80,186-197).  Mesh tier: allreduce + per-rank select — shapes are
+uniform, values rank-dependent, which is SPMD-legal; XLA's allreduce is the
+same collective a rooted reduce would use on ICI anyway.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch, _mesh_impl
+from .reduce_ops import SUM, as_reduce_op
+
+
+def reduce(x, op=SUM, root=0, *, comm=None, token=None):
+    """Reduce ``x`` with ``op``; root receives the result, others get ``x``."""
+    op = as_reduce_op(op)
+    x = _validation.check_array("x", x)
+    root = _validation.check_static_int("root", root)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        body = lambda v: _mesh_impl.reduce(v, op, root, comm.axis)
+    else:
+        from . import _world_impl
+
+        _validation.check_in_range("root", root, comm.size())
+        body = lambda v: _world_impl.reduce(v, op, root, comm)
+    return _dispatch.maybe_tokenized(body, x, token)

@@ -1,0 +1,121 @@
+"""Reduction operators.
+
+The reference passes ``mpi4py.MPI.Op`` handles straight through to libmpi
+(/root/reference/mpi4jax/_src/utils.py:133-152 wraps them as hashable static
+params).  Here the operator set is first-class framework objects that know
+how to execute on TPU: each op carries
+
+- a *fast path* onto a fused XLA collective (``psum``/``pmax``/``pmin``) when
+  one exists — these compile to single ICI collectives, and
+- a generic ``combine``/``reduce`` pair for the ops XLA has no fused
+  collective for (PROD, bitwise) — used by the all-gather fallback and by the
+  log-step prefix-scan ladder,
+- dtype admissibility (logical ops want bools, bitwise ops want integers),
+- differentiability (only SUM is linear; matching the reference, which
+  implements JVP/transpose for SUM only, _src/collective_ops/allreduce.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True, eq=False)  # eq/hash are name-based, defined below
+class ReduceOp:
+    name: str
+    # one of "sum" | "max" | "min" | None — key into the fused-collective path
+    lax_kind: Optional[str]
+    combine: Callable = field(compare=False)
+    reduce: Callable = field(compare=False)  # reduce over axis 0 of a stack
+    # "any" | "numeric" | "bool" | "integer"
+    domain: str = "numeric"
+    differentiable: bool = False
+
+    def __repr__(self):
+        return f"ReduceOp({self.name})"
+
+    def __hash__(self):
+        return hash(("mpi4jax_tpu.ReduceOp", self.name))
+
+    def __eq__(self, other):
+        return isinstance(other, ReduceOp) and other.name == self.name
+
+    def check_dtype(self, dtype):
+        d = np.dtype(dtype)
+        if self.domain == "numeric" and d == np.bool_:
+            raise TypeError(
+                f"{self!r} is not defined for boolean arrays; use LAND/LOR/LXOR"
+            )
+        if self.domain == "integer" and not (
+            np.issubdtype(d, np.integer) or d == np.bool_
+        ):
+            raise TypeError(f"{self!r} requires an integer dtype, got {d.name}")
+        if self.domain == "bool" and not (
+            d == np.bool_ or np.issubdtype(d, np.integer)
+        ):
+            raise TypeError(
+                f"{self!r} requires a boolean or integer dtype, got {d.name}"
+            )
+
+
+SUM = ReduceOp(
+    "SUM", "sum", lambda a, b: a + b, lambda s: jnp.sum(s, axis=0),
+    differentiable=True,
+)
+PROD = ReduceOp("PROD", None, lambda a, b: a * b, lambda s: jnp.prod(s, axis=0))
+MAX = ReduceOp("MAX", "max", jnp.maximum, lambda s: jnp.max(s, axis=0))
+MIN = ReduceOp("MIN", "min", jnp.minimum, lambda s: jnp.min(s, axis=0))
+LAND = ReduceOp(
+    "LAND", None, jnp.logical_and, lambda s: jnp.all(s, axis=0), domain="bool"
+)
+LOR = ReduceOp(
+    "LOR", None, jnp.logical_or, lambda s: jnp.any(s, axis=0), domain="bool"
+)
+LXOR = ReduceOp(
+    "LXOR",
+    None,
+    jnp.logical_xor,
+    lambda s: jnp.sum(s.astype(jnp.int32), axis=0) % 2 == 1,
+    domain="bool",
+)
+def _fold(binop):
+    # Static unroll over the (small) leading axis — jnp bitwise functions are
+    # not ufuncs, so there is no .reduce; the stack size is the communicator
+    # size, known at trace time.
+    def run(s):
+        acc = s[0]
+        for i in range(1, s.shape[0]):
+            acc = binop(acc, s[i])
+        return acc
+
+    return run
+
+
+BAND = ReduceOp(
+    "BAND", None, jnp.bitwise_and, _fold(jnp.bitwise_and), domain="integer"
+)
+BOR = ReduceOp(
+    "BOR", None, jnp.bitwise_or, _fold(jnp.bitwise_or), domain="integer"
+)
+BXOR = ReduceOp(
+    "BXOR", None, jnp.bitwise_xor, _fold(jnp.bitwise_xor), domain="integer"
+)
+
+ALL_OPS = (SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR)
+_BY_NAME = {op.name: op for op in ALL_OPS}
+
+
+def as_reduce_op(op) -> ReduceOp:
+    """Coerce ``op`` (ReduceOp or name string) to a ReduceOp."""
+    if isinstance(op, ReduceOp):
+        return op
+    if isinstance(op, str) and op.upper() in _BY_NAME:
+        return _BY_NAME[op.upper()]
+    raise TypeError(
+        f"expected a mpi4jax_tpu ReduceOp (e.g. mpi4jax_tpu.SUM) or one of "
+        f"{sorted(_BY_NAME)}, got {op!r}"
+    )

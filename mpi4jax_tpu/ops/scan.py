@@ -1,0 +1,28 @@
+"""scan — inclusive prefix reduction across ranks (MPI_Scan).
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/scan.py (same-shape
+inclusive scan, :163-167).  Mesh tier: a log2(size) Hillis–Steele ladder of
+``lax.ppermute`` steps (ops/_mesh_impl.py:scan) — each step one ICI hop, no
+host round-trips.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch, _mesh_impl
+from .reduce_ops import SUM, as_reduce_op
+
+
+def scan(x, op=SUM, *, comm=None, token=None):
+    """Rank r receives ``op(x_0, ..., x_r)`` (inclusive prefix)."""
+    op = as_reduce_op(op)
+    x = _validation.check_array("x", x)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        body = lambda v: _mesh_impl.scan(v, op, comm.axis)
+    else:
+        from . import _world_impl
+
+        body = lambda v: _world_impl.scan(v, op, comm)
+    return _dispatch.maybe_tokenized(body, x, token)

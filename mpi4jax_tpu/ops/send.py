@@ -1,0 +1,39 @@
+"""send — point-to-point send.
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/send.py (returns a
+token only, :153-154).
+
+A lone ``send`` is only meaningful when ranks run *different* programs — the
+world tier (one process per rank, like the reference) supports it exactly.
+In one SPMD program every rank executes every line, so an unpaired send has
+no well-defined receiver call; the mesh tier rejects it with guidance toward
+:func:`mpi4jax_tpu.sendrecv` (ppermute), which expresses the same data
+motion deadlock-free.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch
+
+
+def send(x, dest, tag=0, *, comm=None, token=None):
+    """Send ``x`` to rank ``dest`` (world tier only; see module docstring)."""
+    x = _validation.check_array("x", x)
+    dest = _validation.check_static_int("dest", dest)
+    tag = _validation.check_static_int("tag", tag)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        raise NotImplementedError(
+            "send() has no meaning inside a single SPMD program: every rank "
+            "executes the same code, so there is no separate receiver. Use "
+            "sendrecv(x, perm=...) / sendrecv(x, shift=...) (compiled to "
+            "lax.ppermute over ICI), or run one process per rank via "
+            "`python -m mpi4jax_tpu.runtime.launch` for MPMD send/recv."
+        )
+
+    from . import _world_impl
+
+    _validation.check_in_range("dest", dest, comm.size())
+    return _world_impl.send(x, dest, tag, comm, token)

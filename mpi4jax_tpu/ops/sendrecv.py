@@ -1,0 +1,64 @@
+"""sendrecv — paired exchange; the halo-exchange / ring building block.
+
+Reference: /root/reference/mpi4jax/_src/collective_ops/sendrecv.py (per-rank
+``source``/``dest`` ints :46-125; transpose swaps source and dest :390-409 —
+the cotangent flows backward along the message edge).
+
+Mesh tier: ``lax.ppermute`` over a *static permutation* — the SPMD spelling
+of per-rank source/dest.  Conveniences:
+
+- ``perm=[(src, dst), ...]`` explicit pairs;
+- ``shift=k, wrap=...`` the ring pattern (dest = rank+k), which is the whole
+  of the reference's in-repo usage (halo exchange, shallow_water.py there).
+
+Autodiff: ``ppermute``'s transpose is the inverse permutation — exactly the
+reference's source/dest swap — and (an improvement over the reference, which
+raises for forward mode :150-155) JVP works too.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch, _mesh_impl
+
+
+def _resolve_perm(comm, perm, shift, wrap):
+    if (perm is None) == (shift is None):
+        raise ValueError("pass exactly one of perm= or shift=")
+    if perm is not None:
+        return [
+            (
+                _validation.check_static_int("source", s),
+                _validation.check_static_int("dest", d),
+            )
+            for s, d in perm
+        ]
+    shift = _validation.check_static_int("shift", shift)
+    return _mesh_impl.ring_perm(comm.size(), shift, wrap)
+
+
+def sendrecv(x, *, perm=None, shift=None, wrap=True, comm=None, token=None):
+    """Exchange ``x`` along a static rank permutation.
+
+    Each pair ``(s, d)`` in the permutation delivers rank ``s``'s ``x`` to
+    rank ``d``; ranks that are not a destination receive zeros.  With
+    ``shift=k``, data moves to ``rank + k`` (a ring when ``wrap=True``).
+    """
+    x = _validation.check_array("x", x)
+    comm = _dispatch.resolve_comm(comm)
+
+    if _dispatch.is_mesh(comm):
+        pairs = _resolve_perm(comm, perm, shift, wrap)
+        body = lambda v: _mesh_impl.sendrecv(v, pairs, comm.axis)
+        return _dispatch.maybe_tokenized(body, x, token)
+
+    from . import _world_impl
+
+    return _world_impl.sendrecv_dispatch(
+        x, perm=perm, shift=shift, wrap=wrap, comm=comm, token=token
+    )
+
+
+def permute(x, perm, *, comm=None, token=None):
+    """Alias for :func:`sendrecv` with an explicit permutation."""
+    return sendrecv(x, perm=perm, comm=comm, token=token)

@@ -1,0 +1,17 @@
+from .mesh import (
+    CommBase,
+    MeshComm,
+    current_comm,
+    get_default_comm,
+    make_mesh,
+    spmd,
+)
+
+__all__ = [
+    "CommBase",
+    "MeshComm",
+    "current_comm",
+    "get_default_comm",
+    "make_mesh",
+    "spmd",
+]

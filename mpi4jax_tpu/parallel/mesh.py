@@ -1,0 +1,222 @@
+"""Communicators and the SPMD entry point.
+
+The reference's communicator is an MPI handle cloned from COMM_WORLD
+(/root/reference/mpi4jax/_src/comm.py:4-11).  TPU-native, a communicator is a
+*mesh axis*: ranks are positions along one or more named axes of a
+``jax.sharding.Mesh``, and ops execute inside ``shard_map`` where those axes
+are bound.  ``spmd`` is the front door: it wraps a per-rank function the way
+``mpirun`` wraps a per-rank process.
+
+Design notes:
+- ``MeshComm`` is hashable/comparable by axis names — like the reference's
+  ``HashableMPIType`` wrapper (_src/utils.py:133-152), comms appear in traced
+  code and must be stable static params.
+- A context stack supplies the default comm (reference: lazily cloned
+  COMM_WORLD); ``spmd`` pushes its comm for the duration of the trace so op
+  calls inside need no explicit ``comm=``.
+- Splitting a 2-D grid into row/column sub-communicators (the shallow-water
+  pattern) is just naming two mesh axes — ``ProcessGrid`` below.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+class CommBase:
+    """Abstract communicator."""
+
+    def rank(self):
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def __enter__(self):
+        _push_comm(self)
+        return self
+
+    def __exit__(self, *exc):
+        _pop_comm(self)
+        return False
+
+
+class MeshComm(CommBase):
+    """Communicator over one mesh axis (or several, flattened in order).
+
+    ``axis`` may be a single axis name or a tuple of names; with a tuple the
+    rank is the row-major flattening of the per-axis indices (matching how
+    ``Mesh`` flattens devices).
+    """
+
+    def __init__(self, axis="mpi", *, mesh: Optional[Mesh] = None):
+        if isinstance(axis, str):
+            axis = (axis,)
+        self.axes: tuple = tuple(axis)
+        self.mesh = mesh
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def axis(self):
+        """The axis argument to pass to lax collectives."""
+        return self.axes if len(self.axes) > 1 else self.axes[0]
+
+    def __hash__(self):
+        return hash(("mpi4jax_tpu.MeshComm", self.axes))
+
+    def __eq__(self, other):
+        return isinstance(other, MeshComm) and other.axes == self.axes
+
+    def __repr__(self):
+        return f"MeshComm(axis={self.axes!r})"
+
+    # -- topology ---------------------------------------------------------
+    def rank(self):
+        """Linearized rank along this comm's axes (traced; inside shard_map)."""
+        r = lax.axis_index(self.axes[0])
+        for name in self.axes[1:]:
+            r = r * lax.axis_size(name) + lax.axis_index(name)
+        return r
+
+    def size(self) -> int:
+        n = 1
+        for name in self.axes:
+            n *= lax.axis_size(name)
+        return n
+
+    def sub(self, axis) -> "MeshComm":
+        """Sub-communicator over a subset of this comm's axes."""
+        if isinstance(axis, str):
+            axis = (axis,)
+        for a in axis:
+            if a not in self.axes:
+                raise ValueError(f"axis {a!r} not part of {self!r}")
+        return MeshComm(axis, mesh=self.mesh)
+
+
+_DEFAULT_AXIS = "mpi"
+
+
+class _CommStack(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_comm_stack = _CommStack()
+
+
+def _push_comm(comm):
+    _comm_stack.stack.append(comm)
+
+
+def _pop_comm(comm):
+    top = _comm_stack.stack.pop()
+    if top is not comm:  # pragma: no cover - misuse guard
+        raise RuntimeError("communicator context stack corrupted")
+
+
+def current_comm() -> Optional[CommBase]:
+    return _comm_stack.stack[-1] if _comm_stack.stack else None
+
+
+_world_comm = None
+
+
+def get_default_comm() -> CommBase:
+    """Innermost active comm, else the process 'world'.
+
+    Outside any context this returns the world-tier communicator when the
+    process was launched by the mpi4jax_tpu launcher (multi-process mode),
+    else a ``MeshComm`` over the default axis name — the single-controller
+    SPMD world.
+    """
+    comm = current_comm()
+    if comm is not None:
+        return comm
+    from ..runtime import transport
+
+    if transport.in_world():
+        return transport.get_world_comm()
+    return MeshComm(_DEFAULT_AXIS)
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    axis: str = _DEFAULT_AXIS,
+    devices: Optional[Sequence] = None,
+    backend: Optional[str] = None,
+) -> Mesh:
+    """A 1-D mesh over ``n_devices`` (default: all available devices)."""
+    if devices is None:
+        devices = jax.devices(backend) if backend else jax.devices()
+    if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"requested {n_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def spmd(
+    fn=None,
+    *,
+    comm: Optional[MeshComm] = None,
+    mesh: Optional[Mesh] = None,
+    in_specs=None,
+    out_specs=None,
+    check_vma: bool = False,
+):
+    """Run ``fn`` once per rank over a device mesh (the `mpirun` of this
+    framework).
+
+    Wraps ``jax.shard_map``: every array argument is split along its leading
+    axis across the comm's devices (override with ``in_specs``/``out_specs``)
+    and ``fn`` sees its local shard, exactly like an MPI rank sees its local
+    buffer.  Inside ``fn``, the comm is the ambient default — op calls need
+    no ``comm=`` argument.
+
+        mesh = m4j.make_mesh()
+        @m4j.spmd(mesh=mesh)
+        def step(x):
+            return m4j.allreduce(x, op=m4j.SUM)
+    """
+
+    def wrap(f):
+        def call(*args):
+            nonlocal comm, mesh
+            if mesh is None:
+                mesh = make_mesh() if comm is None or comm.mesh is None else comm.mesh
+            if comm is None:
+                comm_ = MeshComm(mesh.axis_names, mesh=mesh)
+            else:
+                comm_ = MeshComm(comm.axes, mesh=mesh)
+            spec_in = in_specs if in_specs is not None else P(comm_.axes)
+            spec_out = out_specs if out_specs is not None else P(comm_.axes)
+
+            def ranked(*local_args):
+                with comm_:
+                    return f(*local_args)
+
+            return jax.shard_map(
+                ranked,
+                mesh=mesh,
+                in_specs=spec_in,
+                out_specs=spec_out,
+                check_vma=check_vma,
+            )(*args)
+
+        call.__name__ = getattr(f, "__name__", "spmd_fn")
+        return call
+
+    if fn is not None:
+        return wrap(fn)
+    return wrap

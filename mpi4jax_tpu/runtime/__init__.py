@@ -1,0 +1,1 @@
+from . import transport  # noqa: F401

@@ -1,0 +1,96 @@
+"""World-tier (multi-process) communicator.
+
+This module is the Python face of the native C++ transport (``native/``),
+which replaces the reference's libmpi substrate
+(/root/reference/mpi4jax/_src/xla_bridge/mpi_xla_bridge.pyx) — this
+environment ships no MPI, and on TPU pods the equivalent role (host-side
+cross-process bytes over DCN) is played by our own TCP transport.
+
+Process model: one process per rank, launched by
+``python -m mpi4jax_tpu.runtime.launch -n N prog.py`` which sets
+``MPI4JAX_TPU_RANK`` / ``MPI4JAX_TPU_SIZE`` / ``MPI4JAX_TPU_COORD``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_RANK = "MPI4JAX_TPU_RANK"
+ENV_SIZE = "MPI4JAX_TPU_SIZE"
+ENV_COORD = "MPI4JAX_TPU_COORD"
+
+
+def in_world() -> bool:
+    """True when this process was launched as a rank of a world job."""
+    return ENV_RANK in os.environ and ENV_SIZE in os.environ
+
+
+_world: Optional["WorldComm"] = None
+
+
+def get_world_comm() -> "WorldComm":
+    global _world
+    if _world is None:
+        if not in_world():
+            raise RuntimeError(
+                "not running under the mpi4jax_tpu launcher; start with "
+                "`python -m mpi4jax_tpu.runtime.launch -n <ranks> prog.py` "
+                "or use the mesh tier (mpi4jax_tpu.spmd) in a single process"
+            )
+        _world = WorldComm(
+            rank=int(os.environ[ENV_RANK]),
+            size=int(os.environ[ENV_SIZE]),
+            coord=os.environ.get(ENV_COORD, "127.0.0.1:49817"),
+        )
+    return _world
+
+
+class WorldComm:
+    """One-process-per-rank communicator backed by the native transport."""
+
+    def __init__(self, rank: int, size: int, coord: str):
+        self._rank = rank
+        self._size = size
+        self._coord = coord
+        self._handle = None  # native comm handle, created lazily
+
+    def rank(self) -> int:
+        return self._rank
+
+    def size(self) -> int:
+        return self._size
+
+    def __repr__(self):
+        return f"WorldComm(rank={self._rank}, size={self._size})"
+
+    def __hash__(self):
+        return hash(("mpi4jax_tpu.WorldComm", self._size))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, WorldComm)
+            and other._size == self._size
+            and other._rank == self._rank
+        )
+
+    def __enter__(self):
+        from ..parallel.mesh import _push_comm
+
+        _push_comm(self)
+        return self
+
+    def __exit__(self, *exc):
+        from ..parallel.mesh import _pop_comm
+
+        _pop_comm(self)
+        return False
+
+    @property
+    def handle(self) -> int:
+        """Native communicator id (connects the TCP mesh on first use)."""
+        if self._handle is None:
+            from . import bridge
+
+            self._handle = bridge.comm_init(self._rank, self._size, self._coord)
+        return self._handle

@@ -1,0 +1,1 @@
+from . import config, dtypes, jax_compat, tracing, validation  # noqa: F401

@@ -1,0 +1,57 @@
+"""Environment-variable configuration registry.
+
+The reference reads env vars ad hoc with a truthiness parser duplicated in two
+places (see SURVEY.md §5.6, citing /root/reference/mpi4jax/_src/decorators.py:19-24
+and xla_bridge/__init__.py:18-19).  Here every knob is declared once, in one
+table, with one parser.
+
+Knobs (all prefixed ``MPI4JAX_TPU_``):
+
+- ``MPI4JAX_TPU_DEBUG``       — per-call debug tracing (rank | call-id | op | dt).
+- ``MPI4JAX_TPU_PREFER_TOKEN``— route the primary API through the explicit-token
+                                compat layer (inverse of the reference's
+                                ``MPI4JAX_PREFER_NOTOKEN``: ordered-effects /
+                                SPMD ordering is our default, tokens the opt-in).
+- ``MPI4JAX_TPU_TRANSPORT``   — world-tier transport ("tcp" only for now).
+- ``MPI4JAX_TPU_NO_WARN_JAX_VERSION`` — silence the jax version check.
+"""
+
+from __future__ import annotations
+
+import os
+
+_TRUTHY = frozenset(("1", "true", "on", "yes", "y"))
+_FALSY = frozenset(("0", "false", "off", "no", "n", ""))
+
+
+def parse_bool(value: str, *, name: str = "<flag>") -> bool:
+    v = value.strip().lower()
+    if v in _TRUTHY:
+        return True
+    if v in _FALSY:
+        return False
+    raise ValueError(f"cannot parse boolean env var {name}={value!r}")
+
+
+def flag(name: str, default: bool = False) -> bool:
+    """Read a boolean env var (see module docstring for the known set)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return parse_bool(raw, name=name)
+
+
+def setting(name: str, default: str) -> str:
+    return os.environ.get(name, default)
+
+
+def debug_enabled() -> bool:
+    return flag("MPI4JAX_TPU_DEBUG")
+
+
+def prefer_token() -> bool:
+    return flag("MPI4JAX_TPU_PREFER_TOKEN")
+
+
+def transport_name() -> str:
+    return setting("MPI4JAX_TPU_TRANSPORT", "tcp")

@@ -1,0 +1,59 @@
+"""Supported dtypes and their wire codes.
+
+Parity with the reference's dtype→MPI-datatype table
+(/root/reference/mpi4jax/_src/utils.py:100-115, 14 dtypes) plus bfloat16,
+which is the native TPU matmul dtype and therefore first-class here.
+
+The integer codes are the wire protocol between Python and the native C++
+transport (native/tpucomm.cc) — they must stay in sync with ``tpucomm.h``.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# name -> (dtype, wire code, element size in bytes)
+_TABLE = {
+    "bool": (jnp.bool_, 0, 1),
+    "int8": (jnp.int8, 1, 1),
+    "int16": (jnp.int16, 2, 2),
+    "int32": (jnp.int32, 3, 4),
+    "int64": (jnp.int64, 4, 8),
+    "uint8": (jnp.uint8, 5, 1),
+    "uint16": (jnp.uint16, 6, 2),
+    "uint32": (jnp.uint32, 7, 4),
+    "uint64": (jnp.uint64, 8, 8),
+    "float16": (jnp.float16, 9, 2),
+    "bfloat16": (jnp.bfloat16, 10, 2),
+    "float32": (jnp.float32, 11, 4),
+    "float64": (jnp.float64, 12, 8),
+    "complex64": (jnp.complex64, 13, 8),
+    "complex128": (jnp.complex128, 14, 16),
+}
+
+SUPPORTED_DTYPES = tuple(np.dtype(v[0]) for v in _TABLE.values())
+
+
+def wire_code(dtype) -> int:
+    """Wire code for ``dtype``; raises TypeError for unsupported dtypes."""
+    name = np.dtype(dtype).name
+    try:
+        return _TABLE[name][1]
+    except KeyError:
+        raise TypeError(
+            f"mpi4jax_tpu does not support dtype {name}; supported: "
+            f"{sorted(_TABLE)}"
+        ) from None
+
+
+def check_supported(dtype) -> None:
+    wire_code(dtype)
+
+
+def is_boolean(dtype) -> bool:
+    return np.dtype(dtype) == np.dtype(np.bool_)
+
+
+def is_inexact(dtype) -> bool:
+    return np.issubdtype(np.dtype(dtype), np.inexact)

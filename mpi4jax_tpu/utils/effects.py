@@ -1,0 +1,54 @@
+"""JAX effect types for the world-tier (multi-process) primitives.
+
+The reference defines two effects with *stable hashes* so that jaxprs cached
+on different processes agree (/root/reference/mpi4jax/_src/utils.py:16-31),
+registering the notoken one as ordered (jax_compat.py:82-100 there).  Same
+contract here: ``CommEffect`` is ordered (serializes every world-tier call —
+the framework's correctness backbone), ``UnorderedCommEffect`` marks calls
+that are safe to reorder (e.g. the transposed allreduce pass, which lowers to
+identity).
+"""
+
+from __future__ import annotations
+
+from jax._src import effects as _effects
+
+
+class _StableHashEffect(_effects.Effect):
+    """Effect whose hash depends only on the class name.
+
+    Python object hashes differ across processes; jaxpr caches keyed on
+    effects must agree across all ranks of a world communicator.
+    """
+
+    def __hash__(self):
+        return hash(type(self).__module__ + type(self).__qualname__)
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __repr__(self):
+        return type(self).__qualname__
+
+
+class CommEffect(_StableHashEffect):
+    pass
+
+
+class UnorderedCommEffect(_StableHashEffect):
+    pass
+
+
+comm_effect = CommEffect()
+unordered_comm_effect = UnorderedCommEffect()
+
+# Ordered: the compiler threads a runtime token through every op carrying
+# this effect, in program order — the notoken design the reference's
+# experimental layer pioneered (SURVEY.md §2.2), promoted to the core here.
+_effects.ordered_effects.add_type(CommEffect)
+_effects.lowerable_effects.add_type(CommEffect)
+_effects.lowerable_effects.add_type(UnorderedCommEffect)
+_effects.control_flow_allowed_effects.add_type(CommEffect)
+_effects.control_flow_allowed_effects.add_type(UnorderedCommEffect)
+_effects.custom_derivatives_allowed_effects.add_type(CommEffect)
+_effects.custom_derivatives_allowed_effects.add_type(UnorderedCommEffect)

@@ -1,0 +1,46 @@
+"""JAX version handling.
+
+The reference pins a "latest known good" jax and warns beyond it
+(/root/reference/mpi4jax/_src/jax_compat.py:24-47).  We do the same with a
+much smaller surface: this framework targets jax >= 0.9 (no pre-0.5 shims —
+the reference needed them for jax 0.4.x, we do not).
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from . import config
+
+MIN_JAX_VERSION = (0, 6, 0)
+LATEST_TESTED_JAX_VERSION = (0, 9, 0)
+
+
+def _parse(version: str) -> tuple:
+    parts = []
+    for piece in version.split(".")[:3]:
+        digits = "".join(ch for ch in piece if ch.isdigit())
+        parts.append(int(digits or 0))
+    return tuple(parts)
+
+
+def check_jax_version() -> None:
+    import jax
+
+    found = _parse(jax.__version__)
+    if found < MIN_JAX_VERSION:
+        raise ImportError(
+            f"mpi4jax_tpu requires jax >= {'.'.join(map(str, MIN_JAX_VERSION))}, "
+            f"found {jax.__version__}"
+        )
+    if found > LATEST_TESTED_JAX_VERSION and not config.flag(
+        "MPI4JAX_TPU_NO_WARN_JAX_VERSION"
+    ):
+        warnings.warn(
+            f"jax {jax.__version__} is newer than the latest version tested "
+            f"with mpi4jax_tpu "
+            f"({'.'.join(map(str, LATEST_TESTED_JAX_VERSION))}). "
+            "If you encounter problems, pin jax or set "
+            "MPI4JAX_TPU_NO_WARN_JAX_VERSION=1 to silence this warning.",
+            UserWarning,
+        )

@@ -1,0 +1,90 @@
+"""Debug tracing: per-call entry/exit log lines with rank, call id, timing.
+
+Parity with the reference's single observability mechanism
+(/root/reference/mpi4jax/_src/xla_bridge/mpi_xla_bridge.pyx:38-60,100-112 and
+SURVEY.md §5.1): when enabled (``MPI4JAX_TPU_DEBUG=1`` or ``set_logging``),
+every communicating call emits
+
+    r<rank> | <id8> | <Op> <details>
+    r<rank> | <id8> | <Op> done with code 0 (<dt> s)
+
+The world tier logs at execution time from the host side (the C++ transport
+has its own mirror of this, native/tpucomm.cc).  The mesh tier executes on
+device inside a compiled program, so per-execution host logging is done via
+``jax.debug.callback`` when tracing is enabled at trace time.
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+
+from . import config
+
+_PRINT_DEBUG: bool | None = None
+
+
+def set_logging(enabled: bool) -> None:
+    global _PRINT_DEBUG
+    _PRINT_DEBUG = bool(enabled)
+
+
+def logging_enabled() -> bool:
+    if _PRINT_DEBUG is not None:
+        return _PRINT_DEBUG
+    return config.debug_enabled()
+
+
+def new_call_id() -> str:
+    return secrets.token_hex(4)
+
+
+def log_line(rank, call_id: str, message: str) -> None:
+    print(f"r{rank} | {call_id} | {message}", flush=True)
+
+
+class CallTrace:
+    """Context manager for host-side op tracing (world tier)."""
+
+    def __init__(self, rank: int, opname: str, details: str = ""):
+        self.rank = rank
+        self.opname = opname
+        self.details = details
+        self.call_id = new_call_id()
+        self._t0 = 0.0
+
+    def __enter__(self):
+        if logging_enabled():
+            log_line(
+                self.rank, self.call_id, f"{self.opname} {self.details}".rstrip()
+            )
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if logging_enabled() and exc_type is None:
+            dt = time.perf_counter() - self._t0
+            log_line(
+                self.rank,
+                self.call_id,
+                f"{self.opname} done with code 0 ({dt:.6f} s)",
+            )
+        return False
+
+
+def trace_mesh_op(axis_rank, opname: str, details: str = "") -> None:
+    """Emit a device-side debug line for a mesh-tier op (if enabled).
+
+    Uses ``jax.debug.callback`` so the line is printed at *execution* time
+    with the concrete rank, matching the world-tier format.
+    """
+    if not logging_enabled():
+        return
+    import jax
+
+    call_id = new_call_id()
+
+    def _emit(r):
+        log_line(int(r), call_id, f"{opname} {details}".rstrip())
+
+    jax.debug.callback(_emit, axis_rank)

@@ -1,0 +1,181 @@
+"""allreduce identity tests on the 8-device mesh.
+
+Mirrors the reference test strategy (SURVEY.md §4.2): closed-form identities
+(sum == x * size), input non-mutation, scalars, jit, vmap, grad,
+linear_transpose and double-transpose.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m4j
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return m4j.make_mesh(N)
+
+
+def run_spmd(fn, *args, mesh=None, **kw):
+    return m4j.spmd(fn, mesh=mesh, **kw)(*args)
+
+
+def test_allreduce_sum(mesh):
+    x = jnp.arange(N * 3, dtype=jnp.float32).reshape(N, 3)
+    out = m4j.spmd(lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh)(x)
+    expected = np.tile(np.sum(np.asarray(x), axis=0), (N, 1))
+    np.testing.assert_allclose(np.asarray(out), expected)
+    # input unchanged
+    np.testing.assert_allclose(np.asarray(x), np.arange(N * 3).reshape(N, 3))
+
+
+def test_allreduce_jit(mesh):
+    x = jnp.ones((N, 4), jnp.float32)
+    f = jax.jit(m4j.spmd(lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh))
+    out = f(x)
+    np.testing.assert_allclose(np.asarray(out), N)
+
+
+@pytest.mark.parametrize(
+    "op,np_fn",
+    [
+        (m4j.SUM, np.sum),
+        (m4j.PROD, np.prod),
+        (m4j.MAX, np.max),
+        (m4j.MIN, np.min),
+    ],
+)
+def test_allreduce_ops(mesh, op, np_fn):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(0.5, 1.5, (N, 5)).astype(np.float32))
+    out = m4j.spmd(lambda v: m4j.allreduce(v, op=op), mesh=mesh)(x)
+    expected = np.tile(np_fn(np.asarray(x), axis=0), (N, 1))
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5)
+
+
+@pytest.mark.parametrize("op_name", ["LAND", "LOR", "LXOR"])
+def test_allreduce_logical(mesh, op_name):
+    op = m4j.as_reduce_op(op_name)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.rand(N, 6) > 0.5)
+    out = m4j.spmd(lambda v: m4j.allreduce(v, op=op), mesh=mesh)(x)
+    ref = {
+        "LAND": np.all(np.asarray(x), axis=0),
+        "LOR": np.any(np.asarray(x), axis=0),
+        "LXOR": np.sum(np.asarray(x), axis=0) % 2 == 1,
+    }[op_name]
+    np.testing.assert_array_equal(np.asarray(out), np.tile(ref, (N, 1)))
+
+
+@pytest.mark.parametrize("op_name", ["BAND", "BOR", "BXOR"])
+def test_allreduce_bitwise(mesh, op_name):
+    op = m4j.as_reduce_op(op_name)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randint(0, 255, (N, 4)).astype(np.uint8))
+    out = m4j.spmd(lambda v: m4j.allreduce(v, op=op), mesh=mesh)(x)
+    np_fn = {
+        "BAND": np.bitwise_and.reduce,
+        "BOR": np.bitwise_or.reduce,
+        "BXOR": np.bitwise_xor.reduce,
+    }[op_name]
+    np.testing.assert_array_equal(
+        np.asarray(out), np.tile(np_fn(np.asarray(x), axis=0), (N, 1))
+    )
+
+
+def test_allreduce_scalar(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+    out = m4j.spmd(
+        lambda v: m4j.allreduce(v[0], op=m4j.SUM)[None], mesh=mesh
+    )(x)
+    np.testing.assert_allclose(np.asarray(out), np.sum(np.arange(N)))
+
+
+def test_allreduce_bool_sum_raises(mesh):
+    x = jnp.ones((N,), jnp.bool_)
+    with pytest.raises(TypeError, match="not defined for boolean"):
+        m4j.spmd(lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh)(x)
+
+
+def test_allreduce_vmap(mesh):
+    x = jnp.arange(N * 2 * 3, dtype=jnp.float32).reshape(N, 2, 3)
+
+    def step(v):  # v: (2, 3) local; vmap over leading batch
+        return jax.vmap(lambda row: m4j.allreduce(row, op=m4j.SUM))(v)
+
+    out = m4j.spmd(step, mesh=mesh)(x)
+    expected = np.tile(np.asarray(x).sum(axis=0), (N, 1, 1)).reshape(N, 2, 3)
+    np.testing.assert_allclose(np.asarray(out), expected)
+
+
+def test_allreduce_grad(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+
+    def loss(v):
+        summed = m4j.spmd(
+            lambda u: m4j.allreduce(u * u, op=m4j.SUM), mesh=mesh
+        )(v)
+        return summed.sum()
+
+    g = jax.grad(loss)(x)
+    # d/dx_i sum_r sum_j x_j^2 (replicated N times) = 2 * N * x_i
+    np.testing.assert_allclose(np.asarray(g), 2 * N * np.asarray(x))
+
+
+def test_allreduce_jvp(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+    t = jnp.ones((N,), jnp.float32)
+    f = m4j.spmd(lambda u: m4j.allreduce(u, op=m4j.SUM), mesh=mesh)
+    y, ty = jax.jvp(f, (x,), (t,))
+    np.testing.assert_allclose(np.asarray(y), np.sum(np.arange(N)))
+    np.testing.assert_allclose(np.asarray(ty), N)
+
+
+def test_allreduce_transpose_identity(mesh):
+    # reference: double transpose of allreduce == allreduce
+    # (tests/collective_ops/test_allreduce.py:105-138 there)
+    x = jnp.arange(N, dtype=jnp.float32)
+    f = m4j.spmd(lambda u: m4j.allreduce(u, op=m4j.SUM), mesh=mesh)
+    (xt,) = jax.linear_transpose(f, x)(jnp.ones((N,), jnp.float32))
+    # transpose of "replicate-sum" applied to ones = N ones per shard summed
+    np.testing.assert_allclose(np.asarray(xt), N)
+
+    def double_transpose(v):
+        def t1(u):
+            return jax.linear_transpose(f, x)(u)[0]
+
+        return jax.linear_transpose(t1, jnp.ones((N,), jnp.float32))(v)[0]
+
+    dt = double_transpose(x)
+    np.testing.assert_allclose(np.asarray(dt), np.asarray(f(x)))
+
+
+def test_allreduce_token_chain(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+
+    def step(v):
+        token = m4j.create_token(v)
+        a, token = m4j.allreduce(v, op=m4j.SUM, token=token)
+        b, token = m4j.allreduce(a, op=m4j.MAX, token=token)
+        return b
+
+    out = m4j.spmd(step, mesh=mesh)(x)
+    np.testing.assert_allclose(np.asarray(out), np.sum(np.arange(N)))
+
+
+def test_allreduce_inside_fori_loop(mesh):
+    # ordering/effects must compose with lax control flow (SURVEY.md §7
+    # hard part 1)
+    x = jnp.ones((N,), jnp.float32)
+
+    def step(v):
+        def body(_, acc):
+            return m4j.allreduce(acc, op=m4j.SUM) / N
+        return jax.lax.fori_loop(0, 3, body, v)
+
+    out = jax.jit(m4j.spmd(step, mesh=mesh))(x)
+    np.testing.assert_allclose(np.asarray(out), 1.0)

@@ -1,0 +1,35 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+The reference runs its suite twice — single process and ``mpirun -np 2``
+(/root/reference/docs/developers.rst).  Here the primary tier is SPMD over a
+mesh, so the suite runs once over an 8-device *virtual CPU mesh*
+(xla_force_host_platform_device_count), which exercises every collective
+path the way 8 TPU chips would; world-tier tests spawn real subprocesses via
+the launcher.
+"""
+
+import os
+
+# Must happen before the first JAX backend initialization.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    )
+os.environ.setdefault("XLA_PYTHON_CLIENT_PREALLOCATE", "false")
+
+import jax
+
+try:
+    jax.config.update("jax_platforms", "cpu")
+except Exception:  # pragma: no cover - backend already initialized
+    pass
+
+
+def pytest_report_header(config):
+    import mpi4jax_tpu
+
+    return [
+        f"mpi4jax_tpu {mpi4jax_tpu.__version__} | jax {jax.__version__} | "
+        f"devices: {len(jax.devices())} x {jax.devices()[0].platform}"
+    ]
