@@ -1,0 +1,271 @@
+"""Identity tests for allgather/alltoall/bcast/reduce/scan/scatter/gather/
+barrier on the 8-device mesh (reference pattern: SURVEY.md §4.2 — eager+jit,
+closed-form expectations, input non-mutation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m4j
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return m4j.make_mesh(N)
+
+
+# ---- allgather ---------------------------------------------------------
+
+
+def test_allgather(mesh):
+    x = jnp.arange(N * 3, dtype=jnp.float32).reshape(N, 3)
+    out = m4j.spmd(lambda v: m4j.allgather(v), mesh=mesh)(x)
+    # each rank returns (N, 3); stacked across ranks -> (N*N, 3)
+    out = np.asarray(out).reshape(N, N, 3)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], np.asarray(x))
+
+
+def test_allgather_scalar(mesh):
+    x = jnp.arange(N, dtype=jnp.int32)
+    out = m4j.spmd(lambda v: m4j.allgather(v[0]), mesh=mesh)(x)
+    np.testing.assert_array_equal(
+        np.asarray(out).reshape(N, N)[0], np.arange(N)
+    )
+
+
+# ---- alltoall ----------------------------------------------------------
+
+
+def test_alltoall(mesh):
+    # rank r's input row j is 100*r + j; after alltoall, rank r's row j must
+    # be rank j's row r: 100*j + r.
+    x = jnp.asarray(
+        [[100 * r + j for j in range(N)] for r in range(N)], dtype=jnp.int32
+    ).reshape(N * N)
+    out = m4j.spmd(
+        lambda v: m4j.alltoall(v.reshape(N, 1)).reshape(N), mesh=mesh
+    )(x)
+    out = np.asarray(out).reshape(N, N)
+    for r in range(N):
+        np.testing.assert_array_equal(
+            out[r], np.array([100 * j + r for j in range(N)])
+        )
+
+
+def test_alltoall_bad_leading_axis(mesh):
+    x = jnp.ones((N, 3, 2), jnp.float32)
+    with pytest.raises(ValueError, match="leading axis"):
+        m4j.spmd(lambda v: m4j.alltoall(v), mesh=mesh)(x)
+
+
+# ---- bcast -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("root", [0, 3, 7])
+def test_bcast(mesh, root):
+    x = jnp.arange(N * 2, dtype=jnp.float32).reshape(N, 2)
+    out = m4j.spmd(lambda v: m4j.bcast(v, root), mesh=mesh)(x)
+    out = np.asarray(out).reshape(N, 2)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], np.asarray(x)[root])
+
+
+def test_bcast_bool(mesh):
+    x = jnp.asarray([[r % 2 == 0] for r in range(N)])
+    out = m4j.spmd(lambda v: m4j.bcast(v, 1), mesh=mesh)(x)
+    assert out.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(out), [[False]] * N)
+
+
+# ---- reduce ------------------------------------------------------------
+
+
+@pytest.mark.parametrize("root", [0, 5])
+def test_reduce(mesh, root):
+    x = jnp.arange(N * 2, dtype=jnp.float32).reshape(N, 2)
+    out = m4j.spmd(lambda v: m4j.reduce(v, m4j.SUM, root), mesh=mesh)(x)
+    out = np.asarray(out).reshape(N, 2)
+    np.testing.assert_allclose(out[root], np.asarray(x).sum(axis=0))
+    for r in range(N):
+        if r != root:
+            # non-root ranks keep their input (reference contract)
+            np.testing.assert_allclose(out[r], np.asarray(x)[r])
+
+
+def test_reduce_max(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+    out = m4j.spmd(lambda v: m4j.reduce(v, m4j.MAX, 2), mesh=mesh)(x)
+    out = np.asarray(out)
+    assert out[2] == N - 1
+    assert out[0] == 0.0
+
+
+# ---- scan --------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "op,np_acc",
+    [
+        (m4j.SUM, np.cumsum),
+        (m4j.MAX, np.maximum.accumulate),
+        (m4j.MIN, np.minimum.accumulate),
+        (m4j.PROD, np.cumprod),
+    ],
+)
+def test_scan(mesh, op, np_acc):
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.uniform(0.5, 1.5, (N, 3)).astype(np.float32))
+    out = m4j.spmd(lambda v: m4j.scan(v, op), mesh=mesh)(x)
+    expected = np_acc(np.asarray(x), axis=0)
+    np.testing.assert_allclose(
+        np.asarray(out).reshape(N, 3), expected, rtol=1e-5
+    )
+
+
+def test_scan_int(mesh):
+    x = jnp.ones((N, 1), jnp.int32)
+    out = m4j.spmd(lambda v: m4j.scan(v, m4j.SUM), mesh=mesh)(x)
+    np.testing.assert_array_equal(
+        np.asarray(out).ravel(), np.arange(1, N + 1)
+    )
+
+
+# ---- scatter / gather --------------------------------------------------
+
+
+@pytest.mark.parametrize("root", [0, 4])
+def test_scatter(mesh, root):
+    # every rank passes the same (N, 2) buffer; rank j receives row j
+    base = np.arange(N * 2, dtype=np.float32).reshape(N, 2)
+    x = jnp.asarray(np.tile(base, (N, 1)))  # global (N*N, 2)
+    out = m4j.spmd(lambda v: m4j.scatter(v, root), mesh=mesh)(x)
+    out = np.asarray(out).reshape(N, 2)
+    np.testing.assert_allclose(out, base)
+
+
+def test_scatter_gather_roundtrip(mesh):
+    base = np.arange(N * 3, dtype=np.float32).reshape(N, 3)
+    x = jnp.asarray(np.tile(base, (N, 1)))
+
+    def step(v):
+        mine = m4j.scatter(v, 0)
+        return m4j.gather(mine, 0)
+
+    out = m4j.spmd(step, mesh=mesh)(x)
+    out = np.asarray(out).reshape(N, N, 3)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], base)
+
+
+def test_gather(mesh):
+    x = jnp.arange(N, dtype=jnp.int32)
+    out = m4j.spmd(lambda v: m4j.gather(v, 0), mesh=mesh)(x)
+    np.testing.assert_array_equal(
+        np.asarray(out).reshape(N, N)[0], np.arange(N)
+    )
+
+
+# ---- barrier -----------------------------------------------------------
+
+
+def test_barrier(mesh):
+    def step(v):
+        m4j.barrier()
+        return v
+
+    out = m4j.spmd(step, mesh=mesh)(jnp.arange(N, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.arange(N))
+
+
+def test_barrier_token(mesh):
+    def step(v):
+        token = m4j.create_token(v)
+        token = m4j.barrier(token=token)
+        y, token = m4j.allreduce(v, op=m4j.SUM, token=token)
+        return y
+
+    out = m4j.spmd(step, mesh=mesh)(jnp.arange(N, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), np.sum(np.arange(N)))
+
+
+# ---- sendrecv / permute ------------------------------------------------
+
+
+def test_sendrecv_ring(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+    out = m4j.spmd(lambda v: m4j.sendrecv(v, shift=1), mesh=mesh)(x)
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(N), 1))
+
+
+def test_sendrecv_ring_backward(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+    out = m4j.spmd(lambda v: m4j.sendrecv(v, shift=-1), mesh=mesh)(x)
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(N), -1))
+
+
+def test_sendrecv_nowrap_zero_fill(mesh):
+    x = jnp.ones((N,), jnp.float32)
+    out = m4j.spmd(
+        lambda v: m4j.sendrecv(v, shift=1, wrap=False), mesh=mesh
+    )(x)
+    out = np.asarray(out)
+    assert out[0] == 0.0  # rank 0 has no source
+    np.testing.assert_allclose(out[1:], 1.0)
+
+
+def test_sendrecv_explicit_perm(mesh):
+    x = jnp.arange(N, dtype=jnp.int32)
+    perm = [(0, 7), (7, 0)]
+    out = m4j.spmd(lambda v: m4j.permute(v, perm), mesh=mesh)(x)
+    out = np.asarray(out)
+    assert out[7] == 0 and out[0] == 7
+    np.testing.assert_array_equal(out[1:7], 0)
+
+
+def test_sendrecv_transpose_swaps_direction(mesh):
+    # reference: transpose of sendrecv swaps source and dest
+    # (sendrecv.py:390-409 there); ppermute's transpose is the inverse perm.
+    x = jnp.arange(N, dtype=jnp.float32)
+    f = m4j.spmd(lambda v: m4j.sendrecv(v, shift=1), mesh=mesh)
+    (ct,) = jax.linear_transpose(f, x)(jnp.arange(N, dtype=jnp.float32))
+    np.testing.assert_allclose(np.asarray(ct), np.roll(np.arange(N), -1))
+
+
+def test_sendrecv_jvp(mesh):
+    # improvement over the reference (which forbids fwd-mode, sendrecv.py:150)
+    x = jnp.arange(N, dtype=jnp.float32)
+    f = m4j.spmd(lambda v: m4j.sendrecv(v, shift=2), mesh=mesh)
+    y, ty = jax.jvp(f, (x,), (2 * x,))
+    np.testing.assert_allclose(np.asarray(ty), 2 * np.asarray(y))
+
+
+def test_send_recv_raise_in_mesh(mesh):
+    x = jnp.ones((N,), jnp.float32)
+    with pytest.raises(NotImplementedError, match="SPMD"):
+        m4j.spmd(lambda v: m4j.send(v, 0), mesh=mesh)(x)
+    with pytest.raises(NotImplementedError, match="SPMD"):
+        m4j.spmd(lambda v: m4j.recv(v, 0), mesh=mesh)(x)
+
+
+# ---- validation --------------------------------------------------------
+
+
+def test_static_int_validation(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+    with pytest.raises(TypeError, match="static"):
+        m4j.spmd(lambda v: m4j.bcast(v, jnp.int32(0)), mesh=mesh)(x)
+
+
+def test_traced_root_error_message(mesh):
+    x = jnp.arange(N, dtype=jnp.float32)
+
+    def step(v):
+        r = jax.lax.axis_index("mpi")  # traced
+        return m4j.bcast(v, r)
+
+    with pytest.raises(TypeError, match="static"):
+        m4j.spmd(step, mesh=mesh)(x)
