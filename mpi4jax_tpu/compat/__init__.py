@@ -1,0 +1,1 @@
+from . import token_api  # noqa: F401

@@ -1,0 +1,88 @@
+"""Explicit-token compatibility API.
+
+Signature-level parity with the reference's primary (token) API
+(/root/reference/mpi4jax/_src/collective_ops/*.py): every function returns
+``(result, token)`` (or just ``token`` for send/barrier), and accepts
+``token=None`` to start a chain, exactly like
+
+    res, token = mpi4jax.allreduce(x, op=MPI.SUM, comm=comm, token=token)
+
+The reference threads real XLA tokens through its custom calls
+(allreduce.py:63-64,101-104 there).  Here tokens are scalar arrays tied to op
+inputs/outputs with ``lax.optimization_barrier`` (ops/_dispatch.py): on the
+mesh tier SPMD program order already guarantees a deadlock-free global order,
+so the token's job reduces to expressing *extra* ordering edges the dataflow
+doesn't carry — which the barrier provides; on the world tier the ordered
+effect provides ordering and the token is carried for API fidelity.
+"""
+
+from __future__ import annotations
+
+from .. import ops as _ops
+from ..ops import _dispatch
+from ..ops.reduce_ops import SUM
+
+create_token = _dispatch.create_token
+
+
+def _start(token, x=None):
+    return _dispatch.create_token(x) if token is None else token
+
+
+def allreduce(x, op=SUM, *, comm=None, token=None):
+    return _ops.allreduce(x, op, comm=comm, token=_start(token, x))
+
+
+def allgather(x, *, comm=None, token=None):
+    return _ops.allgather(x, comm=comm, token=_start(token, x))
+
+
+def alltoall(x, *, comm=None, token=None):
+    return _ops.alltoall(x, comm=comm, token=_start(token, x))
+
+
+def barrier(*, comm=None, token=None):
+    return _ops.barrier(comm=comm, token=_start(token))
+
+
+def bcast(x, root=0, *, comm=None, token=None):
+    return _ops.bcast(x, root, comm=comm, token=_start(token, x))
+
+
+def gather(x, root=0, *, comm=None, token=None):
+    return _ops.gather(x, root, comm=comm, token=_start(token, x))
+
+
+def recv(x, source, tag=0, *, comm=None, token=None):
+    return _ops.recv(x, source, tag, comm=comm, token=_start(token, x))
+
+
+def reduce(x, op=SUM, root=0, *, comm=None, token=None):
+    return _ops.reduce(x, op, root, comm=comm, token=_start(token, x))
+
+
+def scan(x, op=SUM, *, comm=None, token=None):
+    return _ops.scan(x, op, comm=comm, token=_start(token, x))
+
+
+def scatter(x, root=0, *, comm=None, token=None):
+    return _ops.scatter(x, root, comm=comm, token=_start(token, x))
+
+
+def send(x, dest, tag=0, *, comm=None, token=None):
+    return _ops.send(x, dest, tag, comm=comm, token=_start(token, x))
+
+
+def sendrecv(
+    x, *, perm=None, shift=None, wrap=True, comm=None, token=None
+):
+    return _ops.sendrecv(
+        x, perm=perm, shift=shift, wrap=wrap, comm=comm,
+        token=_start(token, x),
+    )
+
+
+__all__ = [
+    "allgather", "allreduce", "alltoall", "barrier", "bcast", "create_token",
+    "gather", "recv", "reduce", "scan", "scatter", "send", "sendrecv",
+]

@@ -1,0 +1,1 @@
+from .shallow_water import ShallowWater, SWParams, SWState  # noqa: F401

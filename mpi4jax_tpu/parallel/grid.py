@@ -1,0 +1,73 @@
+"""Cartesian process grids over device meshes.
+
+The reference builds its 2-D process grid by hand from the rank
+(/root/reference/examples/shallow_water.py:57-107: rank → (row, col),
+neighbor ranks, periodic wraparound).  TPU-native, the grid *is* the mesh:
+two named axes, coordinates are ``lax.axis_index`` per axis, and neighbor
+communication is ``lax.ppermute`` along one axis — which on a TPU torus maps
+straight onto nearest-neighbor ICI links.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+from .mesh import MeshComm
+
+
+class ProcessGrid:
+    """An N-D cartesian communicator over mesh axes.
+
+    ``shape`` gives the number of ranks per dimension; ``axes`` names the
+    mesh axes (created if a mesh isn't supplied).
+    """
+
+    def __init__(
+        self,
+        shape: Sequence[int],
+        *,
+        axes: Optional[Sequence[str]] = None,
+        mesh: Optional[Mesh] = None,
+        devices: Optional[Sequence] = None,
+    ):
+        self.shape = tuple(int(s) for s in shape)
+        ndim = len(self.shape)
+        if axes is None:
+            axes = tuple(f"grid{i}" for i in range(ndim))
+        self.axes = tuple(axes)
+        if len(self.axes) != ndim:
+            raise ValueError("axes must match shape length")
+        if mesh is None:
+            n = int(np.prod(self.shape))
+            if devices is None:
+                devices = jax.devices()
+            if len(devices) < n:
+                raise ValueError(
+                    f"grid {self.shape} needs {n} devices, have {len(devices)}"
+                )
+            mesh = Mesh(
+                np.asarray(devices[:n]).reshape(self.shape), self.axes
+            )
+        self.mesh = mesh
+        self.comm = MeshComm(self.axes, mesh=mesh)
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    def coords(self) -> Tuple:
+        """This rank's grid coordinates (traced; inside shard_map)."""
+        return tuple(lax.axis_index(a) for a in self.axes)
+
+    def axis_comm(self, dim: int) -> MeshComm:
+        """Sub-communicator along one grid dimension (row/col comms)."""
+        return MeshComm(self.axes[dim], mesh=self.mesh)
+
+    def __repr__(self):
+        return f"ProcessGrid(shape={self.shape}, axes={self.axes})"

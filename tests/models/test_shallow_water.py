@@ -1,0 +1,59 @@
+"""Shallow-water solver: stability, conservation, and — the strongest
+correctness check — bitwise-comparable results between a 1-device and an
+8-device decomposition of the same problem."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import jax
+import mpi4jax_tpu as m4j
+from mpi4jax_tpu.models.shallow_water import ShallowWater, SWParams
+from mpi4jax_tpu.parallel.grid import ProcessGrid
+
+NY, NX = 24, 48
+PARAMS = SWParams(dx=5e3, dy=5e3)
+
+
+def run_model(grid_shape, n_steps):
+    n = int(np.prod(grid_shape))
+    grid = ProcessGrid(grid_shape, devices=jax.devices()[:n])
+    model = ShallowWater(grid, (NY, NX), PARAMS)
+    state = model.init()
+    state = model.step_fn(n_steps, first=True)(state)
+    return model, state
+
+
+def test_finite_and_nontrivial():
+    model, state = run_model((2, 4), 10)
+    h = model.interior(state.h)
+    assert np.all(np.isfinite(h))
+    assert h.std() > 0  # jet + perturbation evolve
+
+
+def test_mass_conservation():
+    model, state0 = run_model((2, 4), 0)
+    m0 = model.total_mass(state0)
+    state = model.step_fn(20, first=True)(state0)
+    m1 = model.total_mass(state)
+    assert abs(m1 - m0) / abs(m0) < 1e-5
+
+
+def test_decomposition_invariance():
+    # 1 device vs 8 devices must produce the same trajectory
+    model1, s1 = run_model((1, 1), 10)
+    model8, s8 = run_model((2, 4), 10)
+    h1 = model1.interior(s1.h)
+    h8 = model8.interior(s8.h)
+    np.testing.assert_allclose(h1, h8, rtol=2e-5, atol=2e-5)
+    u1 = model1.interior(s1.u)
+    u8 = model8.interior(s8.u)
+    np.testing.assert_allclose(u1, u8, rtol=2e-4, atol=2e-4)
+
+
+def test_longer_run_stable():
+    model, state = run_model((2, 4), 100)
+    h = model.interior(state.h)
+    assert np.all(np.isfinite(h))
+    # surface stays within physically plausible bounds around DEPTH=100
+    assert 50 < h.mean() < 150

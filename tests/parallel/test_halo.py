@@ -1,0 +1,121 @@
+"""Halo-exchange correctness: reconstruct a global array's neighbor strips.
+
+The reference validates its halo pattern implicitly through the
+shallow-water solver; here we check exchange against a numpy ground truth
+on a 4x2 grid (8 virtual devices).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m4j
+from mpi4jax_tpu.parallel.grid import ProcessGrid
+from mpi4jax_tpu.parallel.halo import halo_exchange
+
+GX, GY = 4, 2
+H = 1
+LOC = (6, 4)  # interior block per rank
+
+
+def make_global():
+    rng = np.random.RandomState(0)
+    return rng.rand(GX * LOC[0], GY * LOC[1]).astype(np.float32)
+
+
+def pad_blocks(g):
+    """Split global into per-rank blocks padded with zero ghost rings."""
+    blocks = []
+    for i in range(GX):
+        row = []
+        for j in range(GY):
+            b = g[
+                i * LOC[0] : (i + 1) * LOC[0], j * LOC[1] : (j + 1) * LOC[1]
+            ]
+            row.append(np.pad(b, H))
+        blocks.append(row)
+    return blocks
+
+
+@pytest.mark.parametrize("periodic", [True, False])
+def test_halo_exchange_2d(periodic):
+    g = make_global()
+    blocks = pad_blocks(g)
+    grid = ProcessGrid((GX, GY))
+
+    # shard_map input: global array of stacked padded blocks
+    stacked = np.stack(
+        [blocks[i][j] for i in range(GX) for j in range(GY)]
+    ).reshape(GX, GY, LOC[0] + 2 * H, LOC[1] + 2 * H)
+    xin = jnp.asarray(stacked)
+
+    def step(b):
+        b = b.reshape(LOC[0] + 2 * H, LOC[1] + 2 * H)
+        out = halo_exchange(b, grid, halo=H, periodic=periodic)
+        return out.reshape(1, 1, LOC[0] + 2 * H, LOC[1] + 2 * H)
+
+    from jax.sharding import PartitionSpec as P
+
+    out = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=grid.mesh,
+            in_specs=P(*grid.axes),
+            out_specs=P(*grid.axes),
+        )
+    )(xin)
+    out = np.asarray(out)
+
+    gp = np.pad(g, H, mode="wrap" if periodic else "constant")
+    for i in range(GX):
+        for j in range(GY):
+            got = out[i, j]
+            want = gp[
+                i * LOC[0] : (i + 1) * LOC[0] + 2 * H,
+                j * LOC[1] : (j + 1) * LOC[1] + 2 * H,
+            ].copy()
+            if not periodic:
+                # physical-boundary ghosts keep their prior (zero) values
+                pass
+            # corners are not exchanged diagonally in a 2-pass exchange of
+            # axis 0 then axis 1 — axis-1 pass propagates the already-updated
+            # axis-0 ghosts, so corners ARE correct. Compare everything.
+            np.testing.assert_allclose(got, want, err_msg=f"block {i},{j}")
+
+
+def test_halo_multifield():
+    g1, g2 = make_global(), make_global() + 1
+    grid = ProcessGrid((GX, GY))
+    b1 = pad_blocks(g1)
+    b2 = pad_blocks(g2)
+    s1 = np.stack([b1[i][j] for i in range(GX) for j in range(GY)])
+    s2 = np.stack([b2[i][j] for i in range(GX) for j in range(GY)])
+    shp = (GX, GY, LOC[0] + 2 * H, LOC[1] + 2 * H)
+
+    def step(a, b):
+        a = a.reshape(shp[2:])
+        b = b.reshape(shp[2:])
+        a2, b2_ = halo_exchange((a, b), grid, halo=H, periodic=True)
+        return a2.reshape(1, 1, *shp[2:]), b2_.reshape(1, 1, *shp[2:])
+
+    from jax.sharding import PartitionSpec as P
+
+    o1, o2 = jax.jit(
+        jax.shard_map(
+            step,
+            mesh=grid.mesh,
+            in_specs=P(*grid.axes),
+            out_specs=P(*grid.axes),
+        )
+    )(jnp.asarray(s1.reshape(shp)), jnp.asarray(s2.reshape(shp)))
+    g1p = np.pad(g1, H, mode="wrap")
+    g2p = np.pad(g2, H, mode="wrap")
+    np.testing.assert_allclose(
+        np.asarray(o1)[1, 1],
+        g1p[LOC[0] : 2 * LOC[0] + 2 * H, LOC[1] : 2 * LOC[1] + 2 * H],
+    )
+    np.testing.assert_allclose(
+        np.asarray(o2)[2, 0],
+        g2p[2 * LOC[0] : 3 * LOC[0] + 2 * H, 0 : LOC[1] + 2 * H],
+    )
