@@ -1,0 +1,74 @@
+"""Round benchmark: shallow-water headline config on the available hardware.
+
+Reference baseline (BASELINE.md): the same physical configuration —
+(1800, 3600) domain, 0.1 model days, CFL dt — took 6.28 s on one Tesla P100
+and 111.95 s on one CPU socket (docs/shallow-water.rst there).  We report
+wall seconds on one TPU chip; ``vs_baseline`` is the speedup over the
+reference's best single-accelerator number (P100).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+BASELINE_GPU_SECONDS = 6.28  # reference: 1x P100, docs/shallow-water.rst:81-83
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from mpi4jax_tpu.models.shallow_water import ShallowWater, SWParams
+    from mpi4jax_tpu.parallel.grid import ProcessGrid
+
+    ndev = len(jax.devices())
+    # single-chip headline config (the driver runs this on one real TPU)
+    grid = ProcessGrid((1, 1), devices=jax.devices()[:1])
+    params = SWParams(dx=5e3, dy=5e3)
+    ny, nx = 1800, 3600
+    model = ShallowWater(grid, (ny, nx), params)
+
+    days = 0.1
+    n_steps = int(days * params.day_seconds / params.dt)
+    multistep = 25
+
+    state = model.init()
+    first = model.step_fn(1, first=True)
+    step = model.step_fn(multistep, first=False)
+
+    state = first(state)
+    jax.block_until_ready(step(state))  # compile + one warmup multistep
+
+    t0 = time.perf_counter()
+    done = 1
+    while done < n_steps:
+        state = step(state)
+        jax.block_until_ready(state.h)
+        done += multistep
+    elapsed = time.perf_counter() - t0
+
+    h = model.interior(state.h)
+    if not np.all(np.isfinite(h)):
+        print(json.dumps({
+            "metric": "shallow_water_1800x3600_0.1day",
+            "value": None, "unit": "s", "vs_baseline": 0.0,
+            "error": "diverged",
+        }))
+        return 1
+
+    print(json.dumps({
+        "metric": "shallow_water_1800x3600_0.1day_1chip",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_GPU_SECONDS / elapsed, 3),
+        "steps": done,
+        "platform": jax.devices()[0].platform,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
