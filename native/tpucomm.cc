@@ -1,0 +1,780 @@
+/* tpucomm — TCP-mesh communication runtime (see tpucomm.h).
+ *
+ * Design notes:
+ * - Connection setup: rank r listens on base_port + r; for each pair
+ *   (i, j) with i < j, j dials i and identifies itself with its rank.
+ * - Messages are framed (tag, nbytes) and matched strictly in order — the
+ *   Python layer serializes communicating ops per process with JAX ordered
+ *   effects, so out-of-order arrival on one socket is a program error
+ *   (matching the reference's token-ordering contract, not a message
+ *   re-ordering layer).
+ * - Collectives are deterministic schedules over the point-to-point layer
+ *   (ring allreduce for large payloads would be a later optimization; the
+ *   present schedules favor obviousness: see each function).
+ * - Debug tracing mirrors the reference bridge's format
+ *   ("r<rank> | <id> | Op ..."): entry + exit line with wall time.
+ * - Fail-fast: any socket/protocol error prints to stderr and returns
+ *   nonzero; the Python layer aborts the process group.
+ */
+
+#include "tpucomm.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int g_logging = 0;
+
+double now_s() {
+  using clock = std::chrono::steady_clock;
+  return std::chrono::duration<double>(clock::now().time_since_epoch())
+      .count();
+}
+
+std::string call_id() {
+  static thread_local std::mt19937_64 rng{std::random_device{}()};
+  char buf[12];
+  std::snprintf(buf, sizeof(buf), "%08llx",
+                (unsigned long long)(rng() & 0xffffffffull));
+  return buf;
+}
+
+struct LogScope {
+  int rank;
+  std::string id;
+  const char* op;
+  double t0 = 0;
+  bool active;
+  LogScope(int rank, const char* op, const std::string& detail)
+      : rank(rank), id(call_id()), op(op), active(g_logging != 0) {
+    if (active) {
+      std::fprintf(stderr, "r%d | %s | %s %s\n", rank, id.c_str(), op,
+                   detail.c_str());
+      t0 = now_s();
+    }
+  }
+  ~LogScope() {
+    if (active) {
+      std::fprintf(stderr, "r%d | %s | %s done with code 0 (%.6f s)\n", rank,
+                   id.c_str(), op, now_s() - t0);
+    }
+  }
+};
+
+#define FAIL(comm, ...)                                              \
+  do {                                                               \
+    std::fprintf(stderr, "tpucomm r%d: ", (comm)->rank);             \
+    std::fprintf(stderr, __VA_ARGS__);                               \
+    std::fprintf(stderr, "\n");                                      \
+    return 1;                                                        \
+  } while (0)
+
+struct MsgHeader {
+  int64_t nbytes;
+  int32_t tag;
+  int32_t pad;
+};
+
+struct Comm {
+  int rank = -1;
+  int size = 0;
+  std::vector<int> socks;  // per-peer fd, -1 for self
+  std::mutex mu;           // one op at a time (ordered effects upstream)
+};
+
+std::mutex g_comms_mu;
+std::map<int64_t, Comm*> g_comms;
+int64_t g_next_handle = 1;
+
+Comm* get_comm(int64_t h) {
+  std::lock_guard<std::mutex> lock(g_comms_mu);
+  auto it = g_comms.find(h);
+  return it == g_comms.end() ? nullptr : it->second;
+}
+
+int write_all(int fd, const void* buf, int64_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, (size_t)n);
+    if (w <= 0) {
+      if (w < 0 && (errno == EINTR)) continue;
+      return 1;
+    }
+    p += w;
+    n -= w;
+  }
+  return 0;
+}
+
+int read_all(int fd, void* buf, int64_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, (size_t)n);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return 1;
+    }
+    p += r;
+    n -= r;
+  }
+  return 0;
+}
+
+int send_msg(Comm* c, int dest, int tag, const void* buf, int64_t nbytes) {
+  if (dest < 0 || dest >= c->size) FAIL(c, "send to invalid rank %d", dest);
+  if (dest == c->rank) FAIL(c, "send to self is not supported");
+  MsgHeader h{nbytes, tag, 0};
+  if (write_all(c->socks[dest], &h, sizeof(h)) ||
+      write_all(c->socks[dest], buf, nbytes))
+    FAIL(c, "send to %d failed: %s", dest, std::strerror(errno));
+  return 0;
+}
+
+int recv_msg(Comm* c, int source, int tag, void* buf, int64_t nbytes) {
+  if (source < 0 || source >= c->size)
+    FAIL(c, "recv from invalid rank %d", source);
+  if (source == c->rank) FAIL(c, "recv from self is not supported");
+  MsgHeader h{};
+  if (read_all(c->socks[source], &h, sizeof(h)))
+    FAIL(c, "recv header from %d failed: %s", source, std::strerror(errno));
+  if (h.tag != tag)
+    FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
+         tag, source, h.tag);
+  if (h.nbytes != nbytes)
+    FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
+         source, (long long)nbytes, (long long)h.nbytes);
+  if (read_all(c->socks[source], buf, nbytes))
+    FAIL(c, "recv payload from %d failed: %s", source, std::strerror(errno));
+  return 0;
+}
+
+/* ---------------- element-wise reduction kernels ---------------- */
+
+float bf16_to_f32(uint16_t v) {
+  uint32_t bits = (uint32_t)v << 16;
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+uint16_t f32_to_bf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  /* round to nearest even */
+  uint32_t rounded = bits + 0x7fff + ((bits >> 16) & 1);
+  return (uint16_t)(rounded >> 16);
+}
+
+float f16_to_f32(uint16_t v) {
+  uint32_t sign = (v & 0x8000u) << 16;
+  uint32_t exp = (v >> 10) & 0x1f;
+  uint32_t mant = v & 0x3ff;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;
+    } else {
+      exp = 127 - 15 + 1;
+      while (!(mant & 0x400)) {
+        mant <<= 1;
+        exp--;
+      }
+      mant &= 0x3ff;
+      bits = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    bits = sign | 0x7f800000u | (mant << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+uint16_t f32_to_f16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = (int32_t)((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t mant = bits & 0x7fffffu;
+  if (exp >= 31) return (uint16_t)(sign | 0x7c00u); /* inf/overflow */
+  if (exp <= 0) return (uint16_t)sign;              /* flush denormals */
+  return (uint16_t)(sign | (exp << 10) | (mant >> 13));
+}
+
+template <typename T>
+void combine_typed(T* acc, const T* in, int64_t n, int op) {
+  switch (op) {
+    case TPU_SUM:
+      for (int64_t i = 0; i < n; i++) acc[i] = acc[i] + in[i];
+      break;
+    case TPU_PROD:
+      for (int64_t i = 0; i < n; i++) acc[i] = acc[i] * in[i];
+      break;
+    case TPU_MAX:
+      for (int64_t i = 0; i < n; i++)
+        acc[i] = acc[i] < in[i] ? in[i] : acc[i];
+      break;
+    case TPU_MIN:
+      for (int64_t i = 0; i < n; i++)
+        acc[i] = in[i] < acc[i] ? in[i] : acc[i];
+      break;
+    default:
+      break;
+  }
+}
+
+template <typename T>
+void combine_integer(T* acc, const T* in, int64_t n, int op) {
+  switch (op) {
+    case TPU_LAND:
+      for (int64_t i = 0; i < n; i++) acc[i] = (T)((acc[i] != 0) && (in[i] != 0));
+      break;
+    case TPU_LOR:
+      for (int64_t i = 0; i < n; i++) acc[i] = (T)((acc[i] != 0) || (in[i] != 0));
+      break;
+    case TPU_LXOR:
+      for (int64_t i = 0; i < n; i++) acc[i] = (T)((acc[i] != 0) ^ (in[i] != 0));
+      break;
+    case TPU_BAND:
+      for (int64_t i = 0; i < n; i++) acc[i] = acc[i] & in[i];
+      break;
+    case TPU_BOR:
+      for (int64_t i = 0; i < n; i++) acc[i] = acc[i] | in[i];
+      break;
+    case TPU_BXOR:
+      for (int64_t i = 0; i < n; i++) acc[i] = acc[i] ^ in[i];
+      break;
+    default:
+      combine_typed(acc, in, n, op);
+      break;
+  }
+}
+
+template <typename T, typename ToF, typename FromF>
+void combine_via_float(T* acc, const T* in, int64_t n, int op, ToF to_f,
+                       FromF from_f) {
+  for (int64_t i = 0; i < n; i++) {
+    float a = to_f(acc[i]), b = to_f(in[i]);
+    float r;
+    switch (op) {
+      case TPU_SUM: r = a + b; break;
+      case TPU_PROD: r = a * b; break;
+      case TPU_MAX: r = a < b ? b : a; break;
+      case TPU_MIN: r = b < a ? b : a; break;
+      default: r = a; break;
+    }
+    acc[i] = from_f(r);
+  }
+}
+
+void combine_complex(float* acc, const float* in, int64_t n, int op) {
+  /* n complex elements, interleaved re/im */
+  for (int64_t i = 0; i < n; i++) {
+    float ar = acc[2 * i], ai = acc[2 * i + 1];
+    float br = in[2 * i], bi = in[2 * i + 1];
+    if (op == TPU_SUM) {
+      acc[2 * i] = ar + br;
+      acc[2 * i + 1] = ai + bi;
+    } else { /* PROD */
+      acc[2 * i] = ar * br - ai * bi;
+      acc[2 * i + 1] = ar * bi + ai * br;
+    }
+  }
+}
+
+void combine_complex_d(double* acc, const double* in, int64_t n, int op) {
+  for (int64_t i = 0; i < n; i++) {
+    double ar = acc[2 * i], ai = acc[2 * i + 1];
+    double br = in[2 * i], bi = in[2 * i + 1];
+    if (op == TPU_SUM) {
+      acc[2 * i] = ar + br;
+      acc[2 * i + 1] = ai + bi;
+    } else {
+      acc[2 * i] = ar * br - ai * bi;
+      acc[2 * i + 1] = ar * bi + ai * br;
+    }
+  }
+}
+
+int combine(void* acc, const void* in, int64_t count, int dtype, int op,
+            Comm* c) {
+  switch (dtype) {
+    case TPU_BOOL:
+    case TPU_U8:
+      combine_integer((uint8_t*)acc, (const uint8_t*)in, count, op);
+      return 0;
+    case TPU_I8:
+      combine_integer((int8_t*)acc, (const int8_t*)in, count, op);
+      return 0;
+    case TPU_I16:
+      combine_integer((int16_t*)acc, (const int16_t*)in, count, op);
+      return 0;
+    case TPU_I32:
+      combine_integer((int32_t*)acc, (const int32_t*)in, count, op);
+      return 0;
+    case TPU_I64:
+      combine_integer((int64_t*)acc, (const int64_t*)in, count, op);
+      return 0;
+    case TPU_U16:
+      combine_integer((uint16_t*)acc, (const uint16_t*)in, count, op);
+      return 0;
+    case TPU_U32:
+      combine_integer((uint32_t*)acc, (const uint32_t*)in, count, op);
+      return 0;
+    case TPU_U64:
+      combine_integer((uint64_t*)acc, (const uint64_t*)in, count, op);
+      return 0;
+    case TPU_F16:
+      combine_via_float((uint16_t*)acc, (const uint16_t*)in, count, op,
+                        f16_to_f32, f32_to_f16);
+      return 0;
+    case TPU_BF16:
+      combine_via_float((uint16_t*)acc, (const uint16_t*)in, count, op,
+                        bf16_to_f32, f32_to_bf16);
+      return 0;
+    case TPU_F32:
+      combine_typed((float*)acc, (const float*)in, count, op);
+      return 0;
+    case TPU_F64:
+      combine_typed((double*)acc, (const double*)in, count, op);
+      return 0;
+    case TPU_C64:
+      if (op != TPU_SUM && op != TPU_PROD)
+        FAIL(c, "op %d not defined for complex dtype", op);
+      combine_complex((float*)acc, (const float*)in, count, op);
+      return 0;
+    case TPU_C128:
+      if (op != TPU_SUM && op != TPU_PROD)
+        FAIL(c, "op %d not defined for complex dtype", op);
+      combine_complex_d((double*)acc, (const double*)in, count, op);
+      return 0;
+    default:
+      FAIL(c, "unknown dtype code %d", dtype);
+  }
+}
+
+int64_t dtype_size(int dtype) {
+  switch (dtype) {
+    case TPU_BOOL: case TPU_I8: case TPU_U8: return 1;
+    case TPU_I16: case TPU_U16: case TPU_F16: case TPU_BF16: return 2;
+    case TPU_I32: case TPU_U32: case TPU_F32: return 4;
+    case TPU_I64: case TPU_U64: case TPU_F64: case TPU_C64: return 8;
+    case TPU_C128: return 16;
+    default: return 0;
+  }
+}
+
+constexpr int kCollectiveTag = -7701;
+
+int bcast_internal(Comm* c, void* buf, int64_t nbytes, int root) {
+  /* binomial tree rooted at `root` (relative ranks) */
+  int vrank = (c->rank - root + c->size) % c->size;
+  int dist = 1;
+  while (dist < c->size) dist *= 2;
+  if (vrank != 0) {
+    int lowbit = vrank & (-vrank);
+    int parent = (vrank - lowbit + root) % c->size;
+    if (recv_msg(c, parent, kCollectiveTag, buf, nbytes)) return 1;
+  }
+  int lowbit = vrank == 0 ? dist : (vrank & (-vrank));
+  for (int step = lowbit / 2; step >= 1; step /= 2) {
+    int vchild = vrank + step;
+    if (vchild < c->size) {
+      int child = (vchild + root) % c->size;
+      if (send_msg(c, child, kCollectiveTag, buf, nbytes)) return 1;
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void tpucomm_set_logging(int enabled) { g_logging = enabled; }
+
+int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
+  auto* c = new Comm;
+  c->rank = rank;
+  c->size = size;
+  c->socks.assign(size, -1);
+
+  std::vector<std::string> host_list(size, "127.0.0.1");
+  if (hosts && hosts[0]) {
+    std::string s(hosts);
+    size_t pos = 0;
+    for (int i = 0; i < size; i++) {
+      size_t comma = s.find(',', pos);
+      host_list[i] = s.substr(pos, comma == std::string::npos
+                                       ? std::string::npos
+                                       : comma - pos);
+      if (comma == std::string::npos) break;
+      pos = comma + 1;
+    }
+  }
+
+  /* listener for ranks > me */
+  int listen_fd = -1;
+  if (rank < size - 1) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    int one = 1;
+    ::setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = INADDR_ANY;
+    addr.sin_port = htons((uint16_t)(base_port + rank));
+    if (::bind(listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+        ::listen(listen_fd, size) != 0) {
+      std::fprintf(stderr, "tpucomm r%d: cannot listen on port %d: %s\n",
+                   rank, base_port + rank, std::strerror(errno));
+      delete c;
+      return 0;
+    }
+  }
+
+  /* dial every lower rank (with retries while they come up) */
+  for (int peer = 0; peer < rank; peer++) {
+    int fd = -1;
+    for (int attempt = 0; attempt < 600; attempt++) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons((uint16_t)(base_port + peer));
+      ::inet_pton(AF_INET, host_list[peer].c_str(), &addr.sin_addr);
+      if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) break;
+      ::close(fd);
+      fd = -1;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+    if (fd < 0) {
+      std::fprintf(stderr, "tpucomm r%d: cannot reach rank %d (%s:%d)\n",
+                   rank, peer, host_list[peer].c_str(), base_port + peer);
+      delete c;
+      return 0;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int32_t my_rank = rank;
+    if (write_all(fd, &my_rank, sizeof(my_rank))) {
+      delete c;
+      return 0;
+    }
+    c->socks[peer] = fd;
+  }
+
+  /* accept every higher rank */
+  for (int expected = rank + 1; expected < size; expected++) {
+    int fd = ::accept(listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      std::fprintf(stderr, "tpucomm r%d: accept failed: %s\n", rank,
+                   std::strerror(errno));
+      delete c;
+      return 0;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    int32_t peer_rank = -1;
+    if (read_all(fd, &peer_rank, sizeof(peer_rank)) || peer_rank <= rank ||
+        peer_rank >= size || c->socks[peer_rank] != -1) {
+      std::fprintf(stderr, "tpucomm r%d: bad handshake (peer said %d)\n",
+                   rank, peer_rank);
+      delete c;
+      return 0;
+    }
+    c->socks[peer_rank] = fd;
+  }
+  if (listen_fd >= 0) ::close(listen_fd);
+
+  std::lock_guard<std::mutex> lock(g_comms_mu);
+  int64_t h = g_next_handle++;
+  g_comms[h] = c;
+  return h;
+}
+
+void tpucomm_finalize(int64_t h) {
+  std::lock_guard<std::mutex> lock(g_comms_mu);
+  auto it = g_comms.find(h);
+  if (it == g_comms.end()) return;
+  for (int fd : it->second->socks)
+    if (fd >= 0) ::close(fd);
+  delete it->second;
+  g_comms.erase(it);
+}
+
+int tpucomm_rank(int64_t h) {
+  Comm* c = get_comm(h);
+  return c ? c->rank : -1;
+}
+
+int tpucomm_size(int64_t h) {
+  Comm* c = get_comm(h);
+  return c ? c->size : -1;
+}
+
+int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
+                 int tag) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Send",
+               "to " + std::to_string(dest) + " (" + std::to_string(nbytes) +
+                   " bytes, tag " + std::to_string(tag) + ")");
+  return send_msg(c, dest, tag, buf, nbytes);
+}
+
+int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Recv",
+               "from " + std::to_string(source) + " (" +
+                   std::to_string(nbytes) + " bytes, tag " +
+                   std::to_string(tag) + ")");
+  return recv_msg(c, source, tag, buf, nbytes);
+}
+
+int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
+                     int dest, void* recvbuf, int64_t recv_nbytes, int source,
+                     int tag) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Sendrecv",
+               "to " + std::to_string(dest) + " from " +
+                   std::to_string(source));
+  /* concurrent send thread avoids head-of-line deadlock for large
+   * payloads when both directions target the same pair */
+  int send_rc = 0;
+  std::thread sender([&] { send_rc = send_msg(c, dest, tag, sendbuf,
+                                              send_nbytes); });
+  int recv_rc = recv_msg(c, source, tag, recvbuf, recv_nbytes);
+  sender.join();
+  return send_rc || recv_rc;
+}
+
+int tpucomm_barrier(int64_t h) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Barrier", "");
+  /* dissemination barrier: log2(size) rounds of token exchange */
+  uint8_t token = 1;
+  for (int dist = 1; dist < c->size; dist *= 2) {
+    int dest = (c->rank + dist) % c->size;
+    int src = (c->rank - dist + c->size) % c->size;
+    uint8_t got = 0;
+    int send_rc = 0;
+    std::thread sender(
+        [&] { send_rc = send_msg(c, dest, kCollectiveTag, &token, 1); });
+    int recv_rc = recv_msg(c, src, kCollectiveTag, &got, 1);
+    sender.join();
+    if (send_rc || recv_rc) return 1;
+  }
+  return 0;
+}
+
+int tpucomm_bcast(int64_t h, void* buf, int64_t nbytes, int root) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Bcast", std::to_string(nbytes) + " bytes, root " +
+                                     std::to_string(root));
+  return bcast_internal(c, buf, nbytes, root);
+}
+
+int tpucomm_gather(int64_t h, const void* sendbuf, int64_t nbytes,
+                   void* recvbuf, int root) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Gather", std::to_string(nbytes) + " bytes, root " +
+                                      std::to_string(root));
+  if (c->rank == root) {
+    char* out = static_cast<char*>(recvbuf);
+    std::memcpy(out + (int64_t)root * nbytes, sendbuf, nbytes);
+    for (int r = 0; r < c->size; r++) {
+      if (r == root) continue;
+      if (recv_msg(c, r, kCollectiveTag, out + (int64_t)r * nbytes, nbytes))
+        return 1;
+    }
+    return 0;
+  }
+  return send_msg(c, root, kCollectiveTag, sendbuf, nbytes);
+}
+
+int tpucomm_scatter(int64_t h, const void* sendbuf, void* recvbuf,
+                    int64_t nbytes, int root) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Scatter", std::to_string(nbytes) + " bytes, root " +
+                                       std::to_string(root));
+  if (c->rank == root) {
+    const char* in = static_cast<const char*>(sendbuf);
+    std::memcpy(recvbuf, in + (int64_t)root * nbytes, nbytes);
+    for (int r = 0; r < c->size; r++) {
+      if (r == root) continue;
+      if (send_msg(c, r, kCollectiveTag, in + (int64_t)r * nbytes, nbytes))
+        return 1;
+    }
+    return 0;
+  }
+  return recv_msg(c, root, kCollectiveTag, recvbuf, nbytes);
+}
+
+int tpucomm_allgather(int64_t h, const void* sendbuf, int64_t nbytes,
+                      void* recvbuf) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Allgather", std::to_string(nbytes) + " bytes");
+  /* ring: size-1 rounds, each forwarding the chunk received last round */
+  char* out = static_cast<char*>(recvbuf);
+  std::memcpy(out + (int64_t)c->rank * nbytes, sendbuf, nbytes);
+  int next = (c->rank + 1) % c->size;
+  int prev = (c->rank - 1 + c->size) % c->size;
+  if (c->size == 1) return 0;
+  for (int round = 0; round < c->size - 1; round++) {
+    int send_block = (c->rank - round + c->size) % c->size;
+    int recv_block = (c->rank - round - 1 + c->size) % c->size;
+    int send_rc = 0;
+    std::thread sender([&] {
+      send_rc = send_msg(c, next, kCollectiveTag,
+                         out + (int64_t)send_block * nbytes, nbytes);
+    });
+    int recv_rc = recv_msg(c, prev, kCollectiveTag,
+                           out + (int64_t)recv_block * nbytes, nbytes);
+    sender.join();
+    if (send_rc || recv_rc) return 1;
+  }
+  return 0;
+}
+
+int tpucomm_alltoall(int64_t h, const void* sendbuf, void* recvbuf,
+                     int64_t chunk) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Alltoall", std::to_string(chunk) + " bytes/chunk");
+  const char* in = static_cast<const char*>(sendbuf);
+  char* out = static_cast<char*>(recvbuf);
+  std::memcpy(out + (int64_t)c->rank * chunk, in + (int64_t)c->rank * chunk,
+              chunk);
+  /* size-1 rounds of pairwise exchange with rotating partners */
+  for (int round = 1; round < c->size; round++) {
+    int dest = (c->rank + round) % c->size;
+    int src = (c->rank - round + c->size) % c->size;
+    int send_rc = 0;
+    std::thread sender([&] {
+      send_rc = send_msg(c, dest, kCollectiveTag,
+                         in + (int64_t)dest * chunk, chunk);
+    });
+    int recv_rc =
+        recv_msg(c, src, kCollectiveTag, out + (int64_t)src * chunk, chunk);
+    sender.join();
+    if (send_rc || recv_rc) return 1;
+  }
+  return 0;
+}
+
+int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
+                      int64_t count, int dtype, int op) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Allreduce",
+               std::to_string(count) + " elems dtype " +
+                   std::to_string(dtype) + " op " + std::to_string(op));
+  int64_t esize = dtype_size(dtype);
+  if (esize == 0) FAIL(c, "bad dtype %d", dtype);
+  int64_t nbytes = count * esize;
+  std::memcpy(recvbuf, sendbuf, nbytes);
+  if (c->size == 1) return 0;
+  /* reduce along a chain to rank size-1, then bcast back.  O(size) latency
+   * but strictly ordered and simple; ring-reduce-scatter+allgather is the
+   * planned optimization for large payloads. */
+  std::vector<char> tmp(nbytes);
+  if (c->rank > 0) {
+    if (recv_msg(c, c->rank - 1, kCollectiveTag, tmp.data(), nbytes))
+      return 1;
+    if (combine(recvbuf, tmp.data(), count, dtype, op, c)) return 1;
+  }
+  if (c->rank < c->size - 1) {
+    if (send_msg(c, c->rank + 1, kCollectiveTag, recvbuf, nbytes)) return 1;
+  }
+  return bcast_internal(c, recvbuf, nbytes, c->size - 1);
+}
+
+int tpucomm_reduce(int64_t h, const void* sendbuf, void* recvbuf,
+                   int64_t count, int dtype, int op, int root) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Reduce", std::to_string(count) + " elems, root " +
+                                      std::to_string(root));
+  int64_t esize = dtype_size(dtype);
+  if (esize == 0) FAIL(c, "bad dtype %d", dtype);
+  int64_t nbytes = count * esize;
+  /* chain-reduce into root's copy: gather at root, combining in rank order
+   * for deterministic results */
+  if (c->rank == root) {
+    std::memcpy(recvbuf, sendbuf, nbytes);
+    std::vector<char> tmp(nbytes);
+    for (int r = 0; r < c->size; r++) {
+      if (r == root) continue;
+      if (recv_msg(c, r, kCollectiveTag, tmp.data(), nbytes)) return 1;
+      if (combine(recvbuf, tmp.data(), count, dtype, op, c)) return 1;
+    }
+    return 0;
+  }
+  std::memcpy(recvbuf, sendbuf, nbytes);
+  return send_msg(c, root, kCollectiveTag, sendbuf, nbytes);
+}
+
+int tpucomm_scan(int64_t h, const void* sendbuf, void* recvbuf,
+                 int64_t count, int dtype, int op) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Scan", std::to_string(count) + " elems");
+  int64_t esize = dtype_size(dtype);
+  if (esize == 0) FAIL(c, "bad dtype %d", dtype);
+  int64_t nbytes = count * esize;
+  std::memcpy(recvbuf, sendbuf, nbytes);
+  /* inclusive prefix along the rank chain */
+  if (c->rank > 0) {
+    std::vector<char> tmp(nbytes);
+    if (recv_msg(c, c->rank - 1, kCollectiveTag, tmp.data(), nbytes))
+      return 1;
+    /* combine(prefix_of_below, mine): order matters for non-commutative
+     * semantics; we fold below-prefix into our accumulator on the left */
+    std::vector<char> mine(nbytes);
+    std::memcpy(mine.data(), recvbuf, nbytes);
+    std::memcpy(recvbuf, tmp.data(), nbytes);
+    if (combine(recvbuf, mine.data(), count, dtype, op, c)) return 1;
+  }
+  if (c->rank < c->size - 1) {
+    if (send_msg(c, c->rank + 1, kCollectiveTag, recvbuf, nbytes)) return 1;
+  }
+  return 0;
+}
+
+}  /* extern "C" */

@@ -1,0 +1,70 @@
+/* tpucomm — host-side communication runtime for mpi4jax_tpu's world tier.
+ *
+ * The native substrate replacing libmpi in the reference stack (see
+ * SURVEY.md §2.3: mpi_xla_bridge.pyx wraps libmpi; this library *is* the
+ * message layer): a TCP mesh between one process per rank, with the twelve
+ * MPI-style operations implemented over framed point-to-point messages.
+ *
+ * All functions return 0 on success, nonzero on failure after printing a
+ * diagnostic to stderr (fail-fast contract; callers abort the process —
+ * the analog of MPI_Abort in the reference's abort_on_error).
+ *
+ * Dtype codes match mpi4jax_tpu/utils/dtypes.py; op codes match
+ * mpi4jax_tpu/ops/reduce_ops.py order.
+ */
+#ifndef TPUCOMM_H
+#define TPUCOMM_H
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+/* dtype wire codes (keep in sync with utils/dtypes.py) */
+enum TpuDtype {
+  TPU_BOOL = 0, TPU_I8, TPU_I16, TPU_I32, TPU_I64,
+  TPU_U8, TPU_U16, TPU_U32, TPU_U64,
+  TPU_F16, TPU_BF16, TPU_F32, TPU_F64, TPU_C64, TPU_C128,
+};
+
+/* reduce op codes */
+enum TpuOp {
+  TPU_SUM = 0, TPU_PROD, TPU_MAX, TPU_MIN,
+  TPU_LAND, TPU_LOR, TPU_LXOR, TPU_BAND, TPU_BOR, TPU_BXOR,
+};
+
+/* Create a communicator: rank/size, base TCP port, comma-separated host
+ * list ("" = all localhost). Returns handle > 0, or 0 on failure. */
+int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts);
+void tpucomm_finalize(int64_t h);
+
+int tpucomm_rank(int64_t h);
+int tpucomm_size(int64_t h);
+void tpucomm_set_logging(int enabled);
+
+int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
+                 int tag);
+int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag);
+int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
+                     int dest, void* recvbuf, int64_t recv_nbytes,
+                     int source, int tag);
+int tpucomm_barrier(int64_t h);
+int tpucomm_bcast(int64_t h, void* buf, int64_t nbytes, int root);
+int tpucomm_gather(int64_t h, const void* sendbuf, int64_t nbytes,
+                   void* recvbuf /* size*nbytes, root only */, int root);
+int tpucomm_scatter(int64_t h, const void* sendbuf /* size*nbytes, root */,
+                    void* recvbuf, int64_t nbytes, int root);
+int tpucomm_allgather(int64_t h, const void* sendbuf, int64_t nbytes,
+                      void* recvbuf /* size*nbytes */);
+int tpucomm_alltoall(int64_t h, const void* sendbuf /* size*chunk */,
+                     void* recvbuf /* size*chunk */, int64_t chunk_nbytes);
+int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
+                      int64_t count, int dtype, int op);
+int tpucomm_reduce(int64_t h, const void* sendbuf, void* recvbuf,
+                   int64_t count, int dtype, int op, int root);
+int tpucomm_scan(int64_t h, const void* sendbuf, void* recvbuf,
+                 int64_t count, int dtype, int op);
+
+}  /* extern "C" */
+
+#endif  /* TPUCOMM_H */
