@@ -1,71 +1,449 @@
-"""World-tier op implementations (multi-process, native transport).
+"""World-tier op implementations: JAX primitives over the native transport.
 
-Each op here is a JAX primitive carrying an ordered effect
-(utils/effects.py), lowered to a custom call / host callback into the native
-C++ transport — the structural twin of the reference's Cython bridge stack
-(/root/reference/mpi4jax/_src/xla_bridge/).
+This is the ordered-effects core design the reference's experimental notoken
+layer pioneered (SURVEY.md §2.2, notoken/collective_ops/allreduce.py:94-187
+there) promoted to first-class, on jax 0.9 APIs: every op is a JAX primitive
+that
 
-Status: primitives land with the native transport (native/); until then every
-entry raises with guidance so the mesh tier (the TPU fast path) is never
-blocked on it.
+- declares the framework's ordered ``CommEffect`` (utils/effects.py) in its
+  abstract eval — the compiler threads a runtime token through all world ops
+  in program order, which *is* the deadlock-freedom contract
+  (docs/sharp-bits.rst of the reference);
+- lowers to a host callback via ``emit_python_callback`` with explicit
+  ``ctx.tokens_in``/``set_tokens_out`` plumbing — on TPU this callback is
+  the HBM→TPU-VM-host staging path over DCN, the structural twin of the
+  reference GPU bridge's sync → copy-to-host → MPI → copy-back
+  (mpi_xla_bridge_gpu.pyx:233-251);
+- executes the native C++ transport (runtime/bridge.py → native/tpucomm.cc)
+  on the host buffers;
+- carries reference-parity AD rules registered directly on the primitive:
+  allreduce(SUM) JVP + identity transpose (allreduce.py:188-218 there),
+  sendrecv JVP + source/dest-swapping transpose (sendrecv.py:390-409), and
+  elementwise batching where semantics allow.
 """
 
 from __future__ import annotations
 
-_MSG = (
-    "the world tier (one process per rank over the native transport) for "
-    "'{op}' is not built in this checkout stage; use the mesh tier "
-    "(mpi4jax_tpu.spmd over a device Mesh) instead"
-)
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax._src import core
+from jax._src import callback as _jax_callback
+from jax._src import dispatch as _jax_dispatch
+from jax._src.interpreters import mlir
+from jax.interpreters import ad, batching
+
+from ..utils import tracing
+from ..utils.effects import comm_effect
+from .reduce_ops import ALL_OPS, ReduceOp
+
+_OP_CODE = {op.name: i for i, op in enumerate(ALL_OPS)}
 
 
-def _todo(op):
-    raise NotImplementedError(_MSG.format(op=op))
+def _contig(x) -> np.ndarray:
+    # NB: np.ascontiguousarray promotes 0-d to 1-d; np.asarray + explicit
+    # copy preserves shape
+    a = np.asarray(x)
+    return a if a.flags.c_contiguous else a.copy(order="C")
 
 
-def allreduce(x, op, comm):
-    _todo("allreduce")
+def _np(x, aval):
+    return _contig(np.asarray(x, dtype=aval.dtype))
 
 
-def allgather(x, comm):
-    _todo("allgather")
+def _make_primitive(name, out_aval_fn, host_fn):
+    """A world-tier primitive: ordered effect + host-callback lowering.
+
+    ``host_fn(*np_args, **params) -> np.ndarray`` runs on the host;
+    ``out_aval_fn(*avals, **params) -> ShapedArray`` declares the result.
+    """
+    p = core.Primitive(f"mpi4jax_tpu_{name}")
+    p.def_impl(partial(_jax_dispatch.apply_primitive, p))
+
+    def abstract_eval(*avals, **params):
+        return out_aval_fn(*avals, **params), {comm_effect}
+
+    p.def_effectful_abstract_eval(abstract_eval)
+
+    def lowering(ctx, *args, **params):
+        out_aval = ctx.avals_out[0]
+
+        def _callback(*flat):
+            result = host_fn(
+                *[_np(a, av) for a, av in zip(flat, ctx.avals_in)], **params
+            )
+            return (_contig(np.asarray(result, dtype=out_aval.dtype)),)
+
+        token = ctx.tokens_in.get(comm_effect)
+        results, token, _ = _jax_callback.emit_python_callback(
+            ctx,
+            _callback,
+            token,
+            list(args),
+            ctx.avals_in,
+            ctx.avals_out,
+            has_side_effect=True,
+            returns_token=True,
+        )
+        ctx.set_tokens_out(mlir.TokenSet({comm_effect: token}))
+        return results
+
+    mlir.register_lowering(p, lowering)
+    return p
 
 
-def alltoall(x, comm):
-    _todo("alltoall")
+def _same_aval(x_aval, **params):
+    return core.ShapedArray(x_aval.shape, x_aval.dtype)
 
 
-def barrier(comm, token):
-    _todo("barrier")
+def _scalar_aval(*avals, **params):
+    return core.ShapedArray((), np.dtype(np.int32))
+
+
+def _elementwise_batching(p):
+    def rule(batched_args, batch_dims, **params):
+        (x,), (bd,) = batched_args, batch_dims
+        return p.bind(x, **params), bd
+
+    batching.primitive_batchers[p] = rule
+
+
+# ---------------- host-side executors ----------------
+
+
+def _host_allreduce(x, *, comm, op):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Allreduce", f"op {op.name}"):
+        return bridge.allreduce(comm.handle, x, _OP_CODE[op.name])
+
+
+def _host_reduce(x, *, comm, op, root):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Reduce", f"op {op.name} root {root}"):
+        return bridge.reduce(comm.handle, x, _OP_CODE[op.name], root)
+
+
+def _host_scan(x, *, comm, op):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Scan", f"op {op.name}"):
+        return bridge.scan(comm.handle, x, _OP_CODE[op.name])
+
+
+def _host_bcast(x, *, comm, root):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Bcast", f"root {root}"):
+        return bridge.bcast(comm.handle, x, root)
+
+
+def _host_allgather(x, *, comm):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Allgather", ""):
+        return bridge.allgather(comm.handle, x, comm.size())
+
+
+def _host_gather(x, *, comm, root):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Gather", f"root {root}"):
+        return bridge.gather(comm.handle, x, comm.size(), root, comm.rank())
+
+
+def _host_scatter(x, *, comm, root):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Scatter", f"root {root}"):
+        return bridge.scatter(comm.handle, x, root)
+
+
+def _host_alltoall(x, *, comm):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Alltoall", ""):
+        return bridge.alltoall(comm.handle, x)
+
+
+def _host_barrier(*, comm):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Barrier", ""):
+        bridge.barrier(comm.handle)
+    return np.zeros((), np.int32)
+
+
+def _host_send(x, *, comm, dest, tag):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Send", f"to {dest} tag {tag}"):
+        bridge.send(comm.handle, x, dest, tag)
+    return np.zeros((), np.int32)
+
+
+def _host_recv(x, *, comm, source, tag):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Recv", f"from {source} tag {tag}"):
+        return bridge.recv(comm.handle, x.shape, x.dtype, source, tag)
+
+
+def _host_sendrecv(x, *, comm, source, dest, tag):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(
+        comm.rank(), "Sendrecv", f"to {dest} from {source}"
+    ):
+        return bridge.sendrecv(
+            comm.handle, x, x.shape, x.dtype, source, dest, tag
+        )
+
+
+# ---------------- primitives ----------------
+
+# allreduce carries a `transpose` flag (reference allreduce.py:80-89,215-217):
+# the transposed pass lowers to identity — cotangents of the replicated
+# output pass through per rank — and transposing it again flips the flag
+# back, so double-transpose ≡ allreduce.  Built by hand (not the factory)
+# because the transposed pass carries no effect and no callback.
+allreduce_p = core.Primitive("mpi4jax_tpu_allreduce")
+allreduce_p.def_impl(partial(_jax_dispatch.apply_primitive, allreduce_p))
+
+
+def _allreduce_abstract_eval(x_aval, *, comm, op, transpose=False):
+    effects = set() if transpose else {comm_effect}
+    return core.ShapedArray(x_aval.shape, x_aval.dtype), effects
+
+
+allreduce_p.def_effectful_abstract_eval(_allreduce_abstract_eval)
+
+
+def _allreduce_lowering(ctx, x, *, comm, op, transpose=False):
+    if transpose:
+        return [x]  # identity pass, no communication
+
+    out_aval = ctx.avals_out[0]
+
+    def _callback(*flat):
+        result = _host_allreduce(
+            *[_np(a, av) for a, av in zip(flat, ctx.avals_in)],
+            comm=comm, op=op,
+        )
+        return (_contig(np.asarray(result, dtype=out_aval.dtype)),)
+
+    token = ctx.tokens_in.get(comm_effect)
+    results, token, _ = _jax_callback.emit_python_callback(
+        ctx, _callback, token, [x], ctx.avals_in, ctx.avals_out,
+        has_side_effect=True, returns_token=True,
+    )
+    ctx.set_tokens_out(mlir.TokenSet({comm_effect: token}))
+    return results
+
+
+mlir.register_lowering(allreduce_p, _allreduce_lowering)
+reduce_p = _make_primitive("reduce", _same_aval, _host_reduce)
+scan_p = _make_primitive("scan", _same_aval, _host_scan)
+bcast_p = _make_primitive("bcast", _same_aval, _host_bcast)
+alltoall_p = _make_primitive("alltoall", _same_aval, _host_alltoall)
+sendrecv_p = _make_primitive("sendrecv", _same_aval, _host_sendrecv)
+recv_p = _make_primitive("recv", _same_aval, _host_recv)
+send_p = _make_primitive("send", _scalar_aval, _host_send)
+barrier_p = _make_primitive("barrier", _scalar_aval, _host_barrier)
+
+
+def _stacked_aval(x_aval, *, comm, **params):
+    return core.ShapedArray((comm.size(),) + x_aval.shape, x_aval.dtype)
+
+
+def _unstacked_aval(x_aval, *, comm, **params):
+    return core.ShapedArray(x_aval.shape[1:], x_aval.dtype)
+
+
+allgather_p = _make_primitive("allgather", _stacked_aval, _host_allgather)
+gather_p = _make_primitive("gather", _stacked_aval, _host_gather)
+scatter_p = _make_primitive("scatter", _unstacked_aval, _host_scatter)
+
+
+# ---------------- AD rules (reference parity) ----------------
+
+
+def _allreduce_jvp(primals, tangents, *, comm, op, transpose=False):
+    # reference: JVP defined for SUM only (allreduce.py:192-195 there)
+    (x,), (t,) = primals, tangents
+    if op.name != "SUM":
+        raise NotImplementedError(
+            f"world-tier allreduce is differentiable for SUM only, got "
+            f"{op.name}"
+        )
+    primal_out = allreduce_p.bind(x, comm=comm, op=op, transpose=transpose)
+    if type(t) is ad.Zero:
+        tangent_out = ad.Zero.from_primal_value(primal_out)
+    else:
+        tangent_out = allreduce_p.bind(
+            t, comm=comm, op=op, transpose=transpose
+        )
+    return primal_out, tangent_out
+
+
+def _allreduce_transpose(ct, x, *, comm, op, transpose=False):
+    # flip the flag: transpose(allreduce) is the identity pass, and
+    # transpose of that is allreduce again (reference allreduce.py:206-218)
+    return (
+        allreduce_p.bind(ct, comm=comm, op=op, transpose=not transpose),
+    )
+
+
+ad.primitive_jvps[allreduce_p] = _allreduce_jvp
+ad.primitive_transposes[allreduce_p] = _allreduce_transpose
+
+
+def _sendrecv_jvp(primals, tangents, *, comm, source, dest, tag):
+    # improvement over the reference (which raises for fwd mode,
+    # sendrecv.py:150-155): tangents ride the same message edge
+    (x,), (t,) = primals, tangents
+    primal_out = sendrecv_p.bind(x, comm=comm, source=source, dest=dest,
+                                 tag=tag)
+    if type(t) is ad.Zero:
+        tangent_out = ad.Zero.from_primal_value(primal_out)
+    else:
+        tangent_out = sendrecv_p.bind(
+            t, comm=comm, source=source, dest=dest, tag=tag
+        )
+    return primal_out, tangent_out
+
+
+def _sendrecv_transpose(ct, x, *, comm, source, dest, tag):
+    # the cotangent flows backward along the message edge: swap source/dest
+    # (reference sendrecv.py:390-409)
+    return (
+        sendrecv_p.bind(ct, comm=comm, source=dest, dest=source, tag=tag),
+    )
+
+
+ad.primitive_jvps[sendrecv_p] = _sendrecv_jvp
+ad.primitive_transposes[sendrecv_p] = _sendrecv_transpose
+
+# batching where the op is elementwise across the batch axis (reference
+# scope: allreduce/barrier/sendrecv, allreduce.py:182-185, barrier.py:120-123,
+# sendrecv.py:316-343; bcast/reduce/scan are elementwise too and included)
+for _p in (allreduce_p, reduce_p, scan_p, bcast_p, sendrecv_p):
+    _elementwise_batching(_p)
+
+
+# ---------------- public entry points (called from op modules) -----------
+
+
+def allreduce(x, op: ReduceOp, comm):
+    op.check_dtype(jnp.result_type(x))
+    return allreduce_p.bind(jnp.asarray(x), comm=comm, op=op,
+                            transpose=False)
+
+
+def reduce(x, op: ReduceOp, root, comm):
+    op.check_dtype(jnp.result_type(x))
+    return reduce_p.bind(jnp.asarray(x), comm=comm, op=op, root=root)
+
+
+def scan(x, op: ReduceOp, comm):
+    op.check_dtype(jnp.result_type(x))
+    return scan_p.bind(jnp.asarray(x), comm=comm, op=op)
 
 
 def bcast(x, root, comm):
-    _todo("bcast")
+    return bcast_p.bind(jnp.asarray(x), comm=comm, root=root)
 
 
-def reduce(x, op, root, comm):
-    _todo("reduce")
+def allgather(x, comm):
+    return allgather_p.bind(jnp.asarray(x), comm=comm)
 
 
 def gather(x, root, comm):
-    _todo("gather")
+    return gather_p.bind(jnp.asarray(x), comm=comm, root=root)
 
 
 def scatter(x, root, comm):
-    _todo("scatter")
+    x = jnp.asarray(x)
+    if x.ndim < 1 or x.shape[0] != comm.size():
+        raise ValueError(
+            f"scatter requires input shape (size, ...) = ({comm.size()}, "
+            f"...), got {x.shape}"
+        )
+    return scatter_p.bind(x, comm=comm, root=root)
 
 
-def scan(x, op, comm):
-    _todo("scan")
+def alltoall(x, comm):
+    x = jnp.asarray(x)
+    if x.ndim < 1 or x.shape[0] != comm.size():
+        raise ValueError(
+            f"alltoall requires leading axis == communicator size "
+            f"({comm.size()}), got shape {x.shape}"
+        )
+    return alltoall_p.bind(x, comm=comm)
+
+
+def barrier(comm, token):
+    del token  # ordering comes from the ordered effect
+    return barrier_p.bind(comm=comm)
 
 
 def send(x, dest, tag, comm, token):
-    _todo("send")
+    done = send_p.bind(jnp.asarray(x), comm=comm, dest=dest, tag=tag)
+    if token is not None:
+        from . import _dispatch
+
+        return _dispatch.token_out(token, done)
+    return None
 
 
 def recv(x, source, tag, comm, token):
-    _todo("recv")
+    result = recv_p.bind(jnp.asarray(x), comm=comm, source=source, tag=tag)
+    if token is not None:
+        from . import _dispatch
+
+        return result, _dispatch.token_out(token, result)
+    return result
 
 
-def sendrecv_dispatch(x, *, perm, shift, wrap, comm, token):
-    _todo("sendrecv")
+def sendrecv_dispatch(x, *, perm, shift, wrap, comm, token,
+                      source=None, dest=None, tag=0):
+    """World-tier sendrecv: per-rank explicit source/dest (reference style).
+
+    Accepts explicit ``source``/``dest`` ints, or the mesh-tier
+    ``perm``/``shift`` conveniences resolved against this process's rank.
+    """
+    rank, size = comm.rank(), comm.size()
+    if source is None or dest is None:
+        if shift is not None:
+            dest = (rank + shift) % size if wrap else rank + shift
+            source = (rank - shift) % size if wrap else rank - shift
+            if not (0 <= dest < size) or not (0 <= source < size):
+                raise ValueError(
+                    "shift moves past the edge with wrap=False; world-tier "
+                    "sendrecv needs a valid partner on every rank — use "
+                    "send/recv for edge ranks"
+                )
+        elif perm is not None:
+            src_map = {d: s for s, d in perm}
+            dst_map = {s: d for s, d in perm}
+            if rank not in src_map or rank not in dst_map:
+                raise ValueError(
+                    f"perm must cover rank {rank} as both source and dest "
+                    "on the world tier; use send/recv for one-sided edges"
+                )
+            source, dest = src_map[rank], dst_map[rank]
+        else:
+            raise ValueError("pass source/dest, perm=, or shift=")
+
+    result = sendrecv_p.bind(
+        jnp.asarray(x), comm=comm, source=source, dest=dest, tag=tag
+    )
+    if token is not None:
+        from . import _dispatch
+
+        return result, _dispatch.token_out(token, result)
+    return result

@@ -1,0 +1,229 @@
+"""ctypes bridge to the native tpucomm transport (L3/L4 of the stack).
+
+The reference's registration layer imports Cython extensions and registers
+XLA custom-call targets (/root/reference/mpi4jax/_src/xla_bridge/__init__.py).
+Here the native library is loaded with ctypes and invoked from *ordered host
+callbacks* — on TPU that callback IS the HBM→host staging path (the
+structural twin of the reference GPU bridge's
+cudaMemcpy-to-host → MPI → copy-back sequence,
+mpi_xla_bridge_gpu.pyx:233-251), with XLA managing the device↔host
+transfers.
+
+Fail-fast: a nonzero return from any native call prints
+``tpucomm_<Op> returned error code N`` and hard-exits the process (the
+analog of the reference's abort_on_error → MPI_Abort,
+mpi_xla_bridge.pyx:67-91); peers then fail on their sockets and exit too.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+from typing import Optional
+
+import numpy as np
+
+from ..utils import config, dtypes as _dtypes
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SO_PATH = os.path.join(os.path.dirname(__file__), "_native", "libtpucomm.so")
+_SRC = os.path.join(_REPO_ROOT, "native", "tpucomm.cc")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> None:
+    os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread", "-shared",
+        "-o", _SO_PATH, _SRC,
+    ]
+    subprocess.run(cmd, check=True)
+
+
+def get_lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO_PATH):
+        if not os.path.exists(_SRC):
+            raise RuntimeError(
+                f"native transport missing: no {_SO_PATH} and no source at "
+                f"{_SRC} to build it from"
+            )
+        _build()
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.tpucomm_init.restype = ctypes.c_int64
+    lib.tpucomm_init.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+    ]
+    lib.tpucomm_set_logging.argtypes = [ctypes.c_int]
+    if config.debug_enabled():
+        lib.tpucomm_set_logging(1)
+    _lib = lib
+    return lib
+
+
+def set_native_logging(enabled: bool) -> None:
+    get_lib().tpucomm_set_logging(1 if enabled else 0)
+
+
+def _abort(opname: str, rc: int):
+    print(
+        f"tpucomm_{opname} returned error code {rc}", file=sys.stderr,
+        flush=True,
+    )
+    # fail-fast across the job: peers will observe dead sockets and abort
+    os._exit(1)
+
+
+def _check(opname: str, rc: int):
+    if rc != 0:
+        _abort(opname, rc)
+
+
+def comm_init(rank: int, size: int, coord: str) -> int:
+    lib = get_lib()
+    host, _, port = coord.partition(":")
+    hosts = os.environ.get("MPI4JAX_TPU_HOSTS", "")
+    handle = lib.tpucomm_init(
+        rank, size, int(port or 49817), hosts.encode()
+    )
+    if handle == 0:
+        _abort("init", 1)
+    return handle
+
+
+def _contig(a) -> np.ndarray:
+    # NB: np.ascontiguousarray promotes 0-d arrays to 1-d; preserve shape
+    a = np.asarray(a)
+    return a if a.flags.c_contiguous else a.copy(order="C")
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def _i64(v) -> ctypes.c_int64:
+    return ctypes.c_int64(int(v))
+
+
+# Every function below takes/returns contiguous numpy arrays.
+
+def send(handle, buf: np.ndarray, dest: int, tag: int):
+    buf = _contig(buf)
+    rc = get_lib().tpucomm_send(
+        _i64(handle), _ptr(buf), _i64(buf.nbytes), dest, tag
+    )
+    _check("Send", rc)
+
+
+def recv(handle, shape, dtype, source: int, tag: int) -> np.ndarray:
+    out = np.empty(shape, dtype)
+    rc = get_lib().tpucomm_recv(
+        _i64(handle), _ptr(out), _i64(out.nbytes), source, tag
+    )
+    _check("Recv", rc)
+    return out
+
+
+def sendrecv(handle, sendbuf, recv_shape, recv_dtype, source, dest, tag):
+    sendbuf = _contig(sendbuf)
+    out = np.empty(recv_shape, recv_dtype)
+    rc = get_lib().tpucomm_sendrecv(
+        _i64(handle), _ptr(sendbuf), _i64(sendbuf.nbytes), dest,
+        _ptr(out), _i64(out.nbytes), source, tag,
+    )
+    _check("Sendrecv", rc)
+    return out
+
+
+def barrier(handle):
+    _check("Barrier", get_lib().tpucomm_barrier(_i64(handle)))
+
+
+def bcast(handle, buf, root) -> np.ndarray:
+    out = _contig(buf).copy()
+    rc = get_lib().tpucomm_bcast(_i64(handle), _ptr(out), _i64(out.nbytes), root)
+    _check("Bcast", rc)
+    return out
+
+
+def allreduce(handle, buf, op_code: int) -> np.ndarray:
+    buf = _contig(buf)
+    out = np.empty_like(buf)
+    rc = get_lib().tpucomm_allreduce(
+        _i64(handle), _ptr(buf), _ptr(out), _i64(buf.size),
+        _dtypes.wire_code(buf.dtype), op_code,
+    )
+    _check("Allreduce", rc)
+    return out
+
+
+def reduce(handle, buf, op_code: int, root: int) -> np.ndarray:
+    buf = _contig(buf)
+    out = np.empty_like(buf)
+    rc = get_lib().tpucomm_reduce(
+        _i64(handle), _ptr(buf), _ptr(out), _i64(buf.size),
+        _dtypes.wire_code(buf.dtype), op_code, root,
+    )
+    _check("Reduce", rc)
+    return out
+
+
+def scan(handle, buf, op_code: int) -> np.ndarray:
+    buf = _contig(buf)
+    out = np.empty_like(buf)
+    rc = get_lib().tpucomm_scan(
+        _i64(handle), _ptr(buf), _ptr(out), _i64(buf.size),
+        _dtypes.wire_code(buf.dtype), op_code,
+    )
+    _check("Scan", rc)
+    return out
+
+
+def allgather(handle, buf, size: int) -> np.ndarray:
+    buf = _contig(buf)
+    out = np.empty((size,) + buf.shape, buf.dtype)
+    rc = get_lib().tpucomm_allgather(
+        _i64(handle), _ptr(buf), _i64(buf.nbytes), _ptr(out)
+    )
+    _check("Allgather", rc)
+    return out
+
+
+def gather(handle, buf, size: int, root: int, rank: int) -> np.ndarray:
+    buf = _contig(buf)
+    # uniform output on all ranks; only root's is meaningful
+    out = np.zeros((size,) + buf.shape, buf.dtype)
+    rc = get_lib().tpucomm_gather(
+        _i64(handle), _ptr(buf), _i64(buf.nbytes), _ptr(out), root
+    )
+    _check("Gather", rc)
+    return out
+
+
+def scatter(handle, buf, root: int) -> np.ndarray:
+    buf = _contig(buf)
+    out = np.empty(buf.shape[1:], buf.dtype)
+    rc = get_lib().tpucomm_scatter(
+        _i64(handle), _ptr(buf), _ptr(out), _i64(out.nbytes), root
+    )
+    _check("Scatter", rc)
+    return out
+
+
+def alltoall(handle, buf) -> np.ndarray:
+    buf = _contig(buf)
+    out = np.empty_like(buf)
+    chunk = buf.nbytes // buf.shape[0]
+    rc = get_lib().tpucomm_alltoall(
+        _i64(handle), _ptr(buf), _ptr(out), _i64(chunk)
+    )
+    _check("Alltoall", rc)
+    return out

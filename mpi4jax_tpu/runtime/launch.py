@@ -1,0 +1,86 @@
+"""Process launcher for world-tier (multi-process) jobs.
+
+The reference has no launcher — users run ``mpirun -n N python prog.py``
+(README.rst there).  This framework ships its own:
+
+    python -m mpi4jax_tpu.runtime.launch -n 4 prog.py [args...]
+
+Each rank becomes one process with ``MPI4JAX_TPU_RANK``/``SIZE``/``COORD``
+set; ``get_default_comm()`` then returns the :class:`WorldComm`.  Fail-fast:
+if any rank exits nonzero, the rest are terminated and the launcher exits
+with that code (the job-teardown role MPI_Abort plays in the reference).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.runtime.launch",
+        description="run a program as N world-tier ranks",
+    )
+    parser.add_argument("-n", "--np", type=int, required=True,
+                        help="number of ranks")
+    parser.add_argument("--port", type=int, default=None,
+                        help="base TCP port (default: derived from pid)")
+    parser.add_argument("--platform", default=None,
+                        help="JAX_PLATFORMS for the ranks (default: cpu)")
+    parser.add_argument("prog", help="python program to run")
+    parser.add_argument("args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    base_port = args.port or (40000 + os.getpid() % 20000)
+    procs = []
+    for rank in range(args.np):
+        env = dict(os.environ)
+        env["MPI4JAX_TPU_RANK"] = str(rank)
+        env["MPI4JAX_TPU_SIZE"] = str(args.np)
+        env["MPI4JAX_TPU_COORD"] = f"127.0.0.1:{base_port}"
+        if args.platform:
+            env["JAX_PLATFORMS"] = args.platform
+        else:
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, args.prog, *args.args], env=env
+            )
+        )
+
+    exit_code = 0
+    try:
+        while procs:
+            for p in list(procs):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                procs.remove(p)
+                if rc != 0:
+                    exit_code = rc
+                    # fail-fast: take the rest of the job down
+                    for q in procs:
+                        q.terminate()
+                    deadline = time.time() + 5
+                    for q in procs:
+                        try:
+                            q.wait(timeout=max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                    procs.clear()
+                    break
+            time.sleep(0.02)
+    except KeyboardInterrupt:
+        for q in procs:
+            q.send_signal(signal.SIGINT)
+        exit_code = 130
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

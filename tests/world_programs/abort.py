@@ -1,0 +1,33 @@
+"""Fail-fast behavior: one rank dies; the peer's pending recv must abort
+the process with the transport error message instead of hanging (reference:
+abort-on-error subprocess test, test_common.py:59-87 there)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4j
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank = comm.rank()
+    # establish the mesh before rank 0 bails (init needs all ranks)
+    m4j.barrier(comm=comm)
+    if rank == 0:
+        # "clean" early exit (code 0 so the launcher doesn't reap the peer
+        # first): the peer's pending recv must then fail on the dead socket
+        os._exit(0)
+    m4j.recv(jnp.zeros((1,), jnp.float32), source=0, comm=comm)
+    print("UNREACHABLE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Per-rank correctness program for world-tier ops (run under the launcher).
+
+Mirrors the reference's per-op identity tests (SURVEY.md §4.2) in the
+one-process-per-rank execution model.  Any assertion failure exits nonzero,
+which the launcher converts into a failed job.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size >= 2, "run under the launcher with -n >= 2"
+
+    x = jnp.arange(4, dtype=jnp.float32) + rank
+
+    # allreduce: eager + jit
+    expected_sum = np.arange(4) * size + sum(range(size))
+    out = m4j.allreduce(x, op=m4j.SUM, comm=comm)
+    np.testing.assert_allclose(np.asarray(out), expected_sum)
+    out = jax.jit(lambda v: m4j.allreduce(v, op=m4j.SUM, comm=comm))(x)
+    np.testing.assert_allclose(np.asarray(out), expected_sum)
+    # input not mutated
+    np.testing.assert_allclose(np.asarray(x), np.arange(4) + rank)
+
+    out = m4j.allreduce(x, op=m4j.MAX, comm=comm)
+    np.testing.assert_allclose(np.asarray(out), np.arange(4) + size - 1)
+
+    # allgather
+    ag = m4j.allgather(x, comm=comm)
+    assert ag.shape == (size, 4)
+    for r in range(size):
+        np.testing.assert_allclose(np.asarray(ag)[r], np.arange(4) + r)
+
+    # alltoall: row j -> rank j
+    a2a_in = jnp.asarray(
+        [[100 * rank + j] for j in range(size)], dtype=jnp.int32
+    )
+    a2a = m4j.alltoall(a2a_in, comm=comm)
+    np.testing.assert_array_equal(
+        np.asarray(a2a).ravel(), [100 * r + rank for r in range(size)]
+    )
+
+    # bcast
+    b = jnp.full((3,), float(rank), jnp.float32)
+    b = m4j.bcast(b, root=1, comm=comm)
+    np.testing.assert_allclose(np.asarray(b), 1.0)
+
+    # reduce: root gets reduction, others passthrough
+    red = m4j.reduce(x, op=m4j.SUM, root=0, comm=comm)
+    if rank == 0:
+        np.testing.assert_allclose(np.asarray(red), expected_sum)
+    else:
+        np.testing.assert_allclose(np.asarray(red), np.asarray(x))
+
+    # scan (inclusive prefix)
+    sc = m4j.scan(jnp.asarray([float(rank + 1)]), op=m4j.SUM, comm=comm)
+    np.testing.assert_allclose(
+        np.asarray(sc), [sum(range(1, rank + 2))]
+    )
+
+    # gather / scatter
+    g = m4j.gather(x, root=0, comm=comm)
+    if rank == 0:
+        for r in range(size):
+            np.testing.assert_allclose(np.asarray(g)[r], np.arange(4) + r)
+    sc_in = jnp.tile(jnp.arange(size, dtype=jnp.float32)[:, None], (1, 2))
+    mine = m4j.scatter(sc_in, root=0, comm=comm)
+    np.testing.assert_allclose(np.asarray(mine), float(rank))
+
+    # barrier
+    m4j.barrier(comm=comm)
+
+    # sendrecv ring (jit)
+    ring = jax.jit(
+        lambda v: m4j.sendrecv(v, shift=1, comm=comm)
+    )(x)
+    np.testing.assert_allclose(
+        np.asarray(ring), np.arange(4) + (rank - 1) % size
+    )
+
+    # send / recv pair (true MPMD — impossible on the mesh tier)
+    if rank == 0:
+        m4j.send(x * 2, dest=1, comm=comm)
+    elif rank == 1:
+        got = m4j.recv(jnp.zeros_like(x), source=0, comm=comm)
+        np.testing.assert_allclose(np.asarray(got), np.arange(4) * 2.0)
+
+    # ops inside lax control flow (effects must thread through scan)
+    def body(carry, _):
+        carry = m4j.allreduce(carry, op=m4j.SUM, comm=comm) / size
+        return carry, None
+
+    looped, _ = jax.jit(
+        lambda v: jax.lax.scan(body, v, None, length=3)
+    )(jnp.ones((2,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(looped), 1.0, rtol=1e-6)
+
+    print(f"rank {rank}: basic_ops OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,87 @@
+"""Strict-ordering torture tests (the reference's core value proposition).
+
+1. "Hot potato" (modeled on the reference's notoken ordering test,
+   tests/experimental/test_notoken.py:81-120 there): an asymmetric
+   send/recv script between two ranks whose numeric result is wrong under
+   ANY reordering of the communication calls.
+2. Deadlock-by-construction: send-then-recv on rank 0 vs recv-then-send on
+   rank 1 — only correct if program order is execution order
+   (test_send_and_recv.py:96-115 there).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size >= 2
+
+    zero = jnp.zeros((1,), jnp.float32)
+
+    # --- hot potato: value accumulates operations in strict sequence ----
+    @jax.jit
+    def potato_rank0(v):
+        # send v, get back 3v+1, send 2*(3v+1), get back final
+        m4j.send(v, dest=1, comm=comm)
+        v1 = m4j.recv(zero, source=1, comm=comm)
+        m4j.send(v1 * 2.0, dest=1, comm=comm)
+        v2 = m4j.recv(zero, source=1, comm=comm)
+        return v2
+
+    @jax.jit
+    def potato_rank1():
+        a = m4j.recv(zero, source=0, comm=comm)
+        m4j.send(a * 3.0 + 1.0, dest=0, comm=comm)
+        b = m4j.recv(zero, source=0, comm=comm)
+        m4j.send(b - 5.0, dest=0, comm=comm)
+        return b
+
+    if rank == 0:
+        out = potato_rank0(jnp.asarray([7.0]))
+        # ((7*3+1)*2) - 5 = 39
+        np.testing.assert_allclose(np.asarray(out), [39.0])
+    elif rank == 1:
+        potato_rank1()
+
+    # --- deadlock-by-construction ordering ------------------------------
+    if rank == 0:
+        m4j.send(jnp.asarray([13.0]), dest=1, comm=comm)
+        got = m4j.recv(zero, source=1, comm=comm)
+        np.testing.assert_allclose(np.asarray(got), [17.0])
+    elif rank == 1:
+        got = m4j.recv(zero, source=0, comm=comm)
+        np.testing.assert_allclose(np.asarray(got), [13.0])
+        m4j.send(jnp.asarray([17.0]), dest=0, comm=comm)
+
+    # --- ordering across nested jits ------------------------------------
+    @jax.jit
+    def inner(v):
+        return m4j.allreduce(v, op=m4j.SUM, comm=comm)
+
+    @jax.jit
+    def outer(v):
+        a = inner(v)
+        b = m4j.allreduce(a, op=m4j.MAX, comm=comm)
+        return inner(b)
+
+    out = outer(jnp.asarray([1.0]))
+    np.testing.assert_allclose(np.asarray(out), [float(size * size)])
+
+    print(f"rank {rank}: ordering OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
