@@ -1,0 +1,93 @@
+"""Ring attention: exact attention over sequence shards with a k/v ring.
+
+The reference has no long-context support; its building block for rings is
+token-ordered sendrecv (SURVEY.md §5.7 points at sendrecv.py:46-125 as the
+primitive to build this from).  TPU-native, the ring is ``lax.ppermute``
+over ICI inside ``shard_map`` (one hop per step, bandwidth-optimal), and the
+accumulation is the online-softmax (flash) recurrence so only one k/v block
+is ever resident per device.
+
+Shapes: q/k/v are ``(batch, seq_local, heads, head_dim)`` per rank, the
+sequence axis sharded over ``axis``.  Causality is handled block-wise: the
+k/v block's global offset is compared against the query block's.
+
+The step loop is ``lax.scan`` so the whole thing is reverse-differentiable;
+wrap in ``jax.checkpoint`` upstream to keep backward memory at one block.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _ring_shift(x, axis):
+    size = lax.axis_size(axis)
+    return lax.ppermute(x, axis, [(i, (i + 1) % size) for i in range(size)])
+
+
+def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None):
+    """Exact (flash-accumulated) attention across a sequence-sharded ring.
+
+    Args:
+        q, k, v: ``(B, T_local, H, D)`` per rank, sequence sharded on
+            ``axis``.
+        axis: mesh axis name carrying the sequence shards.
+        causal: apply a causal mask over *global* positions.
+        scale: score scale (default ``1/sqrt(D)``).
+
+    Returns:
+        ``(B, T_local, H, D)`` attention output, sequence-sharded like q.
+    """
+    size = lax.axis_size(axis)
+    my_block = lax.axis_index(axis)
+    b, t_loc, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+
+    # work in (B, H, T, D) for clean einsums
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    q_pos = my_block * t_loc + jnp.arange(t_loc)  # global query positions
+
+    neg_inf = jnp.asarray(jnp.finfo(jnp.float32).min, jnp.float32)
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        # after i hops, we hold the block originally owned by rank - i
+        src_block = (my_block - i) % size
+        scores = jnp.einsum(
+            "bhqd,bhkd->bhqk", qt.astype(jnp.float32),
+            k_cur.astype(jnp.float32),
+        ) * scale
+        if causal:
+            k_pos = src_block * t_loc + jnp.arange(t_loc)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, neg_inf)
+
+        m_blk = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32)
+        )
+        # rotate the k/v ring one hop (skip the send on the last step is a
+        # micro-optimization XLA handles via dead-code once unrolled; with
+        # scan we keep the uniform body)
+        k_nxt = _ring_shift(k_cur, axis)
+        v_nxt = _ring_shift(v_cur, axis)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, h, t_loc, d), jnp.float32)
+    m0 = jnp.full((b, h, t_loc), neg_inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, kt, vt), jnp.arange(size)
+    )
+    out = o / l[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -1,0 +1,76 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-sharding.
+
+The reference exposes the primitive for this — alltoall with a
+``(nproc, ...)`` leading axis (SURVEY.md §2.4 "FFT/spectral slab transpose",
+alltoall.py:39-83 there) — but no attention layer.  Here the full pattern:
+sequence-sharded activations are re-sharded to head-sharded with one
+``all_to_all``, attention runs locally per head group, and a second
+``all_to_all`` restores sequence sharding.  On TPU both transposes ride the
+bisection bandwidth of the ICI fabric.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def seq_to_heads(x, *, axis):
+    """(B, T_local, H, D) seq-sharded → (B, T_global, H_local, D) head-sharded."""
+    size = lax.axis_size(axis)
+    b, t_loc, h, d = x.shape
+    if h % size:
+        raise ValueError(f"heads ({h}) must divide the axis size ({size})")
+    h_loc = h // size
+    # split heads into `size` groups, one per destination rank
+    x = x.reshape(b, t_loc, size, h_loc, d).transpose(2, 0, 1, 3, 4)
+    # (size, B, T_local, H_local, D): row j -> rank j
+    x = lax.all_to_all(x, axis, split_axis=0, concat_axis=0)
+    # rows now hold every rank's sequence chunk of our head group
+    x = x.reshape(size, b, t_loc, h_loc, d).transpose(1, 0, 2, 3, 4)
+    return x.reshape(b, size * t_loc, h_loc, d)
+
+
+def heads_to_seq(x, *, axis):
+    """Inverse of :func:`seq_to_heads`."""
+    size = lax.axis_size(axis)
+    b, t_glob, h_loc, d = x.shape
+    if t_glob % size:
+        raise ValueError(
+            f"global sequence ({t_glob}) must divide the axis size ({size})"
+        )
+    t_loc = t_glob // size
+    x = x.reshape(b, size, t_loc, h_loc, d).transpose(1, 0, 2, 3, 4)
+    x = lax.all_to_all(x, axis, split_axis=0, concat_axis=0)
+    x = x.reshape(size, b, t_loc, h_loc, d).transpose(1, 2, 0, 3, 4)
+    return x.reshape(b, t_loc, size * h_loc, d)
+
+
+def ulysses_attention(q, k, v, *, axis, causal: bool = False, scale=None):
+    """Attention over the full sequence via head-sharding (DeepSpeed-Ulysses).
+
+    q/k/v: ``(B, T_local, H, D)`` sequence-sharded on ``axis``.  Requires
+    the head count to be divisible by the axis size.  Exact attention; the
+    sequence is materialized per head group (memory O(T_global·H/size)).
+    """
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
+    qh = seq_to_heads(q, axis=axis)
+    kh = seq_to_heads(k, axis=axis)
+    vh = seq_to_heads(v, axis=axis)
+
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", qh.astype(jnp.float32), kh.astype(jnp.float32)
+    ) * scale
+    if causal:
+        t = qh.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(
+            mask[None, None], scores, jnp.finfo(jnp.float32).min
+        )
+    probs = jnp.exp(
+        scores - jnp.max(scores, axis=-1, keepdims=True)
+    )
+    probs = probs / jnp.sum(probs, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, vh.astype(jnp.float32))
+    return heads_to_seq(out.astype(q.dtype), axis=axis)
