@@ -1,0 +1,303 @@
+"""GPT-style transformer with dp x tp x sp parallelism on one mesh.
+
+The reference ships no models — its parallel-training patterns exist as
+test/demo compositions of its primitives (SURVEY.md §2.4: DP grad-allreduce,
+TP column-split matvec + allreduce, alltoall transposes, pipeline
+send/recv).  This module is those patterns assembled into a complete,
+trainable model family, TPU-first:
+
+- **dp**: batch-sharded; gradients synced with allreduce-mean
+  (parallel/dp.py).
+- **tp**: Megatron-style — attention QKV and MLP up-projections are
+  column-parallel, output/down-projections row-parallel with one SUM
+  collective each (parallel/tp.py); weights are stored with a leading tp
+  axis and sharded over the mesh so each device holds only its block.
+- **sp**: sequence-sharded activations with **ring attention**
+  (parallel/ring.py) — exact causal attention over the full context with
+  one k/v block resident per device.
+
+Everything runs inside one ``shard_map`` over a 3-axis mesh; layers are
+stacked and iterated with ``lax.scan`` (one compiled block, TPU-friendly
+compile times); matmuls are kept large for the MXU and can run in bfloat16.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import ops
+from ..parallel.ring import ring_attention
+
+
+class GPTConfig(NamedTuple):
+    vocab: int = 256
+    d_model: int = 64
+    n_heads: int = 8
+    n_layers: int = 2
+    d_ff: int = 256
+    max_seq: int = 128
+    dtype: str = "float32"  # compute dtype; "bfloat16" on real TPU
+
+
+class GPTParams(NamedTuple):
+    # replicated
+    wte: jax.Array  # (vocab, d)
+    wpe: jax.Array  # (max_seq, d)
+    ln1: jax.Array  # (L, 2, d) scale/bias
+    ln2: jax.Array  # (L, 2, d)
+    lnf: jax.Array  # (2, d)
+    b2: jax.Array   # (L, d)  down-proj bias (added post-reduction)
+    bo: jax.Array   # (L, d)  attn out bias (added post-reduction)
+    # tp-sharded (leading tp axis)
+    w_qkv: jax.Array  # (L, tp, d, 3*d/tp)
+    w_o: jax.Array    # (L, tp, d/tp, d)
+    w1: jax.Array     # (L, tp, d, ff/tp)
+    b1: jax.Array     # (L, tp, ff/tp)
+    w2: jax.Array     # (L, tp, ff/tp, d)
+
+
+REPLICATED_FIELDS = ("wte", "wpe", "ln1", "ln2", "lnf", "b2", "bo")
+TP_FIELDS = ("w_qkv", "w_o", "w1", "b1", "w2")
+
+
+def init_params(cfg: GPTConfig, tp: int, seed: int = 0) -> GPTParams:
+    if cfg.d_model % cfg.n_heads or cfg.n_heads % tp or cfg.d_ff % tp:
+        raise ValueError("d_model/n_heads/d_ff must divide heads and tp")
+    rng = np.random.RandomState(seed)
+    d, ff, L = cfg.d_model, cfg.d_ff, cfg.n_layers
+    s = 0.02
+
+    def norm(*shape):
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * s)
+
+    ln_init = jnp.stack(
+        [jnp.ones((L, d), jnp.float32), jnp.zeros((L, d), jnp.float32)],
+        axis=1,
+    )
+    return GPTParams(
+        wte=norm(cfg.vocab, d),
+        wpe=norm(cfg.max_seq, d),
+        ln1=ln_init,
+        ln2=ln_init,
+        lnf=jnp.stack(
+            [jnp.ones((d,), jnp.float32), jnp.zeros((d,), jnp.float32)]
+        ),
+        b2=jnp.zeros((L, d), jnp.float32),
+        bo=jnp.zeros((L, d), jnp.float32),
+        w_qkv=norm(L, tp, d, 3 * d // tp),
+        w_o=norm(L, tp, d // tp, d),
+        w1=norm(L, tp, d, ff // tp),
+        b1=jnp.zeros((L, tp, ff // tp), jnp.float32),
+        w2=norm(L, tp, ff // tp, d),
+    )
+
+
+def param_specs(tp_axis: str = "tp") -> GPTParams:
+    """PartitionSpecs: tp-sharded weights on ``tp_axis``, rest replicated."""
+    reps = {f: P() for f in REPLICATED_FIELDS}
+    shard = {f: P(None, tp_axis) for f in TP_FIELDS}
+    shard["b1"] = P(None, tp_axis)
+    return GPTParams(**reps, **shard)
+
+
+def _layernorm(x, scale_bias):
+    scale, bias = scale_bias[0], scale_bias[1]
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-5) * scale + bias
+
+
+class GPT:
+    """The model, bound to a mesh with ("dp", "tp", "sp") axes."""
+
+    def __init__(self, cfg: GPTConfig, mesh: Mesh,
+                 dp_axis="dp", tp_axis="tp", sp_axis="sp"):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.axes = (dp_axis, tp_axis, sp_axis)
+        self.tp = mesh.shape[tp_axis]
+        self.sp = mesh.shape[sp_axis]
+        self.dp = mesh.shape[dp_axis]
+
+    # -- per-rank forward (inside shard_map) ------------------------------
+    def _block(self, x, layer, tp_comm):
+        """One transformer block on local activations (B_loc, T_loc, d)."""
+        cfg = self.cfg
+        dp_ax, tp_ax, sp_ax = self.axes
+        ln1, ln2, w_qkv, w_o, w1, b1, w2, b2, bo = layer
+        dtype = jnp.dtype(cfg.dtype)
+
+        h_loc = cfg.n_heads // self.tp
+        hd = cfg.d_model // cfg.n_heads
+
+        # attention: column-parallel qkv (no comm)
+        y = _layernorm(x, ln1).astype(dtype)
+        qkv = y @ w_qkv.astype(dtype)  # (B, T_loc, 3*d/tp)
+        b, t = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(b, t, 3, h_loc, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # exact causal attention over the sequence ring
+        attn = ring_attention(q, k, v, axis=sp_ax, causal=True)
+        attn = attn.reshape(b, t, h_loc * hd)
+        # row-parallel output projection: one SUM collective over tp
+        out = ops.allreduce(
+            attn @ w_o.astype(dtype), op=ops.SUM, comm=tp_comm
+        ) + bo.astype(dtype)
+        x = x + out.astype(x.dtype)
+
+        # MLP: column-parallel up, row-parallel down
+        y = _layernorm(x, ln2).astype(dtype)
+        h = jax.nn.gelu(y @ w1.astype(dtype) + b1.astype(dtype))
+        down = ops.allreduce(
+            h @ w2.astype(dtype), op=ops.SUM, comm=tp_comm
+        ) + b2.astype(dtype)
+        return x + down.astype(x.dtype)
+
+    def _forward_local(self, params: GPTParams, tokens):
+        """tokens: (B_loc, T_loc) int32 → logits (B_loc, T_loc, vocab)."""
+        from ..parallel.mesh import MeshComm
+
+        cfg = self.cfg
+        dp_ax, tp_ax, sp_ax = self.axes
+        tp_comm = MeshComm(tp_ax, mesh=self.mesh)
+
+        t_loc = tokens.shape[1]
+        sp_idx = lax.axis_index(sp_ax)
+        pos0 = sp_idx * t_loc
+
+        x = params.wte[tokens] + lax.dynamic_slice(
+            params.wpe, (pos0, 0), (t_loc, cfg.d_model)
+        )[None]
+
+        # per-layer stacks; [:, 0] squeezes this rank's tp block (the
+        # sharded leading tp dim is size 1 per shard)
+        stacked = (
+            params.ln1, params.ln2,
+            params.w_qkv[:, 0], params.w_o[:, 0],
+            params.w1[:, 0], params.b1[:, 0], params.w2[:, 0],
+            params.b2, params.bo,
+        )
+
+        def body(x_, layer):
+            return self._block(x_, layer, tp_comm), None
+
+        x, _ = lax.scan(body, x, stacked)
+        x = _layernorm(x, params.lnf)
+        return x @ params.wte.T  # tied embeddings
+
+    def _loss_local(self, params, tokens, targets, mask):
+        logits = self._forward_local(params, tokens).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        nll = nll * mask
+        # mean over *global* tokens: sum local, divide by global count
+        dp_ax, tp_ax, sp_ax = self.axes
+        from ..parallel.mesh import MeshComm
+
+        total = ops.allreduce(
+            jnp.sum(nll), op=ops.SUM,
+            comm=MeshComm((dp_ax, sp_ax), mesh=self.mesh),
+        )
+        count = ops.allreduce(
+            jnp.sum(mask), op=ops.SUM,
+            comm=MeshComm((dp_ax, sp_ax), mesh=self.mesh),
+        )
+        return total / jnp.maximum(count, 1.0)
+
+    # -- public training step --------------------------------------------
+    def train_step_fn(self, example_opt_state, optimizer=None):
+        """Build ``step(params, opt_state, tokens) -> (loss, params,
+        opt_state)`` jitted over the mesh.
+
+        ``tokens``: (B, T) int32, global. Batch is sharded over dp, the
+        sequence over sp, weights over tp.  ``example_opt_state`` (from
+        :meth:`init_opt_state`) supplies the optimizer-state structure so
+        its param-shaped moments inherit the param shardings.
+        """
+        import optax
+
+        dp_ax, tp_ax, sp_ax = self.axes
+        if optimizer is None:
+            optimizer = optax.adamw(3e-4)
+
+        specs = param_specs(tp_ax)
+        tok_spec = P(dp_ax, sp_ax)
+        # optimizer-state moments are GPTParams subtrees → same shardings
+        opt_specs = jax.tree.map(
+            lambda x: specs if isinstance(x, GPTParams) else P(),
+            example_opt_state,
+            is_leaf=lambda x: isinstance(x, GPTParams),
+        )
+
+        def local_step(params, opt_state, tokens, targets, mask):
+            from ..parallel.mesh import MeshComm
+
+            def loss_fn(p):
+                return self._loss_local(p, tokens, targets, mask)
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+
+            # gradient sync (see module docstring):
+            # - every param: mean over dp and sp replicas
+            # - replicated params additionally SUM over tp (each tp rank
+            #   holds only its shard's contribution)
+            dpsp = MeshComm((dp_ax, sp_ax), mesh=self.mesh)
+            tpc = MeshComm(tp_ax, mesh=self.mesh)
+            n = dpsp.size()
+
+            def sync(field, g):
+                g = ops.allreduce(g, op=ops.SUM, comm=dpsp) / n
+                if field in REPLICATED_FIELDS:
+                    g = ops.allreduce(g, op=ops.SUM, comm=tpc)
+                return g
+
+            grads = GPTParams(
+                **{
+                    f: sync(f, getattr(grads, f))
+                    for f in GPTParams._fields
+                }
+            )
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return loss[None], params, opt_state
+
+        mapped = jax.shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(specs, opt_specs, tok_spec, tok_spec, tok_spec),
+            out_specs=(P(dp_ax), specs, opt_specs),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def step(params, opt_state, tokens):
+            targets = jnp.concatenate(
+                [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1
+            )
+            mask = jnp.concatenate(
+                [
+                    jnp.ones(tokens[:, 1:].shape, jnp.float32),
+                    jnp.zeros(tokens[:, :1].shape, jnp.float32),
+                ],
+                axis=1,
+            )
+            loss, params2, opt_state2 = mapped(
+                params, opt_state, tokens, targets, mask
+            )
+            return loss[0], params2, opt_state2
+
+        return step
+
+    def init_opt_state(self, params, optimizer=None):
+        import optax
+
+        if optimizer is None:
+            optimizer = optax.adamw(3e-4)
+        return optimizer.init(params)

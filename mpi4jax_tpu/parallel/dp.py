@@ -1,0 +1,54 @@
+"""Data parallelism: gradient synchronization via differentiable allreduce.
+
+The reference's DP embodiment is ``allreduce(op=SUM)`` inside the loss so it
+sits *inside* ``jax.grad`` (SURVEY.md §2.4, allreduce.py:41-76 +
+test_allreduce_matvec.py there).  Same pattern here, plus the conventional
+outside-the-loss helpers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+
+
+def _resolve(comm):
+    if comm is None:
+        from .mesh import get_default_comm
+
+        comm = get_default_comm()
+    return comm
+
+
+def pmean(x, *, comm=None):
+    """Mean across ranks (differentiable; SUM allreduce / size)."""
+    comm = _resolve(comm)
+    return ops.allreduce(x, op=ops.SUM, comm=comm) / comm.size()
+
+
+def sync_gradients(grads, *, comm=None):
+    """Allreduce-mean every leaf of a gradient pytree (one call per leaf;
+    XLA fuses/overlaps the collectives on ICI)."""
+    return jax.tree.map(lambda g: pmean(g, comm=comm), grads)
+
+
+def value_and_synced_grad(loss_fn, *, comm=None):
+    """``value_and_grad`` of a per-shard loss with DP synchronization.
+
+    ``loss_fn(params, *batch) -> scalar`` is computed on the local shard;
+    the returned function yields the global mean loss and the allreduce-mean
+    gradient.  (Note: with replicated params inside ``shard_map``, a psum
+    inside the loss alone does NOT produce synced grads — the transpose of
+    psum delivers the cotangent to each local term, so the cross-rank sum of
+    per-rank gradients must be taken explicitly. Differentiating *through*
+    ``shard_map`` from outside syncs automatically; this helper is for the
+    per-rank-grad style.)
+    """
+
+    def wrapped(params, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        return pmean(loss, comm=comm), sync_gradients(grads, comm=comm)
+
+    return wrapped

@@ -1,0 +1,64 @@
+"""Pipeline parallelism over a mesh axis (SPMD GPipe).
+
+The reference's pipeline embodiment is ordered point-to-point send/recv
+chains between ranks, deadlock-free by token ordering (SURVEY.md §2.4,
+test_send_and_recv.py:96-115 there).  TPU-native, the stage handoff is one
+``lax.ppermute`` per pipeline tick inside a ``lax.scan``: every stage
+executes the same program (no per-rank code), bubbles are masked compute,
+and reverse-mode autodiff replays the schedule backward for free.
+
+The world tier (one process per rank) still supports the reference's
+explicit send/recv MPMD style for pipelines that need it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(stage_fn, stage_params, microbatches, *, axis):
+    """Run microbatches through a chain of stages along ``axis``.
+
+    Args:
+        stage_fn: ``stage_fn(params, x) -> y`` — one stage's compute; the
+            activation shape must be the same for every stage boundary.
+        stage_params: this rank's stage parameters (any pytree; inside
+            ``shard_map`` each rank passes its own shard).
+        microbatches: ``(M, ...)`` microbatch inputs, consumed by stage 0
+            (other ranks may pass the same array; only stage 0 reads it).
+        axis: mesh axis enumerating pipeline stages.
+
+    Returns:
+        ``(M, ...)`` outputs, valid on the **last** stage (use
+        :func:`mpi4jax_tpu.bcast` from the last rank if every stage needs
+        them); other ranks hold zeros.
+    """
+    size = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    n_ticks = m + size - 1
+
+    act_shape = microbatches.shape[1:]
+
+    def tick(carry, t):
+        incoming = carry  # activation handed off by the previous stage
+        mb = t - idx  # microbatch index this stage processes at tick t
+        active = (mb >= 0) & (mb < m)
+        # stage 0 reads its microbatch; later stages read the handoff
+        x0 = microbatches[jnp.clip(mb, 0, m - 1)]
+        x_in = jnp.where(idx == 0, x0, incoming)
+        y = stage_fn(stage_params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+        handoff = lax.ppermute(
+            y, axis, [(i, i + 1) for i in range(size - 1)]
+        )
+        return handoff, y
+
+    init = jnp.zeros(act_shape, microbatches.dtype)
+    _, ys = lax.scan(tick, init, jnp.arange(n_ticks))
+    # the last stage produced microbatch j at tick j + size - 1
+    out = ys[size - 1:]
+    is_last = idx == size - 1
+    return jnp.where(is_last, out, jnp.zeros_like(out))

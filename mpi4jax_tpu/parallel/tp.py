@@ -1,0 +1,57 @@
+"""Tensor parallelism: column/row-sharded linear layers.
+
+The reference's TP embodiment is the column-split matvec + allreduce with a
+``linear_transpose``-able collective (SURVEY.md §2.4,
+test_allreduce_matvec.py:12-66 there).  These helpers package the standard
+Megatron pairing: a column-parallel layer (no comm in, sharded out) followed
+by a row-parallel layer (sharded in, one psum out) — exactly one collective
+per pair, riding ICI.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import ops
+
+
+def column_parallel(x, w_shard, b_shard=None):
+    """y_shard = x @ w_shard (+ b_shard): output features sharded, no comm.
+
+    ``w_shard``: (d_in, d_out/size) — this rank's column block.
+    """
+    y = x @ w_shard
+    if b_shard is not None:
+        y = y + b_shard
+    return y
+
+
+def row_parallel(x_shard, w_shard, b=None, *, comm=None):
+    """y = allreduce(x_shard @ w_shard) (+ b): input features sharded, one
+    SUM collective produces the replicated output.
+
+    ``w_shard``: (d_in/size, d_out) — this rank's row block.  ``b`` is added
+    once (after the reduction), not per shard.
+    """
+    partial = x_shard @ w_shard
+    y = ops.allreduce(partial, op=ops.SUM, comm=comm)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def shard_columns(w, rank, size):
+    """Static helper: slice columns of a full weight for ``rank``."""
+    d = w.shape[-1]
+    if d % size:
+        raise ValueError(f"cannot split {d} columns over {size} ranks")
+    step = d // size
+    return w[..., rank * step:(rank + 1) * step]
+
+
+def shard_rows(w, rank, size):
+    d = w.shape[0]
+    if d % size:
+        raise ValueError(f"cannot split {d} rows over {size} ranks")
+    step = d // size
+    return w[rank * step:(rank + 1) * step]

@@ -1,0 +1,95 @@
+"""GPT with dp x tp x sp: trains, and the decomposed run matches 1-device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+import mpi4jax_tpu as m4j
+from mpi4jax_tpu.models.transformer import GPT, GPTConfig, init_params
+
+CFG = GPTConfig(
+    vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=32
+)
+B, T = 4, 32
+
+
+def make_model(shape):
+    n = int(np.prod(shape))
+    devices = np.array(jax.devices()[:n]).reshape(shape)
+    mesh = Mesh(devices, ("dp", "tp", "sp"))
+    model = GPT(CFG, mesh)
+    params = init_params(CFG, tp=shape[1], seed=0)
+    opt_state = model.init_opt_state(params)
+    step = model.train_step_fn(opt_state)
+    return model, params, opt_state, step
+
+
+def tokens():
+    rng = np.random.RandomState(0)
+    return jnp.asarray(rng.randint(0, CFG.vocab, (B, T)).astype(np.int32))
+
+
+def test_training_reduces_loss():
+    _, params, opt_state, step = make_model((2, 2, 2))
+    toks = tokens()
+    losses = []
+    for _ in range(8):
+        loss, params, opt_state = step(params, opt_state, toks)
+        losses.append(float(loss))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0] - 0.1, losses
+
+
+@pytest.mark.parametrize("shape", [(2, 2, 2), (1, 4, 2), (2, 1, 4)])
+def test_decomposition_invariance(shape):
+    # the same data + params must give the same first-step loss on any mesh
+    _, p1, s1, step1 = make_model((1, 1, 1))
+    toks = tokens()
+    l_ref, p1b, _ = step1(p1, s1, toks)
+
+    modelN, pN, sN, stepN = make_model(shape)
+    # tp-sharded weights were initialized with the same global values only
+    # when tp matches; regenerate the 1-dev model with matching tp blocks
+    if shape[1] != 1:
+        from mpi4jax_tpu.models.transformer import GPTParams, TP_FIELDS
+
+        # reshape tp=1 params into tp=k blocks (same underlying values)
+        def reblock(f, arr):
+            if f not in TP_FIELDS:
+                return arr
+            tp = shape[1]
+            full = arr[:, 0]
+            if f == "w_qkv":
+                # last dim layout is (3, heads, head_dim): split by heads
+                L, d, _ = full.shape
+                h, hd = CFG.n_heads, CFG.d_model // CFG.n_heads
+                w = full.reshape(L, d, 3, h, hd)
+                blocks = jnp.split(w, tp, axis=3)
+                return jnp.stack(
+                    [b.reshape(L, d, 3 * (h // tp) * hd) for b in blocks],
+                    axis=1,
+                )
+            if f in ("w1", "b1"):  # column-sharded: split last (ff) dim
+                return jnp.stack(jnp.split(full, tp, axis=-1), axis=1)
+            # w_o / w2: row-sharded — split the first feature dim
+            return jnp.stack(jnp.split(full, tp, axis=1), axis=1)
+
+        pN = GPTParams(
+            **{f: reblock(f, getattr(p1, f)) for f in GPTParams._fields}
+        )
+        sN = modelN.init_opt_state(pN)
+    else:
+        pN = p1
+        sN = modelN.init_opt_state(pN)
+
+    l_N, _, _ = stepN(pN, sN, toks)
+    np.testing.assert_allclose(float(l_N), float(l_ref), rtol=2e-4)
+
+
+def test_qkv_tp_split_is_consistent():
+    # sanity: with tp>1 the column split of w_qkv must keep q/k/v blocks per
+    # head group; n_heads % tp == 0 enforced
+    with pytest.raises(ValueError):
+        init_params(GPTConfig(n_heads=3), tp=2)

@@ -1,0 +1,129 @@
+"""DP / TP / pipeline strategy tests (reference embodiments: SURVEY.md §2.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import mpi4jax_tpu as m4j
+from mpi4jax_tpu.parallel import dp, tp
+from mpi4jax_tpu.parallel.pipeline import pipeline_apply
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return m4j.make_mesh(N)
+
+
+def test_dp_replicated_loss_grad(mesh):
+    # grad of the wrapped loss == grad of the global-batch loss
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(4).astype(np.float32))
+    xs = jnp.asarray(rng.randn(N * 2, 4).astype(np.float32))
+    ys = jnp.asarray(rng.randn(N * 2).astype(np.float32))
+
+    def local_loss(w_, x, y):
+        return jnp.mean((x @ w_ - y) ** 2)
+
+    def dp_grad(w_):
+        def per_rank(x, y):
+            _, g = dp.value_and_synced_grad(local_loss)(w_, x, y)
+            return g[None]
+
+        gs = m4j.spmd(per_rank, mesh=mesh)(xs, ys)
+        return gs.reshape(N, 4)[0]
+
+    g_dp = dp_grad(w)
+    g_full = jax.grad(
+        lambda w_: jnp.mean(
+            jnp.stack([
+                jnp.mean((xs[i * 2:(i + 1) * 2] @ w_ - ys[i * 2:(i + 1) * 2]) ** 2)
+                for i in range(N)
+            ])
+        )
+    )(w)
+    np.testing.assert_allclose(np.asarray(g_dp), np.asarray(g_full), rtol=1e-5)
+
+
+def test_tp_column_row_pair(mesh):
+    # column-parallel -> row-parallel == dense two-layer matmul
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(3, 16).astype(np.float32))
+    w1 = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+    w2 = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    dense = jnp.maximum(x @ w1, 0) @ w2
+
+    def per_rank(x_rep):
+        r = jax.lax.axis_index("mpi")
+        # static shards would come from a checkpoint loader; here slice
+        # dynamically for the test via lax.dynamic_slice
+        step1 = 32 // N
+        w1_shard = jax.lax.dynamic_slice(w1, (0, r * step1), (16, step1))
+        w2_shard = jax.lax.dynamic_slice(w2, (r * (32 // N), 0), (32 // N, 8))
+        h = jnp.maximum(tp.column_parallel(x_rep, w1_shard), 0)
+        return tp.row_parallel(h, w2_shard)[None]
+
+    out = m4j.spmd(per_rank, mesh=mesh, in_specs=P(), out_specs=P("mpi"))(x)
+    np.testing.assert_allclose(
+        np.asarray(out[0]), np.asarray(dense), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_tp_transpose_matvec(mesh):
+    # the reference's distributed-matvec + linear_transpose identity
+    # (test_allreduce_matvec.py:43-66 there): A column-split, transpose of
+    # the sharded matvec equals the dense transpose matvec
+    rng = np.random.RandomState(2)
+    a = rng.randn(6, N * 2).astype(np.float32)
+    x = rng.randn(N * 2).astype(np.float32)
+
+    def matvec(x_shards):
+        def per_rank(xs):
+            r = jax.lax.axis_index("mpi")
+            a_shard = jax.lax.dynamic_slice(
+                jnp.asarray(a), (0, r * 2), (6, 2)
+            )
+            return m4j.allreduce(a_shard @ xs, op=m4j.SUM)[None]
+
+        return m4j.spmd(per_rank, mesh=mesh)(x_shards).reshape(N, 6)[0]
+
+    np.testing.assert_allclose(
+        np.asarray(matvec(jnp.asarray(x))), a @ x, rtol=1e-4, atol=1e-4
+    )
+    ct = rng.randn(6).astype(np.float32)
+    (xt,) = jax.linear_transpose(matvec, jnp.asarray(x))(jnp.asarray(ct))
+    np.testing.assert_allclose(np.asarray(xt), a.T @ ct, rtol=1e-4, atol=1e-4)
+
+
+def test_pipeline_matches_sequential(mesh):
+    # N stages, each y = relu(x @ w_s); pipeline == sequential composition
+    rng = np.random.RandomState(3)
+    d = 8
+    ws = rng.randn(N, d, d).astype(np.float32) * 0.4
+    m = 5  # microbatches
+    xs = rng.randn(m, 2, d).astype(np.float32)
+
+    seq = jnp.asarray(xs)
+    for s in range(N):
+        seq = jnp.maximum(seq @ ws[s], 0)
+
+    def per_rank(w_all, mb):
+        r = jax.lax.axis_index("mpi")
+        w_mine = jax.lax.dynamic_index_in_dim(w_all, r, 0, keepdims=False)
+        out = pipeline_apply(
+            lambda w, x: jnp.maximum(x @ w, 0), w_mine, mb, axis="mpi"
+        )
+        return out[None]
+
+    out = m4j.spmd(
+        per_rank, mesh=mesh, in_specs=(P(), P()), out_specs=P("mpi")
+    )(jnp.asarray(ws), jnp.asarray(xs))
+    # outputs valid on the last stage
+    np.testing.assert_allclose(
+        np.asarray(out[N - 1]), np.asarray(seq), rtol=1e-4, atol=1e-4
+    )
+    # other stages masked to zero
+    assert float(np.abs(np.asarray(out[0])).max()) == 0.0
