@@ -1,0 +1,96 @@
+"""Infra-layer tests (reference parity: test_validation / test_jax_compat /
+test_has_cuda / flush, SURVEY.md §2.6)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4j
+from mpi4jax_tpu.utils import config, dtypes, jax_compat, validation
+
+
+def test_config_truthiness():
+    assert config.parse_bool("1") and config.parse_bool("TRUE")
+    assert not config.parse_bool("0") and not config.parse_bool("off")
+    with pytest.raises(ValueError):
+        config.parse_bool("maybe", name="X")
+
+
+def test_flag_env(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_DEBUG", "yes")
+    assert config.debug_enabled()
+    monkeypatch.setenv("MPI4JAX_TPU_DEBUG", "0")
+    assert not config.debug_enabled()
+
+
+def test_dtype_wire_codes_unique_and_supported():
+    codes = [dtypes.wire_code(d) for d in dtypes.SUPPORTED_DTYPES]
+    assert len(set(codes)) == len(codes)
+    assert dtypes.wire_code(jnp.bfloat16) == 10  # native/tpucomm.h contract
+    with pytest.raises(TypeError):
+        dtypes.wire_code(np.dtype("datetime64[s]"))
+
+
+def test_validation_static_int():
+    assert validation.check_static_int("root", np.int64(3)) == 3
+    with pytest.raises(TypeError, match="integer"):
+        validation.check_static_int("root", 1.5)
+    with pytest.raises(TypeError, match="bool"):
+        validation.check_static_int("root", True)
+
+
+def test_validation_range():
+    with pytest.raises(TypeError, match="out of range"):
+        validation.check_in_range("dest", 9, 4)
+
+
+def test_jax_version_parse():
+    assert jax_compat._parse("0.9.0") == (0, 9, 0)
+    assert jax_compat._parse("0.10.1.dev2") >= (0, 10, 1)
+
+
+def test_reduce_op_coercion():
+    assert m4j.as_reduce_op("sum") is m4j.SUM
+    assert m4j.as_reduce_op(m4j.MAX) is m4j.MAX
+    with pytest.raises(TypeError):
+        m4j.as_reduce_op(42)
+
+
+def test_reduce_op_dtype_domains():
+    with pytest.raises(TypeError):
+        m4j.BAND.check_dtype(jnp.float32)
+    m4j.BAND.check_dtype(jnp.uint8)
+    m4j.LAND.check_dtype(jnp.bool_)
+    with pytest.raises(TypeError):
+        m4j.SUM.check_dtype(jnp.bool_)
+
+
+def test_has_ici_support_runs():
+    assert isinstance(m4j.has_ici_support(), bool)
+
+
+def test_flush_runs():
+    # the atexit barrier must be callable at any time
+    from mpi4jax_tpu import _flush
+
+    _flush()
+
+
+def test_comm_context_stack():
+    comm = m4j.MeshComm("foo")
+    assert m4j.current_comm() is None
+    with comm:
+        assert m4j.current_comm() is comm
+        inner = m4j.MeshComm("bar")
+        with inner:
+            assert m4j.current_comm() is inner
+        assert m4j.current_comm() is comm
+    assert m4j.current_comm() is None
+
+
+def test_mesh_comm_hashable():
+    a, b = m4j.MeshComm("x"), m4j.MeshComm("x")
+    assert a == b and hash(a) == hash(b)
+    assert m4j.MeshComm(("x", "y")) != a
