@@ -50,6 +50,29 @@ def _size(axis) -> int:
     return lax.axis_size(axis)
 
 
+def _axes_tuple(axis):
+    return (axis,) if isinstance(axis, str) else tuple(axis)
+
+
+def as_varying(x, axis):
+    """Promote ``x`` to be varying over ``axis`` (VMA bookkeeping).
+
+    Under ``shard_map(..., check_vma=True)`` (the default), collectives
+    require their operand's varying-axes set to include the collective
+    axis; constants and replicated closures arrive invarying.  This makes
+    every op accept either, so the ops work in user shard_maps regardless
+    of the check mode.
+    """
+    try:
+        vma = jax.typeof(x).vma
+    except (AttributeError, TypeError):
+        return x
+    missing = tuple(a for a in _axes_tuple(axis) if a not in vma)
+    if missing:
+        x = lax.pcast(x, missing, to="varying")
+    return x
+
+
 def _masked(x, keep):
     """x where keep (scalar traced bool) else zeros, preserving dtype."""
     return jnp.where(keep, x, jnp.zeros_like(x))
@@ -57,6 +80,7 @@ def _masked(x, keep):
 
 def allreduce(x, op: ReduceOp, axis):
     op.check_dtype(x.dtype)
+    x = as_varying(x, axis)
     if op.lax_kind == "sum":
         return lax.psum(x, axis)
     if op.lax_kind == "max":
@@ -80,7 +104,7 @@ def allreduce(x, op: ReduceOp, axis):
 
 
 def allgather(x, axis):
-    return lax.all_gather(x, axis, axis=0, tiled=False)
+    return lax.all_gather(as_varying(x, axis), axis, axis=0, tiled=False)
 
 
 def alltoall(x, axis):
@@ -90,11 +114,13 @@ def alltoall(x, axis):
             f"alltoall requires leading axis == communicator size ({size}), "
             f"got shape {x.shape}"
         )
-    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0)
+    return lax.all_to_all(as_varying(x, axis), axis, split_axis=0,
+                          concat_axis=0)
 
 
 def bcast(x, root: int, axis):
     _dtypes.check_supported(x.dtype)
+    x = as_varying(x, axis)
     r = _rank(axis)
     if x.dtype == jnp.bool_:
         return lax.psum(_masked(x.astype(jnp.uint8), r == root), axis) != 0
@@ -104,7 +130,8 @@ def bcast(x, root: int, axis):
 def reduce(x, op: ReduceOp, root: int, axis):
     # Reference contract: root receives the reduction, other ranks get their
     # input back unchanged (rank-dependent *values*, uniform shapes — SPMD ok).
-    full = allreduce(x, op, axis)
+    x = as_varying(x, axis)
+    full = as_varying(allreduce(x, op, axis), axis)
     return jnp.where(_rank(axis) == root, full, x)
 
 
@@ -112,7 +139,7 @@ def gather(x, root: int, axis):
     # SPMD divergence (DESIGN.md): result (size, *shape) is materialized on
     # every rank; the root's view equals the reference's root result.
     del root
-    return lax.all_gather(x, axis, axis=0, tiled=False)
+    return lax.all_gather(as_varying(x, axis), axis, axis=0, tiled=False)
 
 
 def scatter(x, root: int, axis):
@@ -125,6 +152,7 @@ def scatter(x, root: int, axis):
     # all_to_all row j of the result holds rank j's chunk addressed to us;
     # row `root` is therefore exactly MPI_Scatter's result.  One collective,
     # O(|x|) traffic per rank — cheaper than bcast-then-slice (2·|x|).
+    x = as_varying(x, axis)
     return lax.all_to_all(x, axis, split_axis=0, concat_axis=0)[root]
 
 
@@ -139,7 +167,7 @@ def scan(x, op: ReduceOp, axis):
     op.check_dtype(x.dtype)
     size = _size(axis)
     r = _rank(axis)
-    acc = x
+    acc = as_varying(x, axis)
     shift = 1
     while shift < size:
         shifted = lax.ppermute(
@@ -158,7 +186,7 @@ def sendrecv(x, perm, axis):
     (/root/reference/mpi4jax/_src/collective_ops/sendrecv.py:46-125).  Ranks
     not appearing as a destination receive zeros.
     """
-    return lax.ppermute(x, axis, perm)
+    return lax.ppermute(as_varying(x, axis), axis, perm)
 
 
 def barrier(axis, tie=None):
@@ -166,7 +194,7 @@ def barrier(axis, tie=None):
     # returns a zero scalar that carries a genuine cross-rank data dependency
     # so callers can sequence host-visible work after it.  ``tie`` (e.g. a
     # token) is ordered before the barrier when given.
-    z = jnp.zeros((), jnp.int32)
+    z = as_varying(jnp.zeros((), jnp.int32), axis)
     if tie is not None:
         z = lax.optimization_barrier((z, tie))[0]
     return lax.psum(z, axis)

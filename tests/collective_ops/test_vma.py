@@ -1,0 +1,59 @@
+"""Ops must work under a user's raw shard_map with the default
+check_vma=True — including on invarying (replicated/constant) operands."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import mpi4jax_tpu as m4j
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return m4j.make_mesh(N)
+
+
+def test_ops_in_checked_shard_map(mesh):
+    comm = m4j.MeshComm("mpi")
+
+    def step(x):
+        # varying operand
+        a = m4j.allreduce(x, op=m4j.SUM, comm=comm)
+        # invarying (constant) operand — requires internal pcast
+        c = m4j.allreduce(jnp.float32(1.0), op=m4j.SUM, comm=comm)
+        b = m4j.bcast(x, 2, comm=comm)
+        r = m4j.reduce(x, m4j.MAX, 0, comm=comm)
+        s = m4j.scan(x, m4j.SUM, comm=comm)
+        g = m4j.sendrecv(x, shift=1, comm=comm)
+        m4j.barrier(comm=comm)
+        return a + c + b + r + s + g
+
+    f = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=P("mpi"), out_specs=P("mpi")
+        )
+    )
+    out = f(jnp.arange(N, dtype=jnp.float32))
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_allgather_alltoall_checked(mesh):
+    comm = m4j.MeshComm("mpi")
+
+    def step(x):
+        g = m4j.allgather(x, comm=comm)  # (N, 1)
+        t = m4j.alltoall(g, comm=comm)
+        sc = m4j.scatter(g, 0, comm=comm)
+        return (g.sum() + t.sum() + sc.sum()).reshape(1)
+
+    f = jax.jit(
+        jax.shard_map(
+            step, mesh=mesh, in_specs=P("mpi"), out_specs=P("mpi")
+        )
+    )
+    out = f(jnp.arange(N, dtype=jnp.float32))
+    assert out.shape == (N,)
