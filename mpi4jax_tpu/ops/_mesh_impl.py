@@ -63,6 +63,12 @@ def as_varying(x, axis):
     every op accept either, so the ops work in user shard_maps regardless
     of the check mode.
     """
+    from jax._src import config as _jcfg
+
+    if not _jcfg._check_vma.value:
+        # unchecked shard_map: vma is untracked (always empty) and pcast's
+        # transpose (a psum) would corrupt/abort transposed programs
+        return x
     try:
         vma = jax.typeof(x).vma
     except (AttributeError, TypeError):
