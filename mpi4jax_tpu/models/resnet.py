@@ -1,0 +1,164 @@
+"""Data-parallel residual CNN (the grad-allreduce training config).
+
+BASELINE.md lists "Data-parallel ResNet-50 grad allreduce" among the
+reference's benchmark configs; the reference itself only provides the
+collective (differentiable allreduce).  This module supplies the model
+family: a parameterizable residual CNN (depth/width scale up to
+ResNet-50-class) trained data-parallel with the framework's
+allreduce-synced gradients (parallel/dp.py).
+
+TPU notes: convolutions run through ``lax.conv_general_dilated`` in NHWC
+(MXU-friendly); normalization is GroupNorm (stateless — no cross-device
+batch statistics, so DP sync is gradients-only).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel import dp
+
+
+class ResNetConfig(NamedTuple):
+    stages: Sequence[int] = (2, 2, 2, 2)   # blocks per stage (ResNet-18)
+    widths: Sequence[int] = (64, 128, 256, 512)
+    n_classes: int = 10
+    in_channels: int = 3
+    groups: int = 8
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _groupnorm(x, scale, bias, groups):
+    n, h, w, c = x.shape
+    g = min(groups, c)
+    xg = x.reshape(n, h, w, g, c // g)
+    mu = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xg, axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mu) * lax.rsqrt(var + 1e-5)
+    return xg.reshape(n, h, w, c) * scale + bias
+
+
+def init_params(cfg: ResNetConfig, seed: int = 0):
+    rng = np.random.RandomState(seed)
+
+    def conv_w(k, cin, cout):
+        fan = k * k * cin
+        return jnp.asarray(
+            (rng.randn(k, k, cin, cout) * np.sqrt(2.0 / fan)).astype(
+                np.float32
+            )
+        )
+
+    params = {
+        "stem": conv_w(3, cfg.in_channels, cfg.widths[0]),
+        "stem_gn": (jnp.ones(cfg.widths[0]), jnp.zeros(cfg.widths[0])),
+        "stages": [],
+        "head": jnp.asarray(
+            (rng.randn(cfg.widths[-1], cfg.n_classes) * 0.01).astype(
+                np.float32
+            )
+        ),
+        "head_b": jnp.zeros(cfg.n_classes),
+    }
+    cin = cfg.widths[0]
+    for si, (depth, width) in enumerate(zip(cfg.stages, cfg.widths)):
+        blocks = []
+        for b in range(depth):
+            stride, has_proj = _block_plan(cfg, si, b, cin)
+            del stride  # static; recomputed in forward
+            blocks.append({
+                "conv1": conv_w(3, cin, width),
+                "gn1": (jnp.ones(width), jnp.zeros(width)),
+                "conv2": conv_w(3, width, width),
+                "gn2": (jnp.ones(width), jnp.zeros(width)),
+                "proj": conv_w(1, cin, width) if has_proj else None,
+            })
+            cin = width
+        params["stages"].append(blocks)
+    return params
+
+
+def _block_plan(cfg: ResNetConfig, stage: int, block: int, cin: int):
+    """Static (stride, needs_projection) for a block — shared by init and
+    forward so the pytree holds arrays only."""
+    width = cfg.widths[stage]
+    stride = 2 if (block == 0 and stage > 0) else 1
+    return stride, (cin != width or stride > 1)
+
+
+def forward(params, x, cfg: ResNetConfig):
+    g = cfg.groups
+    h = jnp.maximum(
+        _groupnorm(_conv(x, params["stem"]), *params["stem_gn"], g), 0
+    )
+    cin = cfg.widths[0]
+    for si, blocks in enumerate(params["stages"]):
+        for b, blk in enumerate(blocks):
+            stride, _ = _block_plan(cfg, si, b, cin)
+            y = _conv(h, blk["conv1"], stride)
+            y = jnp.maximum(_groupnorm(y, *blk["gn1"], g), 0)
+            y = _groupnorm(_conv(y, blk["conv2"]), *blk["gn2"], g)
+            skip = h
+            if blk["proj"] is not None:
+                skip = _conv(h, blk["proj"], stride)
+            h = jnp.maximum(y + skip, 0)
+            cin = cfg.widths[si]
+    pooled = jnp.mean(h, axis=(1, 2))
+    return pooled @ params["head"] + params["head_b"]
+
+
+def make_dp_train_step(cfg: ResNetConfig, mesh, lr=1e-2, axis="mpi"):
+    """Jitted DP training step: batch sharded over ``axis``, grads synced
+    with allreduce-mean."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import MeshComm
+
+    comm = MeshComm(axis, mesh=mesh)
+
+    def local_loss(params, xb, yb):
+        logits = forward(params, xb, cfg)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(
+            jnp.take_along_axis(logp, yb[:, None], axis=-1)
+        )
+
+    def per_rank(params, xb, yb):
+        loss, grads = dp.value_and_synced_grad(local_loss, comm=comm)(
+            params, xb, yb
+        )
+        params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return loss[None], params
+
+    # `stride`/None leaves are static pytree data; strip them from specs
+    def spec_tree(tree):
+        return jax.tree.map(lambda _: P(), tree)
+
+    example = init_params(cfg)
+
+    mapped = jax.shard_map(
+        per_rank,
+        mesh=mesh,
+        in_specs=(spec_tree(example), P(axis), P(axis)),
+        out_specs=(P(axis), spec_tree(example)),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def step(params, images, labels):
+        loss, params = mapped(params, images, labels)
+        return loss[0], params
+
+    return step
