@@ -1,0 +1,100 @@
+"""Allreduce microbenchmark: float32 message sweep (BASELINE.json config).
+
+Effective bandwidth is reported ring-style: ``2*(n-1)/n * bytes / time``
+per chip.  Runs on whatever devices are visible (real TPUs or the virtual
+CPU mesh); one JSON line per message size.
+
+    python benchmarks/allreduce_sweep.py [--max-mb 256] [--world]
+
+``--world`` benchmarks the world tier (native transport) instead, under
+the launcher.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def mesh_tier_sweep(max_bytes):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m4j
+
+    ndev = len(jax.devices())
+    mesh = m4j.make_mesh(ndev)
+    results = []
+    size = 1024
+    while size <= max_bytes:
+        n = size // 4
+        x = jnp.ones((ndev * n,), jnp.float32)
+        fn = jax.jit(
+            m4j.spmd(lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh)
+        )
+        jax.block_until_ready(fn(x))  # compile + warmup
+        reps = max(3, min(50, int(2e8 / max(size, 1))))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps
+        eff = 2 * (ndev - 1) / ndev * size / dt / 1e9 if ndev > 1 else size / dt / 1e9
+        rec = {
+            "op": "allreduce", "tier": "mesh", "devices": ndev,
+            "bytes": size, "seconds": round(dt, 9),
+            "eff_GBps_per_chip": round(eff, 3),
+            "platform": jax.devices()[0].platform,
+        }
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+        size *= 4
+    return results
+
+
+def world_tier_rank(max_bytes):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4j
+
+    comm = m4j.get_default_comm()
+    n = comm.size()
+    size = 1024
+    while size <= max_bytes:
+        x = jnp.ones((size // 4,), jnp.float32)
+        fn = jax.jit(lambda v: m4j.allreduce(v, op=m4j.SUM, comm=comm))
+        jax.block_until_ready(fn(x))
+        reps = max(3, min(30, int(5e7 / max(size, 1))))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(x)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / reps
+        if comm.rank() == 0:
+            print(json.dumps({
+                "op": "allreduce", "tier": "world", "ranks": n,
+                "bytes": size, "seconds": round(dt, 9),
+                "eff_GBps_per_chip": round(
+                    2 * (n - 1) / n * size / dt / 1e9, 3
+                ),
+            }), flush=True)
+        size *= 4
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-mb", type=float, default=64)
+    ap.add_argument("--world", action="store_true")
+    args = ap.parse_args()
+    max_bytes = int(args.max_mb * 1e6)
+    if args.world:
+        world_tier_rank(max_bytes)
+    else:
+        mesh_tier_sweep(max_bytes)
