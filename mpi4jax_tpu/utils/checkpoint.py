@@ -1,0 +1,63 @@
+"""Checkpoint / resume for model state pytrees.
+
+The reference has no checkpointing at all (SURVEY.md §5.4); training
+frameworks need it, so this framework ships a minimal, dependency-light
+implementation: orbax when available, otherwise a flattened ``.npz`` with a
+structure descriptor.  Works for any pytree of arrays (params, optimizer
+state, solver state).
+
+Single-controller semantics: arrays are fetched to host (global views of
+sharded arrays) and restored with whatever sharding the consumer applies;
+for multi-process (world-tier) jobs, call on rank 0 after a ``gather`` or
+give each rank its own path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import numpy as np
+
+import jax
+
+
+def _try_orbax():
+    try:
+        import orbax.checkpoint as ocp  # type: ignore
+
+        return ocp
+    except Exception:
+        return None
+
+
+def save(path: str, tree: Any) -> None:
+    """Save a pytree of arrays to ``path`` (directory for orbax, file for
+    npz fallback)."""
+    ocp = _try_orbax()
+    if ocp is not None and not path.endswith(".npz"):
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), jax.tree.map(np.asarray, tree))
+        return
+    leaves, _ = jax.tree.flatten(tree)
+    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
+    np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+
+
+def restore(path: str, like: Any) -> Any:
+    """Restore a pytree saved by :func:`save`; ``like`` supplies the
+    structure (and is required for the npz fallback)."""
+    ocp = _try_orbax()
+    if ocp is not None and os.path.isdir(path):
+        ckptr = ocp.PyTreeCheckpointer()
+        restored = ckptr.restore(os.path.abspath(path))
+        # reattach the caller's pytree structure (orbax returns nested dicts)
+        leaves = jax.tree.leaves(restored)
+        return jax.tree.unflatten(jax.tree.structure(like), leaves)
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    data = np.load(path)
+    n = len([k for k in data.files if k.startswith("leaf_")])
+    leaves = [data[f"leaf_{i}"] for i in range(n)]
+    return jax.tree.unflatten(jax.tree.structure(like), leaves)

@@ -58,6 +58,13 @@ def test_abort_fail_fast():
     assert "returned error code" in res.stderr
 
 
+def test_flush_exit_no_deadlock():
+    # reference regression: pending async comm at teardown must not hang
+    res = run_launcher("flush_exit.py", 2, timeout=120)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("dispatched, exiting") == 2
+
+
 def test_debug_log_format():
     res = run_launcher(
         "ordering.py", 2, env_extra={"MPI4JAX_TPU_DEBUG": "1"}

@@ -1,0 +1,33 @@
+"""Exit-deadlock regression: dispatch communication and exit immediately.
+
+Reference analog: pending async MPI at interpreter teardown would hang
+without the atexit effects barrier (test_common.py:91-114 there).  Here:
+both ranks fire a sendrecv and exit without blocking on the result; the
+atexit ``jax.effects_barrier()`` must drain it and the job must end
+cleanly.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4j
+
+
+def main():
+    comm = m4j.get_default_comm()
+    # fire-and-exit: no block_until_ready, no result use
+    m4j.sendrecv(jnp.arange(1000.0), shift=1, comm=comm)
+    m4j.allreduce(jnp.ones((1000,)), op=m4j.SUM, comm=comm)
+    print(f"rank {comm.rank()}: dispatched, exiting", flush=True)
+
+
+if __name__ == "__main__":
+    main()
