@@ -1,0 +1,221 @@
+"""Pipeline-parallel GPT: layers split into stages along a mesh axis.
+
+BASELINE.md's "Pipeline-parallel GPT-2 124M via point-to-point" config.
+The reference realizes pipelines as token-ordered send/recv chains between
+rank processes (SURVEY.md §2.4); here the schedule is the SPMD GPipe of
+``parallel/pipeline.py`` — one ``ppermute`` handoff per tick, microbatches
+filling the bubble, reverse-mode autodiff replaying the schedule backward.
+
+Layout: each stage owns ``n_layers/pp`` transformer blocks (params carry a
+leading ``pp`` axis, sharded over the mesh); embeddings are replicated
+(stage 0 embeds via the pipeline's ``prepare_fn``, the last stage applies
+the final norm + tied unembedding).  Compose with dp by adding a mesh axis
+and sharding the batch.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .. import ops
+from ..parallel.mesh import MeshComm
+from ..parallel.pipeline import pipeline_apply
+from .transformer import GPTConfig, _layernorm
+
+
+class PPGPTParams(NamedTuple):
+    wte: jax.Array   # (vocab, d)      replicated
+    wpe: jax.Array   # (max_seq, d)    replicated
+    lnf: jax.Array   # (2, d)          replicated
+    # stage-sharded stacks: leading pp axis, then layers-per-stage
+    ln1: jax.Array   # (pp, Ls, 2, d)
+    ln2: jax.Array   # (pp, Ls, 2, d)
+    w_qkv: jax.Array  # (pp, Ls, d, 3d)
+    w_o: jax.Array    # (pp, Ls, d, d)
+    w1: jax.Array     # (pp, Ls, d, ff)
+    b1: jax.Array     # (pp, Ls, ff)
+    w2: jax.Array     # (pp, Ls, ff, d)
+    b2: jax.Array     # (pp, Ls, d)
+
+
+REPLICATED = ("wte", "wpe", "lnf")
+
+
+def init_params(cfg: GPTConfig, pp: int, seed: int = 0) -> PPGPTParams:
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers ({cfg.n_layers}) must divide pp ({pp})")
+    ls = cfg.n_layers // pp
+    d, ff = cfg.d_model, cfg.d_ff
+    rng = np.random.RandomState(seed)
+    s = 0.02
+
+    def norm(*shape):
+        return jnp.asarray(rng.randn(*shape).astype(np.float32) * s)
+
+    ln = jnp.stack(
+        [jnp.ones((pp, ls, d), jnp.float32),
+         jnp.zeros((pp, ls, d), jnp.float32)], axis=2,
+    )
+    return PPGPTParams(
+        wte=norm(cfg.vocab, d),
+        wpe=norm(cfg.max_seq, d),
+        lnf=jnp.stack(
+            [jnp.ones((d,), jnp.float32), jnp.zeros((d,), jnp.float32)]
+        ),
+        ln1=ln, ln2=ln,
+        w_qkv=norm(pp, ls, d, 3 * d),
+        w_o=norm(pp, ls, d, d),
+        w1=norm(pp, ls, d, ff),
+        b1=jnp.zeros((pp, ls, ff), jnp.float32),
+        w2=norm(pp, ls, ff, d),
+        b2=jnp.zeros((pp, ls, d), jnp.float32),
+    )
+
+
+def param_specs(pp_axis: str = "pp") -> PPGPTParams:
+    return PPGPTParams(
+        **{f: P() for f in REPLICATED},
+        **{
+            f: P(pp_axis)
+            for f in PPGPTParams._fields
+            if f not in REPLICATED
+        },
+    )
+
+
+def _causal_attention(x, w_qkv, w_o, n_heads):
+    b, t, d = x.shape
+    hd = d // n_heads
+    qkv = (x @ w_qkv).reshape(b, t, 3, n_heads, hd)
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    mask = jnp.tril(jnp.ones((t, t), bool))
+    scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(b, t, d)
+    return out @ w_o
+
+
+class PPGPT:
+    def __init__(self, cfg: GPTConfig, mesh: Mesh, pp_axis: str = "pp"):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.pp_axis = pp_axis
+        self.pp = mesh.shape[pp_axis]
+
+    def _stage(self, stage_params, x):
+        """Run this stage's block stack on activations (B, T, d)."""
+        cfg = self.cfg
+        ln1, ln2, w_qkv, w_o, w1, b1, w2, b2 = stage_params
+
+        def block(x_, layer):
+            l1, l2, wq, wo, a1, c1, a2, c2 = layer
+            y = _causal_attention(
+                _layernorm(x_, l1), wq, wo, cfg.n_heads
+            )
+            x_ = x_ + y
+            h = jax.nn.gelu(_layernorm(x_, l2) @ a1 + c1)
+            return x_ + (h @ a2 + c2), None
+
+        x, _ = lax.scan(block, x, (ln1, ln2, w_qkv, w_o, w1, b1, w2, b2))
+        return x
+
+    def loss_fn(self):
+        """Per-rank pipelined loss: ``loss(params, tokens, targets, mask)``
+        with tokens (M, B_mb, T) microbatched; call inside shard_map."""
+        cfg = self.cfg
+
+        def loss(params: PPGPTParams, tokens, targets, mask):
+            idx = lax.axis_index(self.pp_axis)
+            is_last = idx == self.pp - 1
+            stage = tuple(
+                getattr(params, f)[0]
+                for f in ("ln1", "ln2", "w_qkv", "w_o", "w1", "b1", "w2",
+                          "b2")
+            )
+
+            def prepare(mb_tokens):
+                t = mb_tokens.shape[-1]
+                return (
+                    params.wte[mb_tokens]
+                    + params.wpe[:t][None]
+                )
+
+            acts = pipeline_apply(
+                self._stage, stage, tokens, axis=self.pp_axis,
+                prepare_fn=prepare,
+            )  # (M, B_mb, T, d); zeros except on the last stage
+
+            x = _layernorm(acts, params.lnf)
+            logits = x @ params.wte.T
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            nll = -jnp.take_along_axis(
+                logp, targets[..., None], axis=-1
+            )[..., 0]
+            local = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            # only the last stage's numbers are real; share them
+            contrib = jnp.where(is_last, local, 0.0)
+            return ops.allreduce(
+                contrib, op=ops.SUM, comm=MeshComm(self.pp_axis,
+                                                   mesh=self.mesh)
+            )
+
+        return loss
+
+    def train_step_fn(self, lr: float = 3e-4):
+        """SGD step: ``step(params, tokens) -> (loss, params)``; tokens
+        (M, B_mb, T) int32 microbatches, replicated."""
+        specs = param_specs(self.pp_axis)
+        loss_fn = self.loss_fn()
+
+        def per_rank(params, tokens, targets, mask):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, targets, mask
+            )
+            # stage-sharded grads are local; replicated params (embeddings,
+            # final norm) accumulate contributions from every stage
+            ppc = MeshComm(self.pp_axis, mesh=self.mesh)
+
+            def sync(f, g):
+                if f in REPLICATED:
+                    return ops.allreduce(g, op=ops.SUM, comm=ppc)
+                return g
+
+            grads = PPGPTParams(
+                **{f: sync(f, getattr(grads, f))
+                   for f in PPGPTParams._fields}
+            )
+            params = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+            return loss[None], params
+
+        mapped = jax.shard_map(
+            per_rank,
+            mesh=self.mesh,
+            in_specs=(specs, P(), P(), P()),
+            out_specs=(P(self.pp_axis), specs),
+            check_vma=False,
+        )
+
+        @jax.jit
+        def step(params, tokens):
+            targets = jnp.concatenate(
+                [tokens[..., 1:], jnp.zeros_like(tokens[..., :1])], axis=-1
+            )
+            mask = jnp.concatenate(
+                [
+                    jnp.ones(tokens[..., 1:].shape, jnp.float32),
+                    jnp.zeros(tokens[..., :1].shape, jnp.float32),
+                ],
+                axis=-1,
+            )
+            loss, params2 = mapped(params, tokens, targets, mask)
+            return loss[0], params2
+
+        return step

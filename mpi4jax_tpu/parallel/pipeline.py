@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def pipeline_apply(stage_fn, stage_params, microbatches, *, axis):
+def pipeline_apply(stage_fn, stage_params, microbatches, *, axis,
+                   prepare_fn=None):
     """Run microbatches through a chain of stages along ``axis``.
 
     Args:
@@ -29,6 +30,9 @@ def pipeline_apply(stage_fn, stage_params, microbatches, *, axis):
         microbatches: ``(M, ...)`` microbatch inputs, consumed by stage 0
             (other ranks may pass the same array; only stage 0 reads it).
         axis: mesh axis enumerating pipeline stages.
+        prepare_fn: optional map from a raw microbatch to the activation
+            fed into stage 0 (e.g. an embedding lookup) — lets microbatch
+            dtype/shape differ from the inter-stage activation.
 
     Returns:
         ``(M, ...)`` outputs, valid on the **last** stage (use
@@ -40,14 +44,20 @@ def pipeline_apply(stage_fn, stage_params, microbatches, *, axis):
     m = microbatches.shape[0]
     n_ticks = m + size - 1
 
-    act_shape = microbatches.shape[1:]
+    if prepare_fn is None:
+        prepare_fn = lambda mb: mb
+
+    act = jax.eval_shape(prepare_fn, jax.ShapeDtypeStruct(
+        microbatches.shape[1:], microbatches.dtype
+    ))
 
     def tick(carry, t):
         incoming = carry  # activation handed off by the previous stage
         mb = t - idx  # microbatch index this stage processes at tick t
         active = (mb >= 0) & (mb < m)
-        # stage 0 reads its microbatch; later stages read the handoff
-        x0 = microbatches[jnp.clip(mb, 0, m - 1)]
+        # stage 0 reads (and prepares) its microbatch; later stages read
+        # the handoff
+        x0 = prepare_fn(microbatches[jnp.clip(mb, 0, m - 1)])
         x_in = jnp.where(idx == 0, x0, incoming)
         y = stage_fn(stage_params, x_in)
         y = jnp.where(active, y, jnp.zeros_like(y))
@@ -56,7 +66,7 @@ def pipeline_apply(stage_fn, stage_params, microbatches, *, axis):
         )
         return handoff, y
 
-    init = jnp.zeros(act_shape, microbatches.dtype)
+    init = jnp.zeros(act.shape, act.dtype)
     _, ys = lax.scan(tick, init, jnp.arange(n_ticks))
     # the last stage produced microbatch j at tick j + size - 1
     out = ys[size - 1:]
