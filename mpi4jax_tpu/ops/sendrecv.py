@@ -37,17 +37,31 @@ def _resolve_perm(comm, perm, shift, wrap):
     return _mesh_impl.ring_perm(comm.size(), shift, wrap)
 
 
-def sendrecv(x, *, perm=None, shift=None, wrap=True, comm=None, token=None):
+def sendrecv(x, *, perm=None, shift=None, wrap=True, source=None, dest=None,
+             tag=0, comm=None, token=None):
     """Exchange ``x`` along a static rank permutation.
 
     Each pair ``(s, d)`` in the permutation delivers rank ``s``'s ``x`` to
     rank ``d``; ranks that are not a destination receive zeros.  With
     ``shift=k``, data moves to ``rank + k`` (a ring when ``wrap=True``).
+
+    On the world tier (one process per rank) the reference's per-rank
+    ``source=``/``dest=`` integers are also accepted
+    (/root/reference/mpi4jax/_src/collective_ops/sendrecv.py:46-125); on
+    the mesh tier a single SPMD program cannot take per-rank arguments —
+    express the pattern as ``perm``/``shift`` instead.
     """
     x = _validation.check_array("x", x)
     comm = _dispatch.resolve_comm(comm)
 
     if _dispatch.is_mesh(comm):
+        if source is not None or dest is not None:
+            raise ValueError(
+                "mesh-tier sendrecv takes the global pattern (perm=[(src, "
+                "dst), ...] or shift=k), not per-rank source/dest ints — "
+                "all ranks execute one SPMD program. Use the world tier "
+                "(launcher) for per-rank MPMD arguments."
+            )
         pairs = _resolve_perm(comm, perm, shift, wrap)
         body = lambda v: _mesh_impl.sendrecv(v, pairs, comm.axis)
         return _dispatch.maybe_tokenized(body, x, token)
@@ -55,7 +69,8 @@ def sendrecv(x, *, perm=None, shift=None, wrap=True, comm=None, token=None):
     from . import _world_impl
 
     return _world_impl.sendrecv_dispatch(
-        x, perm=perm, shift=shift, wrap=wrap, comm=comm, token=token
+        x, perm=perm, shift=shift, wrap=wrap, comm=comm, token=token,
+        source=source, dest=dest, tag=tag,
     )
 
 
