@@ -241,18 +241,28 @@ class ShallowWater:
         # value, interior ghosts are overwritten by the exchange.
         (hc,) = self._exchange((jnp.pad(_C(h), 1, mode="edge"),), ("h",))
 
+        # fe/fn/q/ke all derive from (hc, u, v) whose ghosts are already
+        # valid — compute them together and exchange in ONE batched
+        # collective per direction (the reference interleaves four separate
+        # token-ordered exchanges here, shallow_water.py:277-345 there)
         fe = _pad(0.5 * (_C(hc) + _E(hc)) * _C(u))
         fn = _pad(0.5 * (_C(hc) + _N(hc)) * _C(v))
-        fe, fn = self._exchange((fe, fn), ("u", "v"))
-
-        dh_new = -( _C(fe) - _W(fe)) / dx - (_C(fn) - _S(fn)) / dy
-
-        # potential vorticity (planetary + relative over layer thickness)
         yy, _ = self._local_coords()
         zeta = (_E(v) - _C(v)) / dx - (_N(u) - _C(u)) / dy
         thickness = 0.25 * (_C(hc) + _E(hc) + _N(hc) + _NE(hc))
         q = _pad((self._coriolis(_C(yy)) + zeta) / thickness)
-        (q,) = self._exchange((q,), ("h",))
+        ke = _pad(
+            0.5
+            * (
+                0.5 * (_C(u) ** 2 + _W(u) ** 2)
+                + 0.5 * (_C(v) ** 2 + _S(v) ** 2)
+            )
+        )
+        fe, fn, q, ke = self._exchange(
+            (fe, fn, q, ke), ("u", "v", "h", "h")
+        )
+
+        dh_new = -(_C(fe) - _W(fe)) / dx - (_C(fn) - _S(fn)) / dy
 
         du_new = -g * (_E(h) - _C(h)) / dx + 0.5 * (
             _C(q) * 0.5 * (_C(fn) + _E(fn))
@@ -262,15 +272,6 @@ class ShallowWater:
             _C(q) * 0.5 * (_C(fe) + _N(fe))
             + _W(q) * 0.5 * (_W(fe) + _NW(fe))
         )
-
-        ke = _pad(
-            0.5
-            * (
-                0.5 * (_C(u) ** 2 + _W(u) ** 2)
-                + 0.5 * (_C(v) ** 2 + _S(v) ** 2)
-            )
-        )
-        (ke,) = self._exchange((ke,), ("h",))
         du_new = du_new - (_E(ke) - _C(ke)) / dx
         dv_new = dv_new - (_N(ke) - _C(ke)) / dy
 
