@@ -695,6 +695,65 @@ int tpucomm_alltoall(int64_t h, const void* sendbuf, void* recvbuf,
   return 0;
 }
 
+namespace {
+
+/* chunk [i] covers elements [i*per, min((i+1)*per, count)) */
+int64_t chunk_lo(int64_t count, int size, int i) {
+  int64_t per = (count + size - 1) / size;
+  int64_t lo = per * i;
+  return lo < count ? lo : count;
+}
+
+int ring_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype,
+                   int op) {
+  const int size = c->size, rank = c->rank;
+  const int64_t esize = dtype_size(dtype);
+  char* buf = static_cast<char*>(recvbuf);
+  int next = (rank + 1) % size, prev = (rank - 1 + size) % size;
+  int64_t per = (count + size - 1) / size;
+  std::vector<char> tmp(per * esize);
+
+  /* phase 1: ring reduce-scatter — after size-1 rounds, chunk (rank+1)%size
+   * holds the full reduction */
+  for (int step = 0; step < size - 1; step++) {
+    int sc = (rank - step + size) % size;
+    int rc = (rank - step - 1 + size) % size;
+    int64_t slo = chunk_lo(count, size, sc), shi = chunk_lo(count, size, sc + 1);
+    int64_t rlo = chunk_lo(count, size, rc), rhi = chunk_lo(count, size, rc + 1);
+    int send_rc = 0;
+    std::thread sender([&] {
+      send_rc = send_msg(c, next, kCollectiveTag, buf + slo * esize,
+                         (shi - slo) * esize);
+    });
+    int recv_rc = recv_msg(c, prev, kCollectiveTag, tmp.data(),
+                           (rhi - rlo) * esize);
+    sender.join();
+    if (send_rc || recv_rc) return 1;
+    if (rhi > rlo &&
+        combine(buf + rlo * esize, tmp.data(), rhi - rlo, dtype, op, c))
+      return 1;
+  }
+  /* phase 2: ring allgather of the reduced chunks */
+  for (int step = 0; step < size - 1; step++) {
+    int sc = (rank + 1 - step + size) % size;
+    int rc = (rank - step + size) % size;
+    int64_t slo = chunk_lo(count, size, sc), shi = chunk_lo(count, size, sc + 1);
+    int64_t rlo = chunk_lo(count, size, rc), rhi = chunk_lo(count, size, rc + 1);
+    int send_rc = 0;
+    std::thread sender([&] {
+      send_rc = send_msg(c, next, kCollectiveTag, buf + slo * esize,
+                         (shi - slo) * esize);
+    });
+    int recv_rc = recv_msg(c, prev, kCollectiveTag, buf + rlo * esize,
+                           (rhi - rlo) * esize);
+    sender.join();
+    if (send_rc || recv_rc) return 1;
+  }
+  return 0;
+}
+
+}  // namespace
+
 int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
                       int64_t count, int dtype, int op) {
   Comm* c = get_comm(h);
@@ -708,9 +767,12 @@ int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
   int64_t nbytes = count * esize;
   std::memcpy(recvbuf, sendbuf, nbytes);
   if (c->size == 1) return 0;
-  /* reduce along a chain to rank size-1, then bcast back.  O(size) latency
-   * but strictly ordered and simple; ring-reduce-scatter+allgather is the
-   * planned optimization for large payloads. */
+  /* large payloads: bandwidth-optimal ring (2*(n-1)/n * bytes on the wire
+   * per rank); small ones: chain-reduce + tree-bcast (lower latency, and
+   * deterministic rank-ordered combining) */
+  if (nbytes >= 64 * 1024 && count >= c->size) {
+    return ring_allreduce(c, recvbuf, count, dtype, op);
+  }
   std::vector<char> tmp(nbytes);
   if (c->rank > 0) {
     if (recv_msg(c, c->rank - 1, kCollectiveTag, tmp.data(), nbytes))
