@@ -1,0 +1,81 @@
+"""Weak-scaling study for the shallow-water app (BASELINE north star:
+≥80% weak-scaling efficiency).
+
+Each rank keeps a fixed local block; the global domain grows with the
+grid.  One JSON line per configuration.  On the virtual CPU mesh this
+validates the harness; the numbers that matter come from a TPU slice.
+
+    python benchmarks/shallow_water_scaling.py --local 256 256 --steps 50
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(grid_shape, local, steps):
+    import jax
+
+    from mpi4jax_tpu.models.shallow_water import ShallowWater, SWParams
+    from mpi4jax_tpu.parallel.grid import ProcessGrid
+
+    gy, gx = grid_shape
+    ny, nx = gy * local[0], gx * local[1]
+    grid = ProcessGrid(grid_shape)
+    model = ShallowWater(grid, (ny, nx), SWParams(dx=5e3, dy=5e3))
+    state = model.init()
+    state = model.step_fn(1, first=True)(state)
+    fn = model.step_fn(steps, first=False)
+    jax.block_until_ready(fn(state))  # compile + warmup
+    t0 = time.perf_counter()
+    out = fn(state)
+    jax.block_until_ready(out.h)
+    dt = time.perf_counter() - t0
+    return steps / dt
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--local", type=int, nargs=2, default=(128, 128))
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+
+    import jax
+
+    ndev = len(jax.devices())
+    configs = []
+    n = 1
+    while n <= ndev:
+        gy = 1
+        for cand in range(int(np.sqrt(n)), 0, -1):
+            if n % cand == 0:
+                gy = cand
+                break
+        configs.append((gy, n // gy))
+        n *= 2
+
+    base = None
+    for shape in configs:
+        sps = run(shape, tuple(args.local), args.steps)
+        ndev_used = shape[0] * shape[1]
+        if base is None:
+            base = sps
+        eff = sps / base
+        print(json.dumps({
+            "bench": "shallow_water_weak_scaling",
+            "grid": list(shape), "devices": ndev_used,
+            "local_block": list(args.local),
+            "steps_per_s": round(sps, 2),
+            "weak_scaling_efficiency": round(eff, 3),
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
