@@ -1,0 +1,59 @@
+"""Dtype coverage for the mesh tier: bfloat16 (TPU-native), float16,
+complex, and 64-bit-free integer paths through every reduction family."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m4j
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return m4j.make_mesh(N)
+
+
+@pytest.mark.parametrize(
+    "dtype", [jnp.bfloat16, jnp.float16, jnp.float32, jnp.int32, jnp.uint16]
+)
+def test_allreduce_sum_dtypes(mesh, dtype):
+    x = jnp.ones((N, 4), dtype)
+    out = m4j.spmd(lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh)(x)
+    assert out.dtype == dtype
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float64), N, rtol=1e-2
+    )
+
+
+def test_allreduce_complex(mesh):
+    x = jnp.full((N, 2), 1 + 2j, jnp.complex64)
+    out = m4j.spmd(lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh)(x)
+    assert out.dtype == jnp.complex64
+    np.testing.assert_allclose(np.asarray(out), N * (1 + 2j))
+
+
+def test_sendrecv_bfloat16(mesh):
+    x = jnp.arange(N, dtype=jnp.bfloat16)
+    out = m4j.spmd(lambda v: m4j.sendrecv(v, shift=1), mesh=mesh)(x)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32), np.roll(np.arange(N), 1)
+    )
+
+
+def test_scan_bfloat16(mesh):
+    x = jnp.ones((N, 2), jnp.bfloat16)
+    out = m4j.spmd(lambda v: m4j.scan(v, m4j.SUM), mesh=mesh)(x)
+    np.testing.assert_allclose(
+        np.asarray(out, dtype=np.float32).reshape(N, 2)[:, 0],
+        np.arange(1, N + 1),
+    )
+
+
+def test_allgather_preserves_dtype(mesh):
+    for dtype in (jnp.bfloat16, jnp.int8, jnp.bool_):
+        x = jnp.ones((N, 2), dtype)
+        out = m4j.spmd(lambda v: m4j.allgather(v), mesh=mesh)(x)
+        assert out.dtype == dtype

@@ -58,6 +58,13 @@ def test_abort_fail_fast():
     assert "returned error code" in res.stderr
 
 
+def test_tag_mismatch_aborts():
+    res = run_launcher("tag_mismatch.py", 2, timeout=120)
+    assert res.returncode != 0
+    assert "UNREACHABLE\n" not in res.stdout
+    assert "order violation" in res.stderr or "returned error code" in res.stderr
+
+
 def test_flush_exit_no_deadlock():
     # reference regression: pending async comm at teardown must not hang
     res = run_launcher("flush_exit.py", 2, timeout=120)

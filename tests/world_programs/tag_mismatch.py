@@ -1,0 +1,34 @@
+"""Protocol-error fail-fast: mismatched tags must abort, not hang.
+
+The transport matches messages strictly in order (ordered effects upstream);
+a tag mismatch is a program error reported as an abort — the no-silent-
+deadlock contract.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+
+import mpi4jax_tpu as m4j
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank = comm.rank()
+    x = jnp.arange(3, dtype=jnp.float32)
+    if rank == 0:
+        m4j.send(x, dest=1, tag=5)
+    elif rank == 1:
+        m4j.recv(x, source=0, tag=7)  # wrong tag -> transport abort
+    print("UNREACHABLE-OK" if rank != 1 else "UNREACHABLE", flush=True)
+
+
+if __name__ == "__main__":
+    main()
