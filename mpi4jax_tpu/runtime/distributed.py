@@ -1,0 +1,54 @@
+"""Multi-host (pod-scale) initialization helpers.
+
+The reference scales across hosts through MPI ranks (`mpirun` on every
+host).  TPU-native, multi-host scaling is *single-program multi-controller*
+JAX: every host runs the same program, `jax.distributed.initialize` wires
+the controllers, and one global `Mesh` spans every chip — ICI inside a
+slice, DCN between slices — with the same `spmd`/collective code as
+single-host (the compiler routes collectives over the right fabric).
+
+    # on every host of the pod (or let TPU metadata fill the arguments)
+    import mpi4jax_tpu as m4j
+    m4j.runtime.distributed.initialize()       # jax.distributed under the hood
+    mesh = m4j.make_mesh()                     # spans ALL hosts' devices
+    out = m4j.spmd(fn, mesh=mesh)(global_array)
+
+The world tier composes with this for MPMD patterns: set
+``MPI4JAX_TPU_HOSTS`` to the per-rank host list and launch one rank per
+host; world ops then stage through the native transport over DCN while
+mesh ops stay on ICI (SURVEY.md §5.8's two-tier design).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    **kwargs,
+) -> None:
+    """Initialize multi-controller JAX (no-op when already initialized or
+    single-process).  Arguments default to TPU-pod auto-detection."""
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            **kwargs,
+        )
+    except RuntimeError as err:  # already initialized
+        if "already" not in str(err).lower():
+            raise
+
+
+def global_mesh(axis: str = "mpi"):
+    """A 1-D mesh over every device of every host (call after
+    :func:`initialize`)."""
+    from ..parallel.mesh import make_mesh
+
+    return make_mesh(axis=axis)
