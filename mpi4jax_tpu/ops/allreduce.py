@@ -16,7 +16,7 @@ from . import _dispatch, _mesh_impl
 from .reduce_ops import SUM, as_reduce_op
 
 
-def allreduce(x, op=SUM, *, comm=None, token=None):
+def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
     """Reduce ``x`` with ``op`` across all ranks of ``comm``.
 
     Args:
@@ -25,10 +25,25 @@ def allreduce(x, op=SUM, *, comm=None, token=None):
             bitwise). Only ``SUM`` is differentiable.
         comm: communicator (default: ambient).
         token: optional ordering token; if given, returns ``(result, token)``.
+        compression: ``"int8"`` for the bandwidth-saving quantized path
+            (mesh tier, SUM only, ~1e-2 relative error; ops/quantized.py).
     """
     op = as_reduce_op(op)
     x = _validation.check_array("x", x)
     comm = _dispatch.resolve_comm(comm)
+
+    if compression is not None:
+        if compression != "int8":
+            raise ValueError(f"unknown compression {compression!r}")
+        if not _dispatch.is_mesh(comm) or op.name != "SUM":
+            raise NotImplementedError(
+                "compression='int8' is supported on the mesh tier with "
+                "op=SUM"
+            )
+        from .quantized import quantized_allreduce_sum
+
+        body = lambda v: quantized_allreduce_sum(v, comm.axis)
+        return _dispatch.maybe_tokenized(body, x, token)
 
     if _dispatch.is_mesh(comm):
         body = lambda v: _mesh_impl.allreduce(v, op, comm.axis)

@@ -1,0 +1,75 @@
+"""Quantized (int8) allreduce — trade precision for ICI bandwidth.
+
+Technique pattern after EQuARX (PAPERS.md: "Efficient Quantized AllReduce
+in XLA"): an allreduce decomposed into reduce-scatter + all-gather with
+block-quantized int8 payloads and per-block scales, cutting wire bytes ~4x
+for float32 (~2x for bfloat16) at ~1e-2 relative error.  Own
+implementation, mesh tier only:
+
+1. split the flattened array into ``size`` destination chunks;
+2. per-chunk absmax scales; quantize to int8;
+3. one ``all_to_all`` moves int8 chunks (+ tiny f32 scales);
+4. dequantize, reduce the ``size`` partial chunks locally (f32 math);
+5. re-quantize the reduced chunk, ``all_gather`` it back, dequantize.
+
+Exposed via ``allreduce(..., compression="int8")`` and directly as
+:func:`quantized_allreduce_sum`.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import _mesh_impl
+
+
+def _pad_to(x, n):
+    flat = x.reshape(-1)
+    pad = (-flat.size) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat, pad
+
+
+def _quantize(x):
+    """per-row int8 quantization: x (rows, k) → (q int8, scale f32 (rows,))."""
+    absmax = jnp.max(jnp.abs(x), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale[:, None]), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
+
+
+def quantized_allreduce_sum(x, axis):
+    """SUM allreduce with int8-compressed transfers (mesh tier).
+
+    Returns an approximation of ``psum(x, axis)`` with ~1e-2 relative
+    error; payload on the wire is ~1/4 of the float32 collective.
+    """
+    size = lax.axis_size(axis)
+    x = _mesh_impl.as_varying(x, axis)
+    orig_dtype = x.dtype
+    flat, pad = _pad_to(x, size)
+    chunks = flat.reshape(size, -1)  # row j → rank j
+
+    q, scale = _quantize(chunks)
+    # one all_to_all for payloads, one for the (tiny) scales
+    q_t = lax.all_to_all(q[:, None], axis, split_axis=0, concat_axis=0)
+    s_t = lax.all_to_all(
+        scale.reshape(size, 1), axis, split_axis=0, concat_axis=0
+    )
+    # rows: every rank's contribution to OUR chunk; reduce in f32
+    partial = q_t[:, 0].astype(jnp.float32) * s_t  # (size, chunk)
+    mine = jnp.sum(partial, axis=0)  # (chunk,)
+
+    # re-quantize the reduced chunk and share it
+    q2, s2 = _quantize(mine[None])
+    q_all = lax.all_gather(q2[0], axis, axis=0, tiled=False)  # (size, chunk)
+    s_all = lax.all_gather(s2, axis, axis=0, tiled=False)  # (size, 1)
+    full = (q_all.astype(jnp.float32) * s_all).reshape(-1)
+    if pad:
+        full = full[:-pad]
+    return full.reshape(x.shape).astype(orig_dtype)

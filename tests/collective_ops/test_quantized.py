@@ -1,0 +1,52 @@
+"""Quantized int8 allreduce vs the exact collective."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import mpi4jax_tpu as m4j
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return m4j.make_mesh(N)
+
+
+@pytest.mark.parametrize("shape", [(257,), (8, 33), (4, 4, 5)])
+def test_quantized_matches_exact(mesh, shape):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(N, *shape).astype(np.float32))
+    exact = m4j.spmd(
+        lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh
+    )(x)
+    approx = m4j.spmd(
+        lambda v: m4j.allreduce(v, op=m4j.SUM, compression="int8"),
+        mesh=mesh,
+    )(x)
+    e = np.asarray(exact)
+    a = np.asarray(approx)
+    denom = np.maximum(np.abs(e), 1e-3)
+    assert np.median(np.abs(a - e) / denom) < 2e-2
+    assert np.max(np.abs(a - e)) < 0.2 * np.max(np.abs(e))
+
+
+def test_quantized_bf16(mesh):
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(N, 64).astype(np.float32)).astype(jnp.bfloat16)
+    out = m4j.spmd(
+        lambda v: m4j.allreduce(v, op=m4j.SUM, compression="int8"),
+        mesh=mesh,
+    )(x)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_quantized_rejects_non_sum(mesh):
+    x = jnp.ones((N,), jnp.float32)
+    with pytest.raises(NotImplementedError):
+        m4j.spmd(
+            lambda v: m4j.allreduce(v, op=m4j.MAX, compression="int8"),
+            mesh=mesh,
+        )(x)
