@@ -11,14 +11,37 @@ Prints exactly one JSON line:
 """
 
 import json
+import os
 import sys
+import threading
 import time
 
 BASELINE_GPU_SECONDS = 6.28  # reference: 1x P100, docs/shallow-water.rst:81-83
 
+# Device acquisition can hang indefinitely if the TPU tunnel is wedged;
+# emit a structured failure instead of stalling the driver.
+INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "600"))
+
+
+def _watchdog(flag):
+    time.sleep(INIT_TIMEOUT_S)
+    if not flag["ready"]:
+        print(json.dumps({
+            "metric": "shallow_water_1800x3600_0.1day_1chip",
+            "value": None, "unit": "s", "vs_baseline": 0.0,
+            "error": f"device init did not complete in {INIT_TIMEOUT_S}s",
+        }), flush=True)
+        os._exit(2)
+
 
 def main():
+    flag = {"ready": False}
+    threading.Thread(target=_watchdog, args=(flag,), daemon=True).start()
+
     import jax
+
+    jax.devices()
+    flag["ready"] = True
     import numpy as np
 
     from mpi4jax_tpu.models.shallow_water import ShallowWater, SWParams
