@@ -1,0 +1,416 @@
+"""Pallas TPU flash-attention kernels for ring attention.
+
+The reference framework has no attention kernels at all (its long-context
+building block is the token-ordered ``sendrecv`` ring, reference
+``mpi4jax/_src/collective_ops/sendrecv.py:46-125``); this module is the
+TPU-native superset: the *local block* of ring attention is computed by a
+blockwise online-softmax (flash) kernel running out of VMEM on the MXU,
+while the k/v blocks travel the ring via ``lax.ppermute`` over ICI.
+
+Design
+------
+* ``_flash_fwd_block`` computes one ring step's contribution for the whole
+  local q against the currently-held k/v block and returns the *partial*
+  ``(o_unnormalized, m, l)`` triple in float32.  The cross-step combine is
+  ~10 VPU ops done in plain JAX, so the ``lax.scan`` over ring steps stays
+  differentiable-shaped and XLA overlaps the ppermute with the next
+  kernel launch.
+* The ring is wrapped in a ``jax.custom_vjp`` at the *ring* level: the
+  backward pass re-runs the ring (one extra rotation of k/v) using the
+  standard flash backward identities with the saved logsumexp, computing
+  dq locally and letting dk/dv ride the ring home with their blocks.
+  Backward kernels (``_bwd_dq_kernel``, ``_bwd_dkv_kernel``) recompute the
+  probabilities blockwise, so backward memory is O(block_q * block_k).
+* Causality is resolved at *global* positions: block offsets arrive as
+  scalar-prefetch operands (they are traced values inside the ring scan),
+  and fully-masked (q-block, k-block) pairs are skipped with ``pl.when``.
+
+Runs in Pallas interpret mode off-TPU so the CPU test mesh exercises the
+identical code path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..parallel.ring import _ring_shift as _shift
+
+NEG_INF = -1e30
+_TRANS_B = (((1,), (1,)), ((), ()))  # contract last dims: x @ y.T
+_TRANS_A = (((0,), (0,)), ((), ()))  # contract first dims: x.T @ y
+
+
+def target_platform() -> str:
+    """Platform the surrounding computation executes on.
+
+    Inside ``shard_map``/``use_mesh`` tracing, the abstract mesh knows the
+    actual device kind — which may differ from ``jax.default_backend()``
+    (e.g. a forced-CPU debug mesh on a TPU host).  Falls back to the
+    default backend outside any mesh context.
+    """
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        kind = getattr(getattr(mesh, "abstract_device", None),
+                       "device_kind", None)
+        if kind:
+            return "tpu" if "tpu" in str(kind).lower() else str(kind).lower()
+    except Exception:
+        pass
+    return jax.default_backend()
+
+
+def _interpret_default() -> bool:
+    return target_platform() != "tpu"
+
+
+def pick_block(t: int, preferred: int) -> int:
+    """Largest divisor of ``t`` that is <= preferred (128-friendly first)."""
+    b = min(preferred, t)
+    while t % b:
+        b -= 1
+    return b
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: one k/v block vs the whole local q
+# ---------------------------------------------------------------------------
+
+
+def _scores(q_ref, k_ref, q_start, k_start, scale, causal, block_q, block_k):
+    q = q_ref[...].astype(jnp.float32)
+    k = k_ref[...].astype(jnp.float32)
+    s = lax.dot_general(q, k, _TRANS_B,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(cols <= rows, s, NEG_INF)
+    return s
+
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                m_s, l_s, acc, *, scale, causal, block_q, block_k):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[...] = jnp.full_like(m_s, NEG_INF)
+        l_s[...] = jnp.zeros_like(l_s)
+        acc[...] = jnp.zeros_like(acc)
+
+    q_start = off_ref[0] + pl.program_id(1) * block_q
+    k_start = off_ref[1] + ik * block_k
+    should_run = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(should_run)
+    def _compute():
+        s = _scores(q_ref, k_ref, q_start, k_start, scale, causal,
+                    block_q, block_k)
+        m_prev, l_prev = m_s[...], l_s[...]          # (BQ, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_next)                      # (BQ, BK)
+        alpha = jnp.exp(m_prev - m_next)
+        m_s[...] = m_next
+        l_s[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[...].astype(jnp.float32)
+        acc[...] = acc[...] * alpha + lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _store():
+        o_ref[...] = acc[...]
+        m_ref[...] = m_s[...]
+        l_ref[...] = l_s[...]
+
+
+def _flash_fwd_block(q, k, v, q_off, k_off, *, scale, causal,
+                     block_q, block_k, interpret):
+    """Partial flash attention of local q against one k/v ring block.
+
+    q: (BH, Tq, D); k, v: (BH, Tk, D); offsets are traced global starts.
+    Returns float32 (o_unnormalized (BH,Tq,D), m (BH,Tq,1), l (BH,Tq,1)).
+    """
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq, nk = tq // block_q, tk // block_k
+    offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j, *_: (b, i, 0)),
+            pl.BlockSpec((None, block_q, 1), lambda b, i, j, *_: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        partial(_fwd_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels (standard flash identities with saved logsumexp)
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    ik = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q_start = off_ref[0] + pl.program_id(1) * block_q
+    k_start = off_ref[1] + ik * block_k
+    should_run = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(should_run)
+    def _compute():
+        s = _scores(q_ref, k_ref, q_start, k_start, scale, causal,
+                    block_q, block_k)
+        p = jnp.exp(s - lse_ref[...])                        # (BQ, BK)
+        do = do_ref[...].astype(jnp.float32)
+        dp = lax.dot_general(do, v_ref[...].astype(jnp.float32), _TRANS_B,
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt_ref[...]) * scale
+        dq_acc[...] += lax.dot(ds, k_ref[...].astype(jnp.float32),
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _store():
+        dq_ref[...] = dq_acc[...]
+
+
+def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, block_q, block_k):
+    iq = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = off_ref[0] + iq * block_q
+    k_start = off_ref[1] + pl.program_id(1) * block_k
+    should_run = (q_start + block_q - 1 >= k_start) if causal else True
+
+    @pl.when(should_run)
+    def _compute():
+        s = _scores(q_ref, k_ref, q_start, k_start, scale, causal,
+                    block_q, block_k)
+        p = jnp.exp(s - lse_ref[...])
+        do = do_ref[...].astype(jnp.float32)
+        dv_acc[...] += lax.dot_general(p, do, _TRANS_A,
+                                       preferred_element_type=jnp.float32)
+        dp = lax.dot_general(do, v_ref[...].astype(jnp.float32), _TRANS_B,
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt_ref[...]) * scale
+        dk_acc[...] += lax.dot_general(ds, q_ref[...].astype(jnp.float32),
+                                       _TRANS_A,
+                                       preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _store():
+        dk_ref[...] = dk_acc[...]
+        dv_ref[...] = dv_acc[...]
+
+
+def _flash_bwd_block(q, k, v, do, lse, delta, q_off, k_off, *,
+                     scale, causal, block_q, block_k, interpret):
+    """One ring step of the backward pass: (dq, dk, dv) in float32."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    nq, nk = tq // block_q, tk // block_k
+    offs = jnp.stack([q_off, k_off]).astype(jnp.int32)
+
+    q_spec = pl.BlockSpec((None, block_q, d), lambda b, i, j, *_: (b, i, 0))
+    r_spec = pl.BlockSpec((None, block_q, 1), lambda b, i, j, *_: (b, i, 0))
+    k_spec = pl.BlockSpec((None, block_k, d), lambda b, i, j, *_: (b, j, 0))
+
+    dq = pl.pallas_call(
+        partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nq, nk),
+            in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+            out_specs=[q_spec],
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bh, tq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse, delta)[0]
+
+    # k-block-major grid: q tiles innermost so dk/dv accumulate in scratch
+    qi_spec = pl.BlockSpec((None, block_q, d), lambda b, j, i, *_: (b, i, 0))
+    ri_spec = pl.BlockSpec((None, block_q, 1), lambda b, j, i, *_: (b, i, 0))
+    kj_spec = pl.BlockSpec((None, block_k, d), lambda b, j, i, *_: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                block_q=block_q, block_k=block_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, nk, nq),
+            in_specs=[qi_spec, kj_spec, kj_spec, qi_spec, ri_spec, ri_spec],
+            out_specs=[kj_spec, kj_spec],
+            scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                            pltpu.VMEM((block_k, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
+                   jax.ShapeDtypeStruct((bh, tk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(offs, q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# ring orchestration (custom VJP at the ring level)
+# ---------------------------------------------------------------------------
+
+
+def _to_bhtd(x):
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _from_bhtd(x, b, h):
+    bh, t, d = x.shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _ring_forward(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+    size = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, t, h, d = q.shape
+    qf, kf, vf = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
+    q_off = my * t
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        src = (my - i) % size
+        o_b, m_b, l_b = _flash_fwd_block(
+            qf, k_cur, v_cur, q_off, src * t, scale=scale, causal=causal,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+        m_new = jnp.maximum(m, m_b)
+        a, a_b = jnp.exp(m - m_new), jnp.exp(m_b - m_new)
+        o = o * a + o_b * a_b
+        l = l * a + l_b * a_b
+        return (o, m_new, l, _shift(k_cur, axis), _shift(v_cur, axis)), None
+
+    o0 = jnp.zeros((b * h, t, d), jnp.float32)
+    m0 = jnp.full((b * h, t, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b * h, t, 1), jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, kf, vf),
+                                  jnp.arange(size))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l_safe).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return _from_bhtd(out, b, h), lse
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis, causal, scale, block_q, block_k, interpret):
+    out, _ = _ring_forward(q, k, v, axis, causal, scale,
+                           block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis, causal, scale, block_q, block_k,
+                    interpret):
+    out, lse = _ring_forward(q, k, v, axis, causal, scale,
+                             block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret,
+                    res, g):
+    q, k, v, out, lse = res
+    size = lax.axis_size(axis)
+    my = lax.axis_index(axis)
+    b, t, h, d = q.shape
+    qf, kf, vf = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
+    dof = _to_bhtd(g).astype(jnp.float32)
+    outf = _to_bhtd(out).astype(jnp.float32)
+    delta = jnp.sum(dof * outf, axis=-1, keepdims=True)
+    q_off = my * t
+
+    def step(carry, i):
+        dq, dk_cur, dv_cur, k_cur, v_cur = carry
+        src = (my - i) % size
+        dq_b, dk_b, dv_b = _flash_bwd_block(
+            qf, k_cur, v_cur, dof, lse, delta, q_off, src * t,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            interpret=interpret)
+        carry = (dq + dq_b, dk_cur + dk_b, dv_cur + dv_b,
+                 k_cur, v_cur)
+        # rotate the k/v blocks together with their accumulated grads;
+        # after `size` hops they are back home
+        return tuple(_shift(x, axis) if j >= 1 else x
+                     for j, x in enumerate(carry)), None
+
+    z_q = jnp.zeros((b * h, t, d), jnp.float32)
+    z_k = jnp.zeros_like(z_q)
+    (dq, dk, dv, _, _), _ = lax.scan(
+        step, (z_q, z_k, z_k, kf, vf), jnp.arange(size))
+    return (_from_bhtd(dq, b, h).astype(q.dtype),
+            _from_bhtd(dk, b, h).astype(k.dtype),
+            _from_bhtd(dv, b, h).astype(v.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_flash_attention(q, k, v, *, axis, causal=False, scale=None,
+                         block_q=128, block_k=128, interpret=None):
+    """Ring attention with Pallas flash kernels for the local blocks.
+
+    Same contract as :func:`mpi4jax_tpu.parallel.ring.ring_attention`:
+    q/k/v are ``(B, T_local, H, D)``, sequence sharded over mesh axis
+    ``axis``; returns the exact attention output, differentiable.
+    """
+    t = q.shape[1]
+    if scale is None:
+        scale = float(1.0 / (q.shape[-1] ** 0.5))
+    bq = pick_block(t, block_q)
+    bk = pick_block(t, block_k)
+    if interpret is None:
+        interpret = _interpret_default()
+    return _ring_flash(q, k, v, axis, bool(causal), float(scale),
+                       bq, bk, bool(interpret))

@@ -27,7 +27,9 @@ def _ring_shift(x, axis):
     return lax.ppermute(x, axis, [(i, (i + 1) % size) for i in range(size)])
 
 
-def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None):
+def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None,
+                   impl: str = "auto", block_q: int = 128,
+                   block_k: int = 128):
     """Exact (flash-accumulated) attention across a sequence-sharded ring.
 
     Args:
@@ -36,10 +38,37 @@ def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None):
         axis: mesh axis name carrying the sequence shards.
         causal: apply a causal mask over *global* positions.
         scale: score scale (default ``1/sqrt(D)``).
+        impl: ``"pallas"`` — local blocks via the Pallas flash kernel
+            (``ops/flash.py``, MXU + VMEM-resident online softmax);
+            ``"xla"`` — fused-einsum flash recurrence below; ``"auto"``
+            picks pallas.
+        block_q, block_k: Pallas tile sizes (clamped to divisors of
+            ``T_local``).
 
     Returns:
         ``(B, T_local, H, D)`` attention output, sequence-sharded like q.
     """
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown ring attention impl {impl!r}")
+    scale_traced = isinstance(scale, jax.core.Tracer)
+    if impl == "auto":
+        # Pallas pays off compiled on TPU; off-TPU it would run in the
+        # (slow) interpreter, and a traced scale cannot be a static
+        # kernel parameter — fall back to the XLA path for both.
+        from ..ops.flash import target_platform
+
+        impl = ("pallas" if target_platform() == "tpu"
+                and not scale_traced else "xla")
+    if impl == "pallas":
+        if scale_traced:
+            raise ValueError(
+                "impl='pallas' needs a static Python scale; got a traced "
+                "value (use impl='xla' for a learnable scale)")
+        from ..ops.flash import ring_flash_attention
+
+        return ring_flash_attention(
+            q, k, v, axis=axis, causal=causal, scale=scale,
+            block_q=block_q, block_k=block_k)
     size = lax.axis_size(axis)
     my_block = lax.axis_index(axis)
     b, t_loc, h, d = q.shape

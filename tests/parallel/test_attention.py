@@ -45,12 +45,15 @@ def _run_sharded(fn, mesh, *args):
     )(*args)
 
 
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_ring_attention_matches_dense(qkv, mesh, causal):
+def test_ring_attention_matches_dense(qkv, mesh, causal, impl):
     q, k, v = qkv
     expected = dense_attention(q, k, v, causal)
     got = _run_sharded(
-        lambda a, b_, c: ring_attention(a, b_, c, axis="sp", causal=causal),
+        lambda a, b_, c: ring_attention(
+            a, b_, c, axis="sp", causal=causal, impl=impl
+        ),
         mesh, q, k, v,
     )
     np.testing.assert_allclose(
@@ -73,12 +76,15 @@ def test_ulysses_attention_matches_dense(qkv, mesh, causal):
     )
 
 
-def test_ring_attention_grad(qkv, mesh):
+@pytest.mark.parametrize("impl", ["xla", "pallas"])
+def test_ring_attention_grad(qkv, mesh, impl):
     q, k, v = qkv
 
     def loss_ring(a, b_, c):
         out = _run_sharded(
-            lambda x, y, z: ring_attention(x, y, z, axis="sp", causal=True),
+            lambda x, y, z: ring_attention(
+                x, y, z, axis="sp", causal=True, impl=impl
+            ),
             mesh, a, b_, c,
         )
         return (out * out).sum()
