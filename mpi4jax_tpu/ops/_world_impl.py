@@ -34,6 +34,7 @@ from jax._src import core
 from jax._src import callback as _jax_callback
 from jax._src import dispatch as _jax_dispatch
 from jax._src.interpreters import mlir
+from jax._src.lib.mlir import ir
 from jax.interpreters import ad, batching
 
 from ..utils import tracing
@@ -92,7 +93,75 @@ def _make_primitive(name, out_aval_fn, host_fn):
         return results
 
     mlir.register_lowering(p, lowering)
+    p._callback_lowering = lowering
     return p
+
+
+# ---------------- native FFI fast path (cpu platform) ----------------
+#
+# On cpu the primitives lower to typed XLA FFI custom calls handled
+# natively (native/tpucomm_ffi.cc) — the modern analog of the reference's
+# Cython custom-call decoders (mpi_xla_bridge_cpu.pyx:20-209 there), with
+# scalar params as custom-call attributes instead of operand buffers.  The
+# ordered-effect token rides the call as a real operand/result, so ordering
+# is identical to the callback path.  On tpu the host-callback lowering
+# (HBM→host staging) remains in force.
+
+
+def _i64_attr(v):
+    return ir.IntegerAttr.get(ir.IntegerType.get_signless(64), int(v))
+
+
+def _i32_attr(v):
+    return ir.IntegerAttr.get(ir.IntegerType.get_signless(32), int(v))
+
+
+def _ffi_attrs(comm=None, op=None, **scalars):
+    attrs = {"comm": _i64_attr(comm.handle)}
+    if op is not None:
+        attrs["op"] = _i32_attr(_OP_CODE[op.name])
+    for name, value in scalars.items():
+        attrs[name] = _i32_attr(value)
+    return attrs
+
+
+def _emit_ffi_call(ctx, target, args, attrs):
+    token = ctx.tokens_in.get(comm_effect)
+    result_types = [mlir.token_type()] + [
+        mlir.aval_to_ir_type(a) for a in ctx.avals_out
+    ]
+    call = mlir.custom_call(
+        target,
+        result_types=result_types,
+        operands=[token, *args],
+        backend_config=attrs,
+        has_side_effect=True,
+        api_version=4,
+    )
+    token_out, *results = call.results
+    ctx.set_tokens_out(mlir.TokenSet({comm_effect: token_out}))
+    return results
+
+
+def _register_ffi_lowering(p, target, identity_param=None):
+    """cpu lowering: native FFI custom call, falling back to the host
+    callback when the fast path is unavailable or disabled.
+
+    ``identity_param`` names a boolean primitive param that short-circuits
+    the lowering to the identity (allreduce's transposed adjoint pass,
+    reference allreduce.py:87-89); it is never sent as an FFI attribute.
+    """
+
+    def lowering(ctx, *args, **params):
+        if identity_param is not None and params.pop(identity_param, False):
+            return [args[0]]  # identity pass, no communication
+        from ..runtime import bridge
+
+        if not bridge.ffi_available():
+            return p._callback_lowering(ctx, *args, **params)
+        return _emit_ffi_call(ctx, target, args, _ffi_attrs(**params))
+
+    mlir.register_lowering(p, lowering, platform="cpu")
 
 
 def _same_aval(x_aval, **params):
@@ -246,6 +315,10 @@ def _allreduce_lowering(ctx, x, *, comm, op, transpose=False):
 
 
 mlir.register_lowering(allreduce_p, _allreduce_lowering)
+allreduce_p._callback_lowering = _allreduce_lowering
+_register_ffi_lowering(
+    allreduce_p, "tpucomm_allreduce", identity_param="transpose"
+)
 reduce_p = _make_primitive("reduce", _same_aval, _host_reduce)
 scan_p = _make_primitive("scan", _same_aval, _host_scan)
 bcast_p = _make_primitive("bcast", _same_aval, _host_bcast)
@@ -267,6 +340,21 @@ def _unstacked_aval(x_aval, *, comm, **params):
 allgather_p = _make_primitive("allgather", _stacked_aval, _host_allgather)
 gather_p = _make_primitive("gather", _stacked_aval, _host_gather)
 scatter_p = _make_primitive("scatter", _unstacked_aval, _host_scatter)
+
+for _p, _target in (
+    (reduce_p, "tpucomm_reduce"),
+    (scan_p, "tpucomm_scan"),
+    (bcast_p, "tpucomm_bcast"),
+    (alltoall_p, "tpucomm_alltoall"),
+    (sendrecv_p, "tpucomm_sendrecv"),
+    (recv_p, "tpucomm_recv"),
+    (send_p, "tpucomm_send"),
+    (barrier_p, "tpucomm_barrier"),
+    (allgather_p, "tpucomm_allgather"),
+    (gather_p, "tpucomm_gather"),
+    (scatter_p, "tpucomm_scatter"),
+):
+    _register_ffi_lowering(_p, _target)
 
 
 # ---------------- AD rules (reference parity) ----------------

@@ -14,6 +14,9 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 SPMD ordering is our default, tokens the opt-in).
 - ``MPI4JAX_TPU_TRANSPORT``   — world-tier transport ("tcp" only for now).
 - ``MPI4JAX_TPU_NO_WARN_JAX_VERSION`` — silence the jax version check.
+- ``MPI4JAX_TPU_DISABLE_FFI`` — skip the native XLA FFI custom-call fast
+                                path on cpu and route world-tier ops through
+                                host callbacks instead (debug aid).
 """
 
 from __future__ import annotations
@@ -55,3 +58,7 @@ def prefer_token() -> bool:
 
 def transport_name() -> str:
     return setting("MPI4JAX_TPU_TRANSPORT", "tcp")
+
+
+def ffi_disabled() -> bool:
+    return flag("MPI4JAX_TPU_DISABLE_FFI")

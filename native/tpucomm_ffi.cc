@@ -1,0 +1,288 @@
+/* tpucomm_ffi — typed XLA FFI custom-call handlers for the world tier.
+ *
+ * The native fast path replacing the Python host-callback hop: each world
+ * tier primitive lowers (on the cpu platform) to a stablehlo.custom_call
+ * whose handler decodes buffers/attributes here and dispatches straight
+ * into the tpucomm transport (tpucomm.cc).  This is the C++ analog of the
+ * reference's Cython custom-call decoders
+ * (/root/reference/mpi4jax/_src/xla_bridge/mpi_xla_bridge_cpu.pyx:20-209,
+ * SURVEY.md §2.3) — scalar params travel as custom-call *attributes*
+ * (the modern FFI idiom) instead of operand buffers.
+ *
+ * Ordering: every handler takes and returns an XLA token, threaded by the
+ * framework's ordered CommEffect (ops/_world_impl.py), so program order of
+ * world ops is preserved exactly as with the callback path.
+ *
+ * Fail-fast: a nonzero transport return prints the same diagnostic the
+ * Python bridge does ("tpucomm_<Op> returned error code N") and hard-exits,
+ * matching runtime/bridge.py::_abort and the reference's abort_on_error →
+ * MPI_Abort contract (mpi_xla_bridge.pyx:67-91 there).
+ */
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <unistd.h>
+
+#include "xla/ffi/api/ffi.h"
+
+#include "tpucomm.h"
+
+namespace ffi = xla::ffi;
+
+namespace {
+
+/* XLA FFI element type → tpucomm wire code (utils/dtypes.py order). */
+int wire_dtype(ffi::DataType dt) {
+  switch (dt) {
+    case ffi::DataType::PRED: return TPU_BOOL;
+    case ffi::DataType::S8:   return TPU_I8;
+    case ffi::DataType::S16:  return TPU_I16;
+    case ffi::DataType::S32:  return TPU_I32;
+    case ffi::DataType::S64:  return TPU_I64;
+    case ffi::DataType::U8:   return TPU_U8;
+    case ffi::DataType::U16:  return TPU_U16;
+    case ffi::DataType::U32:  return TPU_U32;
+    case ffi::DataType::U64:  return TPU_U64;
+    case ffi::DataType::F16:  return TPU_F16;
+    case ffi::DataType::BF16: return TPU_BF16;
+    case ffi::DataType::F32:  return TPU_F32;
+    case ffi::DataType::F64:  return TPU_F64;
+    case ffi::DataType::C64:  return TPU_C64;
+    case ffi::DataType::C128: return TPU_C128;
+    default:                  return -1;
+  }
+}
+
+/* Same fail-fast contract as runtime/bridge.py::_abort: diagnostic line on
+ * stderr, then hard exit; peers observe dead sockets and abort in turn. */
+void check_abort(const char* opname, int rc) {
+  if (rc != 0) {
+    std::fprintf(stderr, "tpucomm_%s returned error code %d\n", opname, rc);
+    std::fflush(stderr);
+    _exit(1);
+  }
+}
+
+ffi::Error bad_dtype() {
+  return ffi::Error::InvalidArgument(
+      "tpucomm ffi: unsupported element type for reduction");
+}
+
+/* ---------------- reductions ---------------- */
+
+ffi::Error AllreduceImpl(ffi::Token, ffi::AnyBuffer x,
+                         ffi::Result<ffi::Token>,
+                         ffi::Result<ffi::AnyBuffer> out,
+                         int64_t comm, int32_t op) {
+  int dt = wire_dtype(x.element_type());
+  if (dt < 0) return bad_dtype();
+  check_abort("Allreduce",
+              tpucomm_allreduce(comm, x.untyped_data(), out->untyped_data(),
+                                (int64_t)x.element_count(), dt, op));
+  return ffi::Error::Success();
+}
+
+ffi::Error ReduceImpl(ffi::Token, ffi::AnyBuffer x,
+                      ffi::Result<ffi::Token>,
+                      ffi::Result<ffi::AnyBuffer> out,
+                      int64_t comm, int32_t op, int32_t root) {
+  int dt = wire_dtype(x.element_type());
+  if (dt < 0) return bad_dtype();
+  check_abort("Reduce",
+              tpucomm_reduce(comm, x.untyped_data(), out->untyped_data(),
+                             (int64_t)x.element_count(), dt, op, root));
+  return ffi::Error::Success();
+}
+
+ffi::Error ScanImpl(ffi::Token, ffi::AnyBuffer x,
+                    ffi::Result<ffi::Token>,
+                    ffi::Result<ffi::AnyBuffer> out,
+                    int64_t comm, int32_t op) {
+  int dt = wire_dtype(x.element_type());
+  if (dt < 0) return bad_dtype();
+  check_abort("Scan",
+              tpucomm_scan(comm, x.untyped_data(), out->untyped_data(),
+                           (int64_t)x.element_count(), dt, op));
+  return ffi::Error::Success();
+}
+
+/* ---------------- data movement ---------------- */
+
+ffi::Error BcastImpl(ffi::Token, ffi::AnyBuffer x,
+                     ffi::Result<ffi::Token>,
+                     ffi::Result<ffi::AnyBuffer> out,
+                     int64_t comm, int32_t root) {
+  /* in-place collective on the output (bridge.py::bcast copies first) */
+  std::memcpy(out->untyped_data(), x.untyped_data(), x.size_bytes());
+  check_abort("Bcast", tpucomm_bcast(comm, out->untyped_data(),
+                                     (int64_t)out->size_bytes(), root));
+  return ffi::Error::Success();
+}
+
+ffi::Error AllgatherImpl(ffi::Token, ffi::AnyBuffer x,
+                         ffi::Result<ffi::Token>,
+                         ffi::Result<ffi::AnyBuffer> out,
+                         int64_t comm) {
+  check_abort("Allgather",
+              tpucomm_allgather(comm, x.untyped_data(),
+                                (int64_t)x.size_bytes(),
+                                out->untyped_data()));
+  return ffi::Error::Success();
+}
+
+ffi::Error GatherImpl(ffi::Token, ffi::AnyBuffer x,
+                      ffi::Result<ffi::Token>,
+                      ffi::Result<ffi::AnyBuffer> out,
+                      int64_t comm, int32_t root) {
+  /* uniform output on all ranks, zeros off-root (bridge.py::gather) */
+  std::memset(out->untyped_data(), 0, out->size_bytes());
+  check_abort("Gather",
+              tpucomm_gather(comm, x.untyped_data(), (int64_t)x.size_bytes(),
+                             out->untyped_data(), root));
+  return ffi::Error::Success();
+}
+
+ffi::Error ScatterImpl(ffi::Token, ffi::AnyBuffer x,
+                       ffi::Result<ffi::Token>,
+                       ffi::Result<ffi::AnyBuffer> out,
+                       int64_t comm, int32_t root) {
+  check_abort("Scatter",
+              tpucomm_scatter(comm, x.untyped_data(), out->untyped_data(),
+                              (int64_t)out->size_bytes(), root));
+  return ffi::Error::Success();
+}
+
+ffi::Error AlltoallImpl(ffi::Token, ffi::AnyBuffer x,
+                        ffi::Result<ffi::Token>,
+                        ffi::Result<ffi::AnyBuffer> out,
+                        int64_t comm) {
+  int64_t rows = x.dimensions()[0];
+  int64_t chunk = rows ? (int64_t)x.size_bytes() / rows : 0;
+  check_abort("Alltoall", tpucomm_alltoall(comm, x.untyped_data(),
+                                           out->untyped_data(), chunk));
+  return ffi::Error::Success();
+}
+
+/* ---------------- point-to-point / sync ---------------- */
+
+ffi::Error BarrierImpl(ffi::Token, ffi::Result<ffi::Token>,
+                       ffi::Result<ffi::AnyBuffer> out, int64_t comm) {
+  check_abort("Barrier", tpucomm_barrier(comm));
+  std::memset(out->untyped_data(), 0, out->size_bytes());
+  return ffi::Error::Success();
+}
+
+ffi::Error SendImpl(ffi::Token, ffi::AnyBuffer x,
+                    ffi::Result<ffi::Token>,
+                    ffi::Result<ffi::AnyBuffer> out,
+                    int64_t comm, int32_t dest, int32_t tag) {
+  check_abort("Send", tpucomm_send(comm, x.untyped_data(),
+                                   (int64_t)x.size_bytes(), dest, tag));
+  std::memset(out->untyped_data(), 0, out->size_bytes());
+  return ffi::Error::Success();
+}
+
+ffi::Error RecvImpl(ffi::Token, ffi::AnyBuffer /* shape carrier */,
+                    ffi::Result<ffi::Token>,
+                    ffi::Result<ffi::AnyBuffer> out,
+                    int64_t comm, int32_t source, int32_t tag) {
+  check_abort("Recv", tpucomm_recv(comm, out->untyped_data(),
+                                   (int64_t)out->size_bytes(), source, tag));
+  return ffi::Error::Success();
+}
+
+ffi::Error SendrecvImpl(ffi::Token, ffi::AnyBuffer x,
+                        ffi::Result<ffi::Token>,
+                        ffi::Result<ffi::AnyBuffer> out,
+                        int64_t comm, int32_t source, int32_t dest,
+                        int32_t tag) {
+  check_abort("Sendrecv",
+              tpucomm_sendrecv(comm, x.untyped_data(),
+                               (int64_t)x.size_bytes(), dest,
+                               out->untyped_data(),
+                               (int64_t)out->size_bytes(), source, tag));
+  return ffi::Error::Success();
+}
+
+}  // namespace
+
+/* Handler symbols, loaded by runtime/bridge.py via ctypes and registered
+ * with jax.ffi.register_ffi_target (≙ the reference's
+ * xla_client.register_custom_call_target loop, xla_bridge/__init__.py:26-31
+ * there). */
+
+#define TPUCOMM_BIND() ffi::Ffi::Bind().Arg<ffi::Token>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommAllreduceFfi, AllreduceImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("op"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommReduceFfi, ReduceImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("op").Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommScanFfi, ScanImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("op"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommBcastFfi, BcastImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommAllgatherFfi, AllgatherImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommGatherFfi, GatherImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommScatterFfi, ScatterImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommAlltoallFfi, AlltoallImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommBarrierFfi, BarrierImpl,
+    TPUCOMM_BIND()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommSendFfi, SendImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("dest").Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommRecvFfi, RecvImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("source").Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommSendrecvFfi, SendrecvImpl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("source").Attr<int32_t>("dest")
+        .Attr<int32_t>("tag"));

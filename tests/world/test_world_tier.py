@@ -41,6 +41,16 @@ def test_basic_ops(np_):
     assert res.stdout.count("basic_ops OK") == np_
 
 
+@pytest.mark.parametrize("ffi", ["on", "off"])
+def test_ffi_fast_path(ffi):
+    # native custom calls used when available; callback fallback under the
+    # kill switch — identical numerics either way
+    env = {"MPI4JAX_TPU_DISABLE_FFI": "1"} if ffi == "off" else None
+    res = run_launcher("ffi_path.py", 2, env_extra=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count(f"ffi_path OK (ffi={ffi})") == 2
+
+
 def test_ordering():
     res = run_launcher("ordering.py", 2)
     assert res.returncode == 0, res.stderr + res.stdout
