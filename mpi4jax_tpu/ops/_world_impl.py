@@ -419,8 +419,51 @@ ad.primitive_transposes[sendrecv_p] = _sendrecv_transpose
 # batching where the op is elementwise across the batch axis (reference
 # scope: allreduce/barrier/sendrecv, allreduce.py:182-185, barrier.py:120-123,
 # sendrecv.py:316-343; bcast/reduce/scan are elementwise too and included)
-for _p in (allreduce_p, reduce_p, scan_p, bcast_p, sendrecv_p):
+for _p in (allreduce_p, reduce_p, scan_p, bcast_p, sendrecv_p, recv_p):
     _elementwise_batching(_p)
+
+
+# shape-changing ops batch too (the reference supports none of these —
+# SURVEY.md §2.1 lists batching only for allreduce/barrier/sendrecv).  The
+# batch axis rides inside the communicated payload, so one message still
+# moves the whole batch:
+
+
+def _stacking_batching(p):
+    # out = (size, *in): the stacking axis is prepended, pushing the batch
+    # axis one position right
+    def rule(batched_args, batch_dims, **params):
+        (x,), (bd,) = batched_args, batch_dims
+        return p.bind(x, **params), bd + 1
+
+    batching.primitive_batchers[p] = rule
+
+
+def _leading_axis_batching(p, out_bd):
+    # ops constrained to a (size, ...) leading axis: move the batch axis to
+    # position 1 so the per-rank slicing on axis 0 is undisturbed
+    def rule(batched_args, batch_dims, **params):
+        (x,), (bd,) = batched_args, batch_dims
+        x = jnp.moveaxis(x, bd, 1)
+        return p.bind(x, **params), out_bd
+
+    batching.primitive_batchers[p] = rule
+
+
+_stacking_batching(allgather_p)
+_stacking_batching(gather_p)
+_leading_axis_batching(alltoall_p, out_bd=1)  # out same shape as in
+_leading_axis_batching(scatter_p, out_bd=0)   # out drops axis 0
+
+
+def _send_batching(batched_args, batch_dims, **params):
+    # the batch rides inside the one message; the scalar completion value
+    # is unbatched
+    (x,), (_,) = batched_args, batch_dims
+    return send_p.bind(x, **params), batching.not_mapped
+
+
+batching.primitive_batchers[send_p] = _send_batching
 
 
 # ---------------- public entry points (called from op modules) -----------

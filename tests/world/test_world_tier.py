@@ -51,6 +51,12 @@ def test_ffi_fast_path(ffi):
     assert res.stdout.count(f"ffi_path OK (ffi={ffi})") == 2
 
 
+def test_vmap_ops():
+    res = run_launcher("vmap_ops.py", 2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("vmap_ops OK") == 2
+
+
 def test_ordering():
     res = run_launcher("ordering.py", 2)
     assert res.returncode == 0, res.stderr + res.stdout

@@ -1,0 +1,114 @@
+"""vmap over world-tier ops, including the shape-changing ones.
+
+The reference batches only allreduce/barrier/sendrecv (SURVEY.md §2.1);
+here every op batches: the batch axis rides inside the communicated
+payload, so a vmapped collective still issues ONE message.  Each vmapped
+result is checked against the per-slice loop of the unbatched op.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+    B, N = 3, 4
+
+    x = (
+        jnp.arange(B * N, dtype=jnp.float32).reshape(B, N) + 100 * rank
+    )
+
+    # allreduce (parity with reference scope) — vmap == loop
+    vm = jax.vmap(lambda v: m4j.allreduce(v, op=m4j.SUM, comm=comm))(x)
+    loop = jnp.stack(
+        [m4j.allreduce(x[i], op=m4j.SUM, comm=comm) for i in range(B)]
+    )
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(loop))
+
+    # allgather: out (size, N) per slice → vmapped out (B, size, N)
+    vm = jax.vmap(lambda v: m4j.allgather(v, comm=comm))(x)
+    assert vm.shape == (B, size, N), vm.shape
+    loop = jnp.stack([m4j.allgather(x[i], comm=comm) for i in range(B)])
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(loop))
+
+    # gather (root-valid only; off-root is zeros on both paths)
+    vm = jax.vmap(lambda v: m4j.gather(v, root=0, comm=comm))(x)
+    assert vm.shape == (B, size, N)
+    loop = jnp.stack([m4j.gather(x[i], root=0, comm=comm) for i in range(B)])
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(loop))
+
+    # alltoall: per-slice input (size, 2), batched (B, size, 2)
+    a2a_in = (
+        jnp.arange(B * size * 2, dtype=jnp.float32).reshape(B, size, 2)
+        + 1000 * rank
+    )
+    vm = jax.vmap(lambda v: m4j.alltoall(v, comm=comm))(a2a_in)
+    loop = jnp.stack(
+        [m4j.alltoall(a2a_in[i], comm=comm) for i in range(B)]
+    )
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(loop))
+
+    # scatter: per-slice input (size, 2), out (2,) → batched out (B, 2)
+    sc_in = jnp.tile(
+        jnp.arange(size, dtype=jnp.float32)[None, :, None], (B, 1, 2)
+    ) + jnp.arange(B, dtype=jnp.float32)[:, None, None]
+    vm = jax.vmap(lambda v: m4j.scatter(v, root=0, comm=comm))(sc_in)
+    assert vm.shape == (B, 2)
+    loop = jnp.stack(
+        [m4j.scatter(sc_in[i], root=0, comm=comm) for i in range(B)]
+    )
+    np.testing.assert_allclose(np.asarray(vm), np.asarray(loop))
+
+    # non-zero batch axis: batch on axis 1
+    xt = x.T  # (N, B)
+    vm = jax.vmap(
+        lambda v: m4j.allgather(v, comm=comm), in_axes=1, out_axes=0
+    )(xt)
+    np.testing.assert_allclose(
+        np.asarray(vm),
+        np.asarray(
+            jnp.stack([m4j.allgather(xt[:, i], comm=comm) for i in range(B)])
+        ),
+    )
+
+    # vmap ∘ jit with mixed ops
+    vm = jax.vmap(
+        jax.jit(
+            lambda v: m4j.allreduce(
+                m4j.bcast(v, root=0, comm=comm), op=m4j.SUM, comm=comm
+            )
+        )
+    )(x)
+    assert vm.shape == (B, N)
+
+    # batched send/recv pair: one message carries the whole batch
+    if rank == 0:
+        jax.vmap(lambda v: m4j.send(v, dest=1, comm=comm))(x)
+    elif rank == 1:
+        # NB: the dummy must itself be batched (zeros_like inside the
+        # vmapped fn would make an unbatched constant and recv once)
+        got = jax.vmap(lambda v: m4j.recv(v, source=0, comm=comm))(
+            jnp.zeros_like(x)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got),
+            np.arange(B * N, dtype=np.float32).reshape(B, N),
+        )
+
+    print(f"rank {rank}: vmap_ops OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
