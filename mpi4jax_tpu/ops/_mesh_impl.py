@@ -38,8 +38,23 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import config as _config
 from ..utils import dtypes as _dtypes
 from .reduce_ops import ReduceOp, SUM
+
+
+def _pallas_ring(axis):
+    """True when the Pallas RDMA fast path should handle this collective:
+    opt-in flag set, ``axis`` is a single named axis, and the global
+    logical device id of a ring neighbor is computable (every mesh axis
+    bound — see ``pallas_collectives.can_route``).  Under the flag the
+    routed ops are reverse-mode differentiable only (fwd-mode raises,
+    like the reference's sendrecv, sendrecv.py:150-155 there)."""
+    if not _config.pallas_collectives_enabled():
+        return False
+    from . import pallas_collectives as _pc
+
+    return _pc.can_route(axis)
 
 
 def _rank(axis):
@@ -88,6 +103,10 @@ def allreduce(x, op: ReduceOp, axis):
     op.check_dtype(x.dtype)
     x = as_varying(x, axis)
     if op.lax_kind == "sum":
+        if _pallas_ring(axis) and x.dtype != jnp.bool_:
+            from . import pallas_collectives as _pc
+
+            return _pc.allreduce_sum(x, axis)
         return lax.psum(x, axis)
     if op.lax_kind == "max":
         return lax.pmax(x, axis)
@@ -110,7 +129,12 @@ def allreduce(x, op: ReduceOp, axis):
 
 
 def allgather(x, axis):
-    return lax.all_gather(as_varying(x, axis), axis, axis=0, tiled=False)
+    x = as_varying(x, axis)
+    if _pallas_ring(axis):
+        from . import pallas_collectives as _pc
+
+        return _pc.all_gather(x, axis)
+    return lax.all_gather(x, axis, axis=0, tiled=False)
 
 
 def alltoall(x, axis):
@@ -192,7 +216,14 @@ def sendrecv(x, perm, axis):
     (/root/reference/mpi4jax/_src/collective_ops/sendrecv.py:46-125).  Ranks
     not appearing as a destination receive zeros.
     """
-    return lax.ppermute(as_varying(x, axis), axis, perm)
+    x = as_varying(x, axis)
+    if _pallas_ring(axis):
+        from . import pallas_collectives as _pc
+
+        k = _pc.ring_shift_of(perm, _size(axis))
+        if k is not None:
+            return _pc.ring_shift(x, axis, k)
+    return lax.ppermute(x, axis, perm)
 
 
 def barrier(axis, tie=None):

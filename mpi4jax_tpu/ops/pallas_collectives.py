@@ -1,0 +1,357 @@
+"""Pallas RDMA ring collectives — direct inter-chip DMA fast path (opt-in).
+
+The mesh tier normally lowers collectives to XLA's fused ICI collectives
+(``lax.psum`` / ``all_gather`` / ``ppermute`` — see ``_mesh_impl.py``).  This
+module provides the same semantics over *explicit* Pallas async remote DMA
+(``pltpu.make_async_remote_copy``), the TPU-native analog of the reference's
+hand-rolled transport layer (its Cython bridge drives libmpi directly,
+reference ``mpi4jax/_src/xla_bridge/mpi_xla_bridge_gpu.pyx:233-251``; here
+the "transport" is the ICI DMA engine and the "rank" is a mesh position).
+
+Why it exists:
+
+* it gives the framework a handle on the wire protocol (chunking, direction,
+  overlap) that XLA's builtin collectives don't expose — the extension point
+  for fused communication/compute kernels (see ``ops/flash.py`` for the
+  attention instance of that idea);
+* it proves the ordering story holds without XLA's collective scheduler in
+  the loop — each hop is an explicit semaphore-paired DMA.
+
+Design: ONE kernel (``_ring_shift_kernel``) does one RDMA hop; every
+collective is composed from hops in plain JAX so XLA still owns the compute
+between hops (reductions, slot assembly) and can overlap it with the next
+launch.  The ring algorithms are the classical bandwidth-optimal ones
+(reduce-scatter + all-gather, as in the native world-tier ring in
+``native/tpucomm.cc``).
+
+All functions must be called inside ``shard_map`` with ``axis`` bound, like
+everything in ``_mesh_impl``.  Off-TPU they run under Pallas TPU interpret
+mode so the CPU test mesh exercises the identical code path.
+
+Opt-in routing: set ``MPI4JAX_TPU_PALLAS_COLLECTIVES=1`` and the mesh tier
+routes allreduce(SUM)/allgather/ring-sendrecv through this module (see
+``_mesh_impl``); or call these functions directly.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .flash import target_platform
+
+
+def _interpret(flag):
+    if flag is None:
+        flag = target_platform() != "tpu"
+    return pltpu.InterpretParams() if flag else False
+
+
+# ---------------------------------------------------------------------------
+# the one kernel: a single ring hop
+# ---------------------------------------------------------------------------
+
+
+def _ring_shift_kernel(dst_ref, x_ref, o_ref, send_sem, recv_sem):
+    """Send the local shard to rank ``dst_ref[0]``; receive symmetrically.
+
+    The destination is computed *outside* the kernel (it is a varying value
+    — ``axis_index`` arithmetic — which the VMA checker tracks in plain JAX
+    but not inside kernel bodies) and arrives as an SMEM scalar.
+    """
+    rdma = pltpu.make_async_remote_copy(
+        src_ref=x_ref,
+        dst_ref=o_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=dst_ref[0],
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rdma.start()
+    rdma.wait()
+
+
+def _dst_logical(axis, shift):
+    """Global LOGICAL device id of rank ``me + shift`` on the ring ``axis``.
+
+    LOGICAL ids linearize the *whole* mesh (row-major over ``axis_names``),
+    so on a multi-dimensional mesh the neighbor's id depends on this
+    device's coordinate on every other axis too — shifting only the ring
+    axis's coordinate.  Raises if any mesh axis is not bound (e.g. a
+    partially-manual shard_map); callers route through ``can_route`` first.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    names = tuple(mesh.axis_names)
+    if axis not in names:
+        n = lax.axis_size(axis)
+        return jnp.mod(lax.axis_index(axis) + shift, n).astype(jnp.int32)
+    flat = jnp.zeros((), jnp.int32)
+    for name in names:
+        size = mesh.shape[name]
+        i = lax.axis_index(name)
+        if name == axis:
+            i = jnp.mod(i + shift, size)
+        flat = flat * size + i
+    return flat.astype(jnp.int32)
+
+
+def can_route(axis) -> bool:
+    """True when the DMA path can address the ring: single named axis and
+    every mesh axis manual (so the global logical id is computable)."""
+    if not isinstance(axis, str):
+        return False
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        for name in mesh.axis_names:
+            lax.axis_index(name)
+        lax.axis_index(axis)
+        return True
+    except Exception:
+        return False
+
+
+def _vma_checked():
+    # jax tracks varying-axes only under checked shard_map; the switch is
+    # private, so fail open (assume checked — it is the default) and let the
+    # TypeError fallback below absorb any future API change.
+    try:
+        from jax._src import config as _jcfg
+
+        return bool(_jcfg._check_vma.value)
+    except Exception:
+        return True
+
+
+def _out_struct(x, axis):
+    if _vma_checked():
+        vma = frozenset(getattr(jax.typeof(x), "vma", frozenset())) | {axis}
+        try:
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
+        except TypeError:
+            pass
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+def _ring_shift_impl(x, axis, shift, interpret):
+    dst = _dst_logical(axis, shift)[None]
+    return pl.pallas_call(
+        _ring_shift_kernel,
+        out_shape=_out_struct(x, axis),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        interpret=_interpret(interpret),
+    )(dst, x)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _ring_shift_d(x, axis, shift, interpret):
+    return _ring_shift_impl(x, axis, shift, interpret)
+
+
+def _ring_shift_fwd(x, axis, shift, interpret):
+    return _ring_shift_impl(x, axis, shift, interpret), None
+
+
+def _ring_shift_bwd(axis, shift, interpret, _, g):
+    # the cotangent flows backward along the message edge — the source/dest
+    # swap of the reference's sendrecv transpose rule
+    # (/root/reference/mpi4jax/_src/collective_ops/sendrecv.py:390-409)
+    return (_ring_shift_impl(g, axis, -shift, interpret),)
+
+
+_ring_shift_d.defvjp(_ring_shift_fwd, _ring_shift_bwd)
+
+
+def ring_shift(x, axis, shift: int = 1, *, interpret=None):
+    """One RDMA hop around the ring: returns the shard of rank ``me - shift``.
+
+    Equivalent to ``lax.ppermute(x, axis, ring_perm(n, shift))`` but executed
+    as an explicit paired-semaphore remote DMA.  ``shift`` is static.
+
+    Reverse-mode differentiable (transpose = shift by ``-shift``); fwd-mode
+    raises, matching the reference's sendrecv contract
+    (sendrecv.py:150-155 there).
+    """
+    if shift == 0:
+        return x
+    return _ring_shift_d(x, axis, shift, interpret)
+
+
+# ---------------------------------------------------------------------------
+# collectives composed from hops
+# ---------------------------------------------------------------------------
+
+
+def _all_gather_impl(x, axis, interpret):
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    if n == 1:
+        return x[None]
+
+    def hop(cur, _):
+        nxt = _ring_shift_impl(cur, axis, 1, interpret)
+        return nxt, nxt
+
+    # After s hops the carried shard originated at rank (me - s) % n.
+    _, received = lax.scan(hop, x, None, length=n - 1)
+    stacked = jnp.concatenate([x[None], received], axis=0)
+    # stacked[s] is rank (me - s)'s shard; row j of the result wants rank j's
+    # shard, i.e. s = (me - j) % n.
+    src = jnp.mod(me - jnp.arange(n), n)
+    return jnp.take(stacked, src, axis=0)
+
+
+def _reduce_scatter_impl(x, axis, interpret):
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    if x.shape[0] % n:
+        raise ValueError(
+            f"reduce_scatter_sum requires leading axis divisible by the ring "
+            f"size ({n}), got shape {x.shape}"
+        )
+    if n == 1:
+        return x
+    view = x.reshape((n, x.shape[0] // n) + x.shape[1:])
+
+    def chunk(s):
+        return jnp.take(view, jnp.mod(me - 1 - s, n), axis=0)
+
+    def step(partial_, s):
+        recv = _ring_shift_impl(partial_, axis, 1, interpret)
+        return chunk(s) + recv, None
+
+    out, _ = lax.scan(step, chunk(0), jnp.arange(1, n))
+    return out
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _all_gather_d(x, axis, interpret):
+    return _all_gather_impl(x, axis, interpret)
+
+
+def _all_gather_fwd(x, axis, interpret):
+    return _all_gather_impl(x, axis, interpret), x.shape
+
+
+def _all_gather_bwd(axis, interpret, x_shape, g):
+    # y_r[j] = x_j on every rank r, so dx = sum_r g_r[me]: exactly this
+    # rank's chunk of a reduce-scatter over the stacked cotangent rows
+    # (row boundaries and chunk boundaries coincide after flattening).
+    dx = _reduce_scatter_impl(g.reshape((g.size,)), axis, interpret)
+    return (dx.reshape(x_shape),)
+
+
+_all_gather_d.defvjp(_all_gather_fwd, _all_gather_bwd)
+
+
+def all_gather(x, axis, *, interpret=None):
+    """Ring all-gather: returns ``(n, *x.shape)``, row r = rank r's shard.
+
+    n-1 hops of one shard each — the bandwidth-optimal schedule (each byte
+    crosses each link exactly once), matching ``lax.all_gather`` semantics
+    (reference op: ``mpi4jax/_src/collective_ops/allgather.py``).
+    Reverse-mode differentiable (transpose = reduce-scatter); fwd-mode
+    raises (⊃ the reference, whose allgather has no autodiff at all).
+    """
+    return _all_gather_d(x, axis, interpret)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _reduce_scatter_d(x, axis, interpret):
+    return _reduce_scatter_impl(x, axis, interpret)
+
+
+def _reduce_scatter_fwd(x, axis, interpret):
+    return _reduce_scatter_impl(x, axis, interpret), x.shape
+
+
+def _reduce_scatter_bwd(axis, interpret, x_shape, g):
+    # y_me = sum_r x_r[chunk me] ⇒ dx[chunk j] = g_j: an all-gather of the
+    # per-rank cotangent chunks laid back out along the leading axis.
+    rows = _all_gather_impl(g, axis, interpret)
+    return (rows.reshape(x_shape),)
+
+
+_reduce_scatter_d.defvjp(_reduce_scatter_fwd, _reduce_scatter_bwd)
+
+
+def reduce_scatter_sum(x, axis, *, interpret=None):
+    """Ring reduce-scatter (SUM): ``x`` is ``(n*c, ...)``; returns this
+    rank's fully-reduced chunk ``(c, ...)`` (chunk index = rank).
+
+    Classical ring: at step s each rank forwards the partial for chunk
+    ``(me - 1 - s) % n``, adding its own contribution as the partial passes
+    through — after n-1 hops chunk ``me`` has visited every rank.
+    Reverse-mode differentiable (transpose = all-gather); fwd-mode raises.
+    """
+    return _reduce_scatter_d(x, axis, interpret)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def allreduce_sum(x, axis):
+    """Ring allreduce (SUM) = reduce-scatter + all-gather over RDMA hops.
+
+    Semantics match ``lax.psum(x, axis)`` / the mesh tier's allreduce-SUM
+    (reference op: ``mpi4jax/_src/collective_ops/allreduce.py:41-76``); like
+    the reference's autodiff support it is SUM-only, and the cotangent of an
+    allreduce-SUM is again an allreduce-SUM (``allreduce.py:188-218``).
+    """
+    return _allreduce_sum(x, axis)
+
+
+def _allreduce_sum(x, axis, *, interpret=None):
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    mine = reduce_scatter_sum(flat, axis, interpret=interpret)
+    full = all_gather(mine, axis, interpret=interpret).reshape(-1)
+    if pad:
+        full = full[: flat.shape[0] - pad]
+    return full.reshape(x.shape)
+
+
+def _allreduce_fwd(x, axis):
+    return _allreduce_sum(x, axis), None
+
+
+def _allreduce_bwd(axis, _, g):
+    return (_allreduce_sum(g, axis),)
+
+
+allreduce_sum.defvjp(_allreduce_fwd, _allreduce_bwd)
+
+
+# ---------------------------------------------------------------------------
+# mesh-tier routing helpers
+# ---------------------------------------------------------------------------
+
+
+def ring_shift_of(perm, size: int):
+    """If ``perm`` is exactly the ring pattern ``i -> (i+k) % n`` for some
+    nonzero k, return k; else None.  Used by the mesh tier to route eligible
+    ``sendrecv`` permutations through the DMA path."""
+    pairs = set((int(a), int(b)) for a, b in perm)
+    if len(pairs) != size:
+        return None
+    shifts = set((b - a) % size for a, b in pairs)
+    if len(shifts) != 1:
+        return None
+    k = shifts.pop()
+    if k == 0:
+        return None
+    if pairs != {(i, (i + k) % size) for i in range(size)}:
+        return None
+    return k

@@ -17,6 +17,11 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
 - ``MPI4JAX_TPU_DISABLE_FFI`` — skip the native XLA FFI custom-call fast
                                 path on cpu and route world-tier ops through
                                 host callbacks instead (debug aid).
+- ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
+                                (allreduce-SUM, allgather, ring sendrecv)
+                                through the Pallas RDMA ring kernels
+                                (``ops/pallas_collectives.py``) instead of
+                                XLA's builtin collectives.
 """
 
 from __future__ import annotations
@@ -62,3 +67,7 @@ def transport_name() -> str:
 
 def ffi_disabled() -> bool:
     return flag("MPI4JAX_TPU_DISABLE_FFI")
+
+
+def pallas_collectives_enabled() -> bool:
+    return flag("MPI4JAX_TPU_PALLAS_COLLECTIVES")
