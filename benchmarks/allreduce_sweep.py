@@ -4,10 +4,13 @@ Effective bandwidth is reported ring-style: ``2*(n-1)/n * bytes / time``
 per chip.  Runs on whatever devices are visible (real TPUs or the virtual
 CPU mesh); one JSON line per message size.
 
-    python benchmarks/allreduce_sweep.py [--max-mb 256] [--world]
+    python benchmarks/allreduce_sweep.py [--max-mb 256] [--world] [--pallas]
 
 ``--world`` benchmarks the world tier (native transport) instead, under
-the launcher.
+the launcher.  ``--pallas`` benchmarks the Pallas RDMA ring collectives
+(``ops/pallas_collectives.py``) — on TPU meshes this times the real
+inter-chip DMA kernels; off-TPU they run interpreted and the numbers only
+establish correctness-path overhead.
 """
 
 import argparse
@@ -19,7 +22,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def mesh_tier_sweep(max_bytes):
+def mesh_tier_sweep(max_bytes, pallas=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -33,9 +36,16 @@ def mesh_tier_sweep(max_bytes):
     while size <= max_bytes:
         n = size // 4
         x = jnp.ones((ndev * n,), jnp.float32)
-        fn = jax.jit(
-            m4j.spmd(lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh)
-        )
+        if pallas:
+            from mpi4jax_tpu.ops import pallas_collectives as pc
+
+            fn = jax.jit(
+                m4j.spmd(lambda v: pc.allreduce_sum(v, "mpi"), mesh=mesh)
+            )
+        else:
+            fn = jax.jit(
+                m4j.spmd(lambda v: m4j.allreduce(v, op=m4j.SUM), mesh=mesh)
+            )
         jax.block_until_ready(fn(x))  # compile + warmup
         reps = max(3, min(50, int(2e8 / max(size, 1))))
         t0 = time.perf_counter()
@@ -45,7 +55,8 @@ def mesh_tier_sweep(max_bytes):
         dt = (time.perf_counter() - t0) / reps
         eff = 2 * (ndev - 1) / ndev * size / dt / 1e9 if ndev > 1 else size / dt / 1e9
         rec = {
-            "op": "allreduce", "tier": "mesh", "devices": ndev,
+            "op": "allreduce", "tier": "pallas" if pallas else "mesh",
+            "devices": ndev,
             "bytes": size, "seconds": round(dt, 9),
             "eff_GBps_per_chip": round(eff, 3),
             "platform": jax.devices()[0].platform,
@@ -92,9 +103,12 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-mb", type=float, default=64)
     ap.add_argument("--world", action="store_true")
+    ap.add_argument("--pallas", action="store_true")
     args = ap.parse_args()
+    if args.world and args.pallas:
+        ap.error("--pallas applies to the mesh tier; drop --world")
     max_bytes = int(args.max_mb * 1e6)
     if args.world:
         world_tier_rank(max_bytes)
     else:
-        mesh_tier_sweep(max_bytes)
+        mesh_tier_sweep(max_bytes, pallas=args.pallas)

@@ -103,7 +103,7 @@ def allreduce(x, op: ReduceOp, axis):
     op.check_dtype(x.dtype)
     x = as_varying(x, axis)
     if op.lax_kind == "sum":
-        if _pallas_ring(axis) and x.dtype != jnp.bool_:
+        if _pallas_ring(axis):
             from . import pallas_collectives as _pc
 
             return _pc.allreduce_sum(x, axis)

@@ -76,6 +76,22 @@ def _ring_shift_kernel(dst_ref, x_ref, o_ref, send_sem, recv_sem):
     rdma.wait()
 
 
+def _dst_logical_at(axis, coord):
+    """Global LOGICAL device id of the device whose ``axis`` coordinate is
+    ``coord`` (traced) and whose other mesh coordinates equal this
+    device's."""
+    mesh = jax.sharding.get_abstract_mesh()
+    names = tuple(mesh.axis_names)
+    if axis not in names:
+        return jnp.asarray(coord, jnp.int32)
+    flat = jnp.zeros((), jnp.int32)
+    for name in names:
+        size = mesh.shape[name]
+        i = jnp.asarray(coord) if name == axis else lax.axis_index(name)
+        flat = flat * size + i
+    return flat.astype(jnp.int32)
+
+
 def _dst_logical(axis, shift):
     """Global LOGICAL device id of rank ``me + shift`` on the ring ``axis``.
 
@@ -137,8 +153,10 @@ def _out_struct(x, axis):
     return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
 
-def _ring_shift_impl(x, axis, shift, interpret):
-    dst = _dst_logical(axis, shift)[None]
+def _send_to(x, axis, dst, interpret):
+    """One paired-DMA hop to the (traced) logical device id ``dst``.  The
+    pairing contract: whichever device's hop targets *us* fills our
+    output buffer; with ring shifts and XOR partners that is guaranteed."""
     return pl.pallas_call(
         _ring_shift_kernel,
         out_shape=_out_struct(x, axis),
@@ -149,7 +167,17 @@ def _ring_shift_impl(x, axis, shift, interpret):
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
         interpret=_interpret(interpret),
-    )(dst, x)
+    )(dst[None], x)
+
+
+def _ring_shift_impl(x, axis, shift, interpret):
+    return _send_to(x, axis, _dst_logical(axis, shift), interpret)
+
+
+def _exchange_impl(x, axis, partner_coord, interpret):
+    """Pairwise exchange with the device at ``partner_coord`` on ``axis``
+    (the butterfly step; the partner relation must be an involution)."""
+    return _send_to(x, axis, _dst_logical_at(axis, partner_coord), interpret)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
@@ -191,6 +219,74 @@ def ring_shift(x, axis, shift: int = 1, *, interpret=None):
 # ---------------------------------------------------------------------------
 
 
+def _ring_shift2_kernel(dsts_ref, a_ref, b_ref, oa_ref, ob_ref,
+                        send_a, recv_a, send_b, recv_b):
+    """Two simultaneous hops — ``a`` to the right neighbor, ``b`` to the
+    left — with both DMAs in flight before either wait, so the two ICI
+    link directions carry traffic concurrently (the bidirectional-ring
+    trick; a single ``lax.ppermute`` cannot express it)."""
+    rd_a = pltpu.make_async_remote_copy(
+        src_ref=a_ref, dst_ref=oa_ref, send_sem=send_a, recv_sem=recv_a,
+        device_id=dsts_ref[0], device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rd_b = pltpu.make_async_remote_copy(
+        src_ref=b_ref, dst_ref=ob_ref, send_sem=send_b, recv_sem=recv_b,
+        device_id=dsts_ref[1], device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    rd_a.start()
+    rd_b.start()
+    rd_a.wait()
+    rd_b.wait()
+
+
+def _ring_shift2_impl(a, b, axis, interpret):
+    dsts = jnp.stack([_dst_logical(axis, 1), _dst_logical(axis, -1)])
+    return pl.pallas_call(
+        _ring_shift2_kernel,
+        out_shape=(_out_struct(a, axis), _out_struct(b, axis)),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 4,
+        interpret=_interpret(interpret),
+    )(dsts, a, b)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _ring_shift2_d(a, b, axis, interpret):
+    return _ring_shift2_impl(a, b, axis, interpret)
+
+
+def _ring_shift2_fwd(a, b, axis, interpret):
+    return _ring_shift2_impl(a, b, axis, interpret), None
+
+
+def _ring_shift2_bwd(axis, interpret, _, g):
+    # our `a` went right, so its cotangent comes back from the right
+    # neighbor (and b's from the left): one bidirectional hop with the
+    # payloads swapped onto the opposite directions
+    ga, gb = g
+    back_b, back_a = _ring_shift2_impl(gb, ga, axis, interpret)
+    return (back_a, back_b)
+
+
+_ring_shift2_d.defvjp(_ring_shift2_fwd, _ring_shift2_bwd)
+
+
+def ring_shift2(a, b, axis, *, interpret=None):
+    """One bidirectional ring step: returns ``(a', b')`` where ``a'`` is the
+    left neighbor's ``a`` (data moved right) and ``b'`` the right
+    neighbor's ``b`` (data moved left).  Reverse-mode differentiable;
+    fwd-mode raises."""
+    return _ring_shift2_d(a, b, axis, interpret)
+
+
 def _all_gather_impl(x, axis, interpret):
     n = lax.axis_size(axis)
     me = lax.axis_index(axis)
@@ -204,10 +300,16 @@ def _all_gather_impl(x, axis, interpret):
     # After s hops the carried shard originated at rank (me - s) % n.
     _, received = lax.scan(hop, x, None, length=n - 1)
     stacked = jnp.concatenate([x[None], received], axis=0)
-    # stacked[s] is rank (me - s)'s shard; row j of the result wants rank j's
-    # shard, i.e. s = (me - j) % n.
+    # stacked[s] is rank (me - s)'s shard; row j of the result wants rank
+    # j's shard, i.e. s = (me - j) % n.
     src = jnp.mod(me - jnp.arange(n), n)
     return jnp.take(stacked, src, axis=0)
+
+
+def _rs_chunk_index(me, s, n, direction):
+    # chunk forwarded at step s; derived so the fully-reduced chunk that
+    # lands after n-1 hops is exactly chunk ``me`` for either direction
+    return jnp.mod(me - direction * (1 + s), n)
 
 
 def _reduce_scatter_impl(x, axis, interpret):
@@ -223,7 +325,7 @@ def _reduce_scatter_impl(x, axis, interpret):
     view = x.reshape((n, x.shape[0] // n) + x.shape[1:])
 
     def chunk(s):
-        return jnp.take(view, jnp.mod(me - 1 - s, n), axis=0)
+        return jnp.take(view, _rs_chunk_index(me, s, n, 1), axis=0)
 
     def step(partial_, s):
         recv = _ring_shift_impl(partial_, axis, 1, interpret)
@@ -231,6 +333,47 @@ def _reduce_scatter_impl(x, axis, interpret):
 
     out, _ = lax.scan(step, chunk(0), jnp.arange(1, n))
     return out
+
+
+def _reduce_scatter_bidir(a, b, axis, interpret):
+    """Fused bidirectional reduce-scatter: segment ``a`` rides the ring
+    rightward, ``b`` leftward, both hops in one kernel per step."""
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    va = a.reshape((n, a.shape[0] // n) + a.shape[1:])
+    vb = b.reshape((n, b.shape[0] // n) + b.shape[1:])
+
+    def step(carry, s):
+        pa, pb = carry
+        ra, rb = ring_shift2(pa, pb, axis, interpret=interpret)
+        na = jnp.take(va, _rs_chunk_index(me, s, n, 1), axis=0) + ra
+        nb = jnp.take(vb, _rs_chunk_index(me, s, n, -1), axis=0) + rb
+        return (na, nb), None
+
+    init = (
+        jnp.take(va, _rs_chunk_index(me, 0, n, 1), axis=0),
+        jnp.take(vb, _rs_chunk_index(me, 0, n, -1), axis=0),
+    )
+    (oa, ob), _ = lax.scan(step, init, jnp.arange(1, n))
+    return oa, ob
+
+
+def _all_gather_bidir(a, b, axis, interpret):
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+
+    def hop(carry, _):
+        ca, cb = carry
+        nxt = ring_shift2(ca, cb, axis, interpret=interpret)
+        return nxt, nxt
+
+    _, (ras, rbs) = lax.scan(hop, (a, b), None, length=n - 1)
+    stacked_a = jnp.concatenate([a[None], ras], axis=0)
+    stacked_b = jnp.concatenate([b[None], rbs], axis=0)
+    ja = jnp.mod(me - jnp.arange(n), n)
+    jb = jnp.mod(jnp.arange(n) - me, n)
+    return (jnp.take(stacked_a, ja, axis=0),
+            jnp.take(stacked_b, jb, axis=0))
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2))
@@ -308,16 +451,51 @@ def allreduce_sum(x, axis):
     return _allreduce_sum(x, axis)
 
 
+# Above this many elements the allreduce splits the payload in half and
+# runs both ring directions concurrently (each hop moves half the bytes on
+# each ICI link direction — ~2x effective bandwidth on a real ring).
+BIDIR_MIN_ELEMS = 16 * 1024
+
+# Below this many elements the allreduce is latency-bound, so it takes the
+# recursive-doubling butterfly — log2(n) full-payload exchanges instead of
+# 2(n-1) chunk hops (requires power-of-two ring size).
+BUTTERFLY_MAX_ELEMS = 4 * 1024
+
+
+def _allreduce_butterfly(flat, axis, interpret):
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    acc = flat
+    k = 1
+    while k < n:
+        partner = jnp.bitwise_xor(me, k)
+        acc = acc + _exchange_impl(acc, axis, partner, interpret)
+        k *= 2
+    return acc
+
+
 def _allreduce_sum(x, axis, *, interpret=None):
     n = lax.axis_size(axis)
     if n == 1:
         return x
     flat = x.reshape(-1)
-    pad = (-flat.shape[0]) % n
-    if pad:
-        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-    mine = reduce_scatter_sum(flat, axis, interpret=interpret)
-    full = all_gather(mine, axis, interpret=interpret).reshape(-1)
+    if flat.shape[0] <= BUTTERFLY_MAX_ELEMS and (n & (n - 1)) == 0:
+        return _allreduce_butterfly(flat, axis, interpret).reshape(x.shape)
+    if flat.shape[0] >= BIDIR_MIN_ELEMS and n > 2:
+        pad = (-flat.shape[0]) % (2 * n)
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        half = flat.shape[0] // 2
+        a, b = flat[:half], flat[half:]
+        ma, mb = _reduce_scatter_bidir(a, b, axis, interpret)
+        fa, fb = _all_gather_bidir(ma, mb, axis, interpret)
+        full = jnp.concatenate([fa.reshape(-1), fb.reshape(-1)])
+    else:
+        pad = (-flat.shape[0]) % n
+        if pad:
+            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+        mine = reduce_scatter_sum(flat, axis, interpret=interpret)
+        full = all_gather(mine, axis, interpret=interpret).reshape(-1)
     if pad:
         full = full[: flat.shape[0] - pad]
     return full.reshape(x.shape)

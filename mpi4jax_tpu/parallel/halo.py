@@ -41,16 +41,32 @@ def _axis_exchange(f, dim: int, axis_name: str, halo: int, periodic: bool):
         else:
             return f
     else:
-        to_prev = [(i, i - 1) for i in range(1, n)]
-        to_next = [(i, i + 1) for i in range(n - 1)]
-        if periodic:
-            to_prev.append((0, n - 1))
-            to_next.append((n - 1, 0))
-        # neighbor below (index+1) sends its low-interior strip to us → our
-        # high ghost; neighbor above (index-1) sends its high-interior → our
-        # low ghost.
-        from_above = lax.ppermute(hi_interior, axis_name, to_next)
-        from_below = lax.ppermute(lo_interior, axis_name, to_prev)
+        from ..utils import config as _config
+
+        use_rdma = False
+        if _config.pallas_collectives_enabled():
+            from ..ops import pallas_collectives as _pc
+
+            use_rdma = _pc.can_route(axis_name)
+        if use_rdma:
+            # one kernel, both directions' DMAs in flight before either
+            # wait — both ICI link directions busy (ring_shift2); at
+            # non-periodic boundaries the wrapped values are masked below,
+            # same as the zeros ppermute would deliver
+            from_above, from_below = _pc.ring_shift2(
+                hi_interior, lo_interior, axis_name
+            )
+        else:
+            to_prev = [(i, i - 1) for i in range(1, n)]
+            to_next = [(i, i + 1) for i in range(n - 1)]
+            if periodic:
+                to_prev.append((0, n - 1))
+                to_next.append((n - 1, 0))
+            # neighbor below (index+1) sends its low-interior strip to us →
+            # our high ghost; neighbor above (index-1) sends its
+            # high-interior → our low ghost.
+            from_above = lax.ppermute(hi_interior, axis_name, to_next)
+            from_below = lax.ppermute(lo_interior, axis_name, to_prev)
 
     idx = lax.axis_index(axis_name)
     lo_ghost = lax.slice_in_dim(f, 0, halo, axis=dim)

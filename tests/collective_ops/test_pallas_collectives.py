@@ -81,7 +81,9 @@ def test_reduce_scatter_matches_psum_chunk():
 
 
 @pytest.mark.parametrize(
-    "shape", [(4 * 8, 32), (7, 5), (3,), ()], ids=["divisible", "odd", "tiny", "scalar"]
+    "shape",
+    [(4 * 8, 32), (2048, 4), (7, 5), (3,), ()],
+    ids=["butterfly", "ring-rs-ag", "odd", "tiny", "scalar"],
 )
 def test_allreduce_matches_psum(shape):
     mesh = _mesh()
@@ -119,6 +121,109 @@ def test_allreduce_grad_matches_psum_grad():
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
     )
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_butterfly_allreduce_8dev():
+    """log2(8)=3 XOR exchanges; payload small enough for the butterfly."""
+    mesh = jax.make_mesh((8,), ("x",))
+    rng = np.random.RandomState(10)
+    x = jnp.asarray(rng.randn(8 * 64), np.float32)
+    assert 64 <= pc.BUTTERFLY_MAX_ELEMS
+    got = _smap(lambda v: pc.allreduce_sum(v, "x"), mesh)(x)
+    want = _smap(lambda v: lax.psum(v, "x"), mesh)(x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ring_shift2_both_directions():
+    mesh = _mesh()
+    x = jnp.arange(4 * 8 * 16, dtype=jnp.float32).reshape(4 * 8, 16)
+
+    def f(v):
+        a, b = pc.ring_shift2(v, 2.0 * v, "x")
+        return a + b
+
+    got = _smap(f, mesh)(x)
+
+    def ref(v):
+        a = lax.ppermute(v, "x", ring_perm(4, 1))
+        b = lax.ppermute(2.0 * v, "x", ring_perm(4, -1))
+        return a + b
+
+    want = _smap(ref, mesh)(x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_bidirectional_allreduce_matches_psum():
+    """Payloads over BIDIR_MIN_ELEMS take the split two-direction ring."""
+    mesh = _mesh()
+    rng = np.random.RandomState(9)
+    assert 4 * 4096 >= pc.BIDIR_MIN_ELEMS
+    x = jnp.asarray(rng.randn(4 * 4096 + 4 * 3), np.float32)  # odd: pads
+    got = _smap(lambda v: pc.allreduce_sum(v, "x"), mesh)(x)
+    want = _smap(lambda v: lax.psum(v, "x"), mesh)(x)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_ring_shift2_grad():
+    mesh = _mesh()
+    rng = np.random.RandomState(11)
+    x = jnp.asarray(rng.randn(4 * 4, 8), np.float32)
+    w1 = jnp.asarray(rng.randn(4 * 4, 8), np.float32)
+    w2 = jnp.asarray(rng.randn(4 * 4, 8), np.float32)
+
+    def make(step):
+        def f(v, w1, w2):
+            return jax.grad(
+                lambda v: jnp.sum(sum(jnp.multiply(o, w)
+                                      for o, w in zip(step(v), (w1, w2))))
+            )(v)
+
+        return _smap(f, mesh, in_specs=(P("x"), P("x"), P("x")))
+
+    got = make(lambda v: pc.ring_shift2(v, 3.0 * v, "x"))(x, w1, w2)
+    want = make(
+        lambda v: (
+            lax.ppermute(v, "x", ring_perm(4, 1)),
+            lax.ppermute(3.0 * v, "x", ring_perm(4, -1)),
+        )
+    )(x, w1, w2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5)
+
+
+@pytest.mark.parametrize("periodic", [True, False])
+def test_halo_exchange_rdma_matches_ppermute(monkeypatch, periodic):
+    from mpi4jax_tpu.parallel.grid import ProcessGrid
+    from mpi4jax_tpu.parallel.halo import halo_exchange
+
+    grid = ProcessGrid((2, 4))
+    rng = np.random.RandomState(12)
+    ny, nx = 2 * 6, 4 * 6
+    a = jnp.asarray(rng.randn(ny, nx), np.float32)
+    b = jnp.asarray(rng.randn(ny, nx), np.float32)
+
+    def run():
+        def f(a, b):
+            return halo_exchange((a, b), grid, halo=1, periodic=periodic)
+
+        return jax.jit(
+            shard_map(
+                f,
+                mesh=grid.mesh,
+                in_specs=(P(*grid.axes),) * 2,
+                out_specs=(P(*grid.axes),) * 2,
+            )
+        )(a, b)
+
+    base = run()
+    monkeypatch.setenv("MPI4JAX_TPU_PALLAS_COLLECTIVES", "1")
+    rdma = run()
+    for g, w in zip(rdma, base):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
 
 
 def test_ring_shift_of():
