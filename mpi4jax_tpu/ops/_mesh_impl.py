@@ -78,9 +78,9 @@ def as_varying(x, axis):
     every op accept either, so the ops work in user shard_maps regardless
     of the check mode.
     """
-    from jax._src import config as _jcfg
+    from ..utils.jax_compat import vma_check_enabled
 
-    if not _jcfg._check_vma.value:
+    if not vma_check_enabled():
         # unchecked shard_map: vma is untracked (always empty) and pcast's
         # transpose (a psum) would corrupt/abort transposed programs
         return x

@@ -131,20 +131,10 @@ def can_route(axis) -> bool:
         return False
 
 
-def _vma_checked():
-    # jax tracks varying-axes only under checked shard_map; the switch is
-    # private, so fail open (assume checked — it is the default) and let the
-    # TypeError fallback below absorb any future API change.
-    try:
-        from jax._src import config as _jcfg
-
-        return bool(_jcfg._check_vma.value)
-    except Exception:
-        return True
-
-
 def _out_struct(x, axis):
-    if _vma_checked():
+    from ..utils.jax_compat import vma_check_enabled
+
+    if vma_check_enabled():
         vma = frozenset(getattr(jax.typeof(x), "vma", frozenset())) | {axis}
         try:
             return jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)

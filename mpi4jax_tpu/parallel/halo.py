@@ -110,6 +110,18 @@ def halo_exchange(
     if dims is None:
         dims = tuple(range(grid.ndim))
 
+    if all(lax.axis_size(grid.axes[g]) == 1 for g in range(grid.ndim)):
+        # no direction actually communicates (single-block grid): stacking
+        # would only buy batched collectives, and its full-array
+        # stack/unstack copies dominate the step on one chip — update each
+        # field's ghosts in place instead
+        out = []
+        for x in fields:
+            for gdim, (adim, per) in enumerate(zip(dims, periodic)):
+                x = _axis_exchange(x, adim, grid.axes[gdim], halo, per)
+            out.append(x)
+        return out[0] if single else tuple(out)
+
     # Batch all fields into one stacked exchange per direction: one
     # collective instead of len(fields) — fewer, larger ICI transfers.
     stacked = jnp.stack([x.astype(fields[0].dtype) for x in fields])

@@ -44,3 +44,20 @@ def check_jax_version() -> None:
             "MPI4JAX_TPU_NO_WARN_JAX_VERSION=1 to silence this warning.",
             UserWarning,
         )
+
+
+def vma_check_enabled() -> bool:
+    """Whether shard_map tracks varying-manual-axes (``check_vma=True``).
+
+    The switch is private jax API (``jax._src.config._check_vma``) — this is
+    the one place that reads it, so a future rename is a one-line fix.
+    Fails open (True, the jax default): callers then declare ``vma`` on
+    kernel out-structs, and the TypeError fallback at the use site absorbs
+    the case where the kwarg is gone too.
+    """
+    try:
+        from jax._src import config as _jcfg
+
+        return bool(_jcfg._check_vma.value)
+    except Exception:
+        return True
