@@ -78,9 +78,17 @@ def as_varying(x, axis):
     every op accept either, so the ops work in user shard_maps regardless
     of the check mode.
     """
-    from ..utils.jax_compat import vma_check_enabled
+    from ..utils.jax_compat import vma_check_mode
 
-    if not vma_check_enabled():
+    checked = vma_check_mode()
+    if checked is None:
+        # a wrong guess either corrupts transposed programs (pcast under
+        # unchecked shard_map) or trips collective vma errors — fail loud
+        raise RuntimeError(
+            "cannot determine shard_map's check_vma mode (private jax API "
+            "moved); update mpi4jax_tpu.utils.jax_compat.vma_check_mode"
+        )
+    if not checked:
         # unchecked shard_map: vma is untracked (always empty) and pcast's
         # transpose (a psum) would corrupt/abort transposed programs
         return x

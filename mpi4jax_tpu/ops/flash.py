@@ -39,7 +39,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..parallel.ring import _ring_shift as _shift
+from ..parallel.ring import _ring_shift_many as _shift_many
 
 NEG_INF = -1e30
 _TRANS_B = (((1,), (1,)), ((), ()))  # contract last dims: x @ y.T
@@ -332,11 +332,13 @@ def _ring_forward(q, k, v, axis, causal, scale, block_q, block_k, interpret):
         a, a_b = jnp.exp(m - m_new), jnp.exp(m_b - m_new)
         o = o * a + o_b * a_b
         l = l * a + l_b * a_b
-        return (o, m_new, l, _shift(k_cur, axis), _shift(v_cur, axis)), None
+        return (o, m_new, l) + _shift_many((k_cur, v_cur), axis), None
 
-    o0 = jnp.zeros((b * h, t, d), jnp.float32)
-    m0 = jnp.full((b * h, t, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b * h, t, 1), jnp.float32)
+    from ._mesh_impl import as_varying
+
+    o0 = as_varying(jnp.zeros((b * h, t, d), jnp.float32), axis)
+    m0 = as_varying(jnp.full((b * h, t, 1), NEG_INF, jnp.float32), axis)
+    l0 = as_varying(jnp.zeros((b * h, t, 1), jnp.float32), axis)
     (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, kf, vf),
                                   jnp.arange(size))
     l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -382,10 +384,11 @@ def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret,
                  k_cur, v_cur)
         # rotate the k/v blocks together with their accumulated grads;
         # after `size` hops they are back home
-        return tuple(_shift(x, axis) if j >= 1 else x
-                     for j, x in enumerate(carry)), None
+        return (carry[0],) + _shift_many(carry[1:], axis), None
 
-    z_q = jnp.zeros((b * h, t, d), jnp.float32)
+    from ._mesh_impl import as_varying
+
+    z_q = as_varying(jnp.zeros((b * h, t, d), jnp.float32), axis)
     z_k = jnp.zeros_like(z_q)
     (dq, dk, dv, _, _), _ = lax.scan(
         step, (z_q, z_k, z_k, kf, vf), jnp.arange(size))

@@ -53,27 +53,37 @@ def _interpret(flag):
 
 
 # ---------------------------------------------------------------------------
-# the one kernel: a single ring hop
+# the one kernel: k payloads, each to its own destination, all DMAs in
+# flight before any wait
 # ---------------------------------------------------------------------------
 
 
-def _ring_shift_kernel(dst_ref, x_ref, o_ref, send_sem, recv_sem):
-    """Send the local shard to rank ``dst_ref[0]``; receive symmetrically.
+def _make_hop_kernel(k: int):
+    """Kernel sending payload i to logical device ``dst_ref[i]``.
 
-    The destination is computed *outside* the kernel (it is a varying value
+    Destinations are computed *outside* the kernel (they are varying values
     — ``axis_index`` arithmetic — which the VMA checker tracks in plain JAX
-    but not inside kernel bodies) and arrives as an SMEM scalar.
+    but not inside kernel bodies) and arrive as SMEM scalars.  Every DMA
+    starts before any wait, so payloads to distinct neighbors (e.g. the
+    two ring directions) travel concurrently.
     """
-    rdma = pltpu.make_async_remote_copy(
-        src_ref=x_ref,
-        dst_ref=o_ref,
-        send_sem=send_sem,
-        recv_sem=recv_sem,
-        device_id=dst_ref[0],
-        device_id_type=pltpu.DeviceIdType.LOGICAL,
-    )
-    rdma.start()
-    rdma.wait()
+
+    def kernel(dst_ref, *refs):
+        ins, outs, sems = refs[:k], refs[k:2 * k], refs[2 * k:]
+        copies = []
+        for i in range(k):
+            c = pltpu.make_async_remote_copy(
+                src_ref=ins[i], dst_ref=outs[i],
+                send_sem=sems[2 * i], recv_sem=sems[2 * i + 1],
+                device_id=dst_ref[i],
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            c.start()
+            copies.append(c)
+        for c in copies:
+            c.wait()
+
+    return kernel
 
 
 def _dst_logical_at(axis, coord):
@@ -132,9 +142,11 @@ def can_route(axis) -> bool:
 
 
 def _out_struct(x, axis):
-    from ..utils.jax_compat import vma_check_enabled
+    from ..utils.jax_compat import vma_check_mode
 
-    if vma_check_enabled():
+    if vma_check_mode() is not False:
+        # checked mode, or unknown (private probe gone): declaring vma is
+        # correct in the former and harmlessly absorbed below in the latter
         vma = frozenset(getattr(jax.typeof(x), "vma", frozenset())) | {axis}
         try:
             return jax.ShapeDtypeStruct(x.shape, x.dtype, vma=vma)
@@ -143,31 +155,36 @@ def _out_struct(x, axis):
     return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
 
-def _send_to(x, axis, dst, interpret):
-    """One paired-DMA hop to the (traced) logical device id ``dst``.  The
-    pairing contract: whichever device's hop targets *us* fills our
-    output buffer; with ring shifts and XOR partners that is guaranteed."""
+def _hop_impl(xs, axis, dsts, interpret):
+    """k paired-DMA hops: payload ``xs[i]`` to logical device ``dsts[i]``.
+
+    The pairing contract: whichever device's hop targets *us* fills our
+    corresponding output buffer; ring shifts, opposite-direction pairs,
+    and XOR partners all satisfy it."""
+    k = len(xs)
     return pl.pallas_call(
-        _ring_shift_kernel,
-        out_shape=_out_struct(x, axis),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
+        _make_hop_kernel(k),
+        out_shape=tuple(_out_struct(x, axis) for x in xs),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM)]
+        + [pl.BlockSpec(memory_space=pl.ANY)] * k,
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in xs),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * (2 * k),
         interpret=_interpret(interpret),
-    )(dst[None], x)
+    )(jnp.stack(dsts), *xs)
 
 
 def _ring_shift_impl(x, axis, shift, interpret):
-    return _send_to(x, axis, _dst_logical(axis, shift), interpret)
+    (out,) = _hop_impl((x,), axis, (_dst_logical(axis, shift),), interpret)
+    return out
 
 
 def _exchange_impl(x, axis, partner_coord, interpret):
     """Pairwise exchange with the device at ``partner_coord`` on ``axis``
     (the butterfly step; the partner relation must be an involution)."""
-    return _send_to(x, axis, _dst_logical_at(axis, partner_coord), interpret)
+    (out,) = _hop_impl(
+        (x,), axis, (_dst_logical_at(axis, partner_coord),), interpret
+    )
+    return out
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
@@ -209,43 +226,14 @@ def ring_shift(x, axis, shift: int = 1, *, interpret=None):
 # ---------------------------------------------------------------------------
 
 
-def _ring_shift2_kernel(dsts_ref, a_ref, b_ref, oa_ref, ob_ref,
-                        send_a, recv_a, send_b, recv_b):
-    """Two simultaneous hops — ``a`` to the right neighbor, ``b`` to the
-    left — with both DMAs in flight before either wait, so the two ICI
-    link directions carry traffic concurrently (the bidirectional-ring
-    trick; a single ``lax.ppermute`` cannot express it)."""
-    rd_a = pltpu.make_async_remote_copy(
-        src_ref=a_ref, dst_ref=oa_ref, send_sem=send_a, recv_sem=recv_a,
-        device_id=dsts_ref[0], device_id_type=pltpu.DeviceIdType.LOGICAL,
-    )
-    rd_b = pltpu.make_async_remote_copy(
-        src_ref=b_ref, dst_ref=ob_ref, send_sem=send_b, recv_sem=recv_b,
-        device_id=dsts_ref[1], device_id_type=pltpu.DeviceIdType.LOGICAL,
-    )
-    rd_a.start()
-    rd_b.start()
-    rd_a.wait()
-    rd_b.wait()
-
-
 def _ring_shift2_impl(a, b, axis, interpret):
-    dsts = jnp.stack([_dst_logical(axis, 1), _dst_logical(axis, -1)])
-    return pl.pallas_call(
-        _ring_shift2_kernel,
-        out_shape=(_out_struct(a, axis), _out_struct(b, axis)),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=(
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ),
-        scratch_shapes=[pltpu.SemaphoreType.DMA] * 4,
-        interpret=_interpret(interpret),
-    )(dsts, a, b)
+    # two simultaneous hops — ``a`` to the right neighbor, ``b`` to the
+    # left — so the two ICI link directions carry traffic concurrently
+    # (the bidirectional-ring trick; one ``lax.ppermute`` cannot express it)
+    return _hop_impl(
+        (a, b), axis,
+        (_dst_logical(axis, 1), _dst_logical(axis, -1)), interpret,
+    )
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(2, 3))
@@ -275,6 +263,36 @@ def ring_shift2(a, b, axis, *, interpret=None):
     neighbor's ``b`` (data moved left).  Reverse-mode differentiable;
     fwd-mode raises."""
     return _ring_shift2_d(a, b, axis, interpret)
+
+
+def _ring_shift_n_impl(xs, axis, shift, interpret):
+    dst = _dst_logical(axis, shift)
+    return _hop_impl(tuple(xs), axis, (dst,) * len(xs), interpret)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _ring_shift_n_d(xs, axis, shift, interpret):
+    return _ring_shift_n_impl(xs, axis, shift, interpret)
+
+
+def _ring_shift_n_fwd(xs, axis, shift, interpret):
+    return _ring_shift_n_impl(xs, axis, shift, interpret), None
+
+
+def _ring_shift_n_bwd(axis, shift, interpret, _, g):
+    return (_ring_shift_n_impl(tuple(g), axis, -shift, interpret),)
+
+
+_ring_shift_n_d.defvjp(_ring_shift_n_fwd, _ring_shift_n_bwd)
+
+
+def ring_shift_n(xs, axis, shift: int = 1, *, interpret=None):
+    """Shift a tuple of arrays one ring hop together — every payload's DMA
+    is in flight before any wait.  The batched-ICI analog of the k/v
+    rotation in ring attention.  Reverse-mode differentiable."""
+    if shift == 0:
+        return tuple(xs)
+    return _ring_shift_n_d(tuple(xs), axis, shift, interpret)
 
 
 def _all_gather_impl(x, axis, interpret):

@@ -23,8 +23,24 @@ from jax import lax
 
 
 def _ring_shift(x, axis):
+    (out,) = _ring_shift_many((x,), axis)
+    return out
+
+
+def _ring_shift_many(xs, axis):
+    """Rotate several arrays one ring hop together.  Under
+    ``MPI4JAX_TPU_PALLAS_COLLECTIVES=1`` all payloads ride one RDMA kernel
+    (every DMA in flight before any wait); otherwise one ppermute each."""
+    from ..utils import config as _config
+
+    if _config.pallas_collectives_enabled():
+        from ..ops import pallas_collectives as _pc
+
+        if _pc.can_route(axis):
+            return _pc.ring_shift_n(xs, axis, 1)
     size = lax.axis_size(axis)
-    return lax.ppermute(x, axis, [(i, (i + 1) % size) for i in range(size)])
+    perm = [(i, (i + 1) % size) for i in range(size)]
+    return tuple(lax.ppermute(x, axis, perm) for x in xs)
 
 
 def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None,
@@ -108,13 +124,16 @@ def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None,
         # rotate the k/v ring one hop (skip the send on the last step is a
         # micro-optimization XLA handles via dead-code once unrolled; with
         # scan we keep the uniform body)
-        k_nxt = _ring_shift(k_cur, axis)
-        v_nxt = _ring_shift(v_cur, axis)
+        k_nxt, v_nxt = _ring_shift_many((k_cur, v_cur), axis)
         return (o_new, m_new, l_new, k_nxt, v_nxt), None
 
-    o0 = jnp.zeros((b, h, t_loc, d), jnp.float32)
-    m0 = jnp.full((b, h, t_loc), neg_inf, jnp.float32)
-    l0 = jnp.zeros((b, h, t_loc), jnp.float32)
+    from ..ops._mesh_impl import as_varying
+
+    # the accumulators start as constants but become varying inside the
+    # scan body — promote up front so checked shard_maps accept the carry
+    o0 = as_varying(jnp.zeros((b, h, t_loc, d), jnp.float32), axis)
+    m0 = as_varying(jnp.full((b, h, t_loc), neg_inf, jnp.float32), axis)
+    l0 = as_varying(jnp.zeros((b, h, t_loc), jnp.float32), axis)
     (o, m, l, _, _), _ = lax.scan(
         step, (o0, m0, l0, kt, vt), jnp.arange(size)
     )

@@ -46,18 +46,19 @@ def check_jax_version() -> None:
         )
 
 
-def vma_check_enabled() -> bool:
+def vma_check_mode():
     """Whether shard_map tracks varying-manual-axes (``check_vma=True``).
 
-    The switch is private jax API (``jax._src.config._check_vma``) — this is
-    the one place that reads it, so a future rename is a one-line fix.
-    Fails open (True, the jax default): callers then declare ``vma`` on
-    kernel out-structs, and the TypeError fallback at the use site absorbs
-    the case where the kwarg is gone too.
+    Returns True/False, or ``None`` when the probe fails — the switch is
+    private jax API (``jax._src.config._check_vma``), and this is the one
+    place that reads it, so a future rename is a one-line fix.  On None,
+    callers choose their own failure mode: loud where a wrong guess would
+    corrupt results (``as_varying``), soft where a fallback is harmless
+    (Pallas out-structs).
     """
     try:
         from jax._src import config as _jcfg
 
         return bool(_jcfg._check_vma.value)
     except Exception:
-        return True
+        return None
