@@ -152,8 +152,12 @@ def alltoall(x, axis):
             f"alltoall requires leading axis == communicator size ({size}), "
             f"got shape {x.shape}"
         )
-    return lax.all_to_all(as_varying(x, axis), axis, split_axis=0,
-                          concat_axis=0)
+    x = as_varying(x, axis)
+    if _pallas_ring(axis):
+        from . import pallas_collectives as _pc
+
+        return _pc.alltoall(x, axis)
+    return lax.all_to_all(x, axis, split_axis=0, concat_axis=0)
 
 
 def bcast(x, root: int, axis):

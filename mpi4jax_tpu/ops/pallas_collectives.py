@@ -155,6 +155,15 @@ def _out_struct(x, axis):
     return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
 
+def _as_dma_dtype(x):
+    """The DMA engines (and the interpreter) move real-typed bytes only:
+    view complex as its float pair (last axis doubles)."""
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        f = jnp.float32 if x.dtype == jnp.complex64 else jnp.float64
+        return x.view(f), x.dtype
+    return x, None
+
+
 def _hop_impl(xs, axis, dsts, interpret):
     """k paired-DMA hops: payload ``xs[i]`` to logical device ``dsts[i]``.
 
@@ -162,15 +171,21 @@ def _hop_impl(xs, axis, dsts, interpret):
     corresponding output buffer; ring shifts, opposite-direction pairs,
     and XOR partners all satisfy it."""
     k = len(xs)
-    return pl.pallas_call(
+    viewed = [_as_dma_dtype(x) for x in xs]
+    ins = tuple(v for v, _ in viewed)
+    outs = pl.pallas_call(
         _make_hop_kernel(k),
-        out_shape=tuple(_out_struct(x, axis) for x in xs),
+        out_shape=tuple(_out_struct(x, axis) for x in ins),
         in_specs=[pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM)]
         + [pl.BlockSpec(memory_space=pl.ANY)] * k,
-        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in xs),
+        out_specs=tuple(pl.BlockSpec(memory_space=pl.ANY) for _ in ins),
         scratch_shapes=[pltpu.SemaphoreType.DMA] * (2 * k),
         interpret=_interpret(interpret),
-    )(jnp.stack(dsts), *xs)
+    )(jnp.stack(dsts), *ins)
+    return tuple(
+        o.view(c) if c is not None else o
+        for o, (_, c) in zip(outs, viewed)
+    )
 
 
 def _ring_shift_impl(x, axis, shift, interpret):
@@ -457,6 +472,98 @@ def allreduce_sum(x, axis):
     allreduce-SUM is again an allreduce-SUM (``allreduce.py:188-218``).
     """
     return _allreduce_sum(x, axis)
+
+
+def _make_alltoall_kernel(n: int):
+    """Direct all-to-all: row i of the local input goes straight to rank
+    i's output (landing at the row indexed by *our* rank) — n simultaneous
+    DMAs, one network hop, no ring.  Message from sender s lands in our
+    row s and signals our recv semaphore slot s, so each transfer has an
+    unambiguous (row, semaphore) pair."""
+
+    def kernel(meta_ref, x_ref, o_ref, send_sems, recv_sems):
+        me = meta_ref[0]
+        copies = []
+        for i in range(n):
+            c = pltpu.make_async_remote_copy(
+                src_ref=x_ref.at[i],
+                dst_ref=o_ref.at[me],
+                send_sem=send_sems.at[i],
+                recv_sem=recv_sems.at[me],
+                device_id=meta_ref[1 + i],
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            c.start()
+            copies.append(c)
+        for c in copies:
+            c.wait_send()
+        for j in range(n):
+            # wait for sender j's row: a local descriptor of the same
+            # extent waits the matching byte count on slot j
+            pltpu.make_async_copy(
+                o_ref.at[j], o_ref.at[j], recv_sems.at[j]
+            ).wait()
+
+    return kernel
+
+
+def _alltoall_impl(x, axis, interpret):
+    n = lax.axis_size(axis)
+    if x.ndim < 1 or x.shape[0] != n:
+        raise ValueError(
+            f"alltoall requires leading axis == ring size ({n}), got shape "
+            f"{x.shape}"
+        )
+    if n == 1:
+        return x
+    me = lax.axis_index(axis).astype(jnp.int32)
+    meta = jnp.stack(
+        [me] + [_dst_logical_at(axis, i) for i in range(n)]
+    )
+    v, cdtype = _as_dma_dtype(x)
+    out = pl.pallas_call(
+        _make_alltoall_kernel(n),
+        out_shape=_out_struct(v, axis),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((n,)),
+            pltpu.SemaphoreType.DMA((n,)),
+        ],
+        interpret=_interpret(interpret),
+    )(meta, v)
+    return out.view(cdtype) if cdtype is not None else out
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _alltoall_d(x, axis, interpret):
+    return _alltoall_impl(x, axis, interpret)
+
+
+def _alltoall_fwd(x, axis, interpret):
+    return _alltoall_impl(x, axis, interpret), None
+
+
+def _alltoall_bwd(axis, interpret, _, g):
+    # out[j] = x_j[me] on every rank: the cotangent of row i is what rank i
+    # holds for us — another all-to-all (the op is its own transpose)
+    return (_alltoall_impl(g, axis, interpret),)
+
+
+_alltoall_d.defvjp(_alltoall_fwd, _alltoall_bwd)
+
+
+def alltoall(x, axis, *, interpret=None):
+    """Direct RDMA all-to-all: ``x`` is ``(n, ...)``; returns ``(n, ...)``
+    where row j is rank j's row addressed to this rank — the semantics of
+    ``lax.all_to_all(split_axis=0, concat_axis=0)`` / MPI_Alltoall
+    (reference op: ``mpi4jax/_src/collective_ops/alltoall.py:39-83``), in
+    ONE network hop instead of a ring.  Reverse-mode differentiable (the
+    op is its own transpose); fwd-mode raises."""
+    return _alltoall_d(x, axis, interpret)
 
 
 # Above this many elements the allreduce splits the payload in half and
