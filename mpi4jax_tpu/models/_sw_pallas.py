@@ -1,0 +1,243 @@
+"""Fused Pallas step kernel for the single-block shallow-water solver.
+
+The XLA step (`shallow_water._step_local`) materializes ~a dozen
+intermediate fields per step (fe/fn/q/ke, viscous gradients, pads, ghost
+updates) — on one chip each is a full HBM round-trip, and the step is
+bandwidth-bound.  This kernel computes the ENTIRE step — flux/vorticity
+build, Adams–Bashforth update, wall + periodic-wrap boundary handling,
+and the viscous pass — inside VMEM row-tiles: 6 field reads + 6 field
+writes of HBM traffic per step, nothing else.
+
+Scope: single-block grids (1×1 ``ProcessGrid``) with ``periodic_x=True``
+— exactly the dense per-chip core.  Decomposed grids keep the XLA path,
+where the halo exchanges between sub-steps are the multi-chip collectives
+(the kernel's row-window trick cannot see a neighbor *rank*'s rows).
+
+Numerical contract: identical stencils to ``_step_local`` (same Sadourny
+C-grid expressions, same boundary-mask ordering as ``_exchange``'s
+kinds), so results match the XLA path to f32 reassociation tolerance —
+asserted by ``tests/models/test_sw_pallas.py``.
+
+Window discipline: each grid step processes ``T`` output rows from an
+``R = T + 8``-row input window (clamped at the domain edges).  Every
+derived level consumes one neighbor row, and the chain
+fe/fn/q/ke → d*_new → AB state → viscous gradients → final state is four
+levels deep on each side.  Rows that fall outside the domain are repaired
+by the ghost-row masks (walls in y), so windows touching the domain edge
+stay valid all the way out.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+HALO_ROWS = 4  # stencil-chain depth per side
+
+
+def _interpret(flag):
+    if flag is None:
+        from ..ops.flash import target_platform
+
+        flag = target_platform() != "tpu"
+    return pltpu.InterpretParams() if flag else False
+
+
+# window shift helpers: value at (r, c) of the result reads the neighbor
+# of (r, c) in the argument; window-edge garbage is absorbed by the halo
+# rows / rebuilt ghost columns.
+def _ex(a):  # east: col + 1
+    return jnp.concatenate([a[:, 1:], a[:, -1:]], axis=1)
+
+
+def _wx(a):  # west: col - 1
+    return jnp.concatenate([a[:, :1], a[:, :-1]], axis=1)
+
+
+def _nx(a):  # north: row + 1
+    return jnp.concatenate([a[1:], a[-1:]], axis=0)
+
+
+def _sx(a):  # south: row - 1
+    return jnp.concatenate([a[:1], a[:-1]], axis=0)
+
+
+def _make_step_kernel(*, nyp, X, T, R, dx, dy, g, nu, dt, f0, beta,
+                      ab_a, ab_b):
+    nx = X - 2
+
+    def wrapc(a):
+        # periodic-x ghost columns from the interior columns (full height,
+        # matching the exchange's full-column wrap strips)
+        return jnp.concatenate(
+            [a[:, nx:nx + 1], a[:, 1:X - 1], a[:, 1:2]], axis=1
+        )
+
+    def kernel(h_hbm, u_hbm, v_hbm, dh_hbm, du_hbm, dv_hbm,
+               ho_hbm, uo_hbm, vo_hbm, dho_hbm, duo_hbm, dvo_hbm,
+               hw, uw, vw, dhw, duw, dvw,
+               in_sems, out_sems):
+        i = pl.program_id(0)
+        in_start = jnp.clip(i * T - HALO_ROWS, 0, nyp - R)
+        out_start = jnp.minimum(i * T, nyp - T)
+
+        loads = [
+            pltpu.make_async_copy(
+                src.at[pl.ds(in_start, R)], dst, in_sems.at[j]
+            )
+            for j, (src, dst) in enumerate(
+                [(h_hbm, hw), (u_hbm, uw), (v_hbm, vw),
+                 (dh_hbm, dhw), (du_hbm, duw), (dv_hbm, dvw)]
+            )
+        ]
+        for c in loads:
+            c.start()
+        for c in loads:
+            c.wait()
+
+        h = hw[...]
+        u = uw[...]
+        v = vw[...]
+        dh = dhw[...]
+        du = duw[...]
+        dv = dvw[...]
+
+        gidx = in_start + lax.broadcasted_iota(jnp.int32, (R, X), 0)
+        ghost_row = (gidx == 0) | (gidx == nyp - 1)
+        col = lax.broadcasted_iota(jnp.int32, (R, X), 1)
+        interior = (~ghost_row) & (col >= 1) & (col <= nx)
+
+        def pad_mask(a):
+            # _pad semantics: ghost ring zero (x-ghosts rebuilt by wrapc)
+            return wrapc(jnp.where(ghost_row, 0.0, a))
+
+        # hc: h's interior with edge-copied ghost rows (jnp.pad mode="edge")
+        hc = jnp.where(gidx == 0, _nx(h), h)
+        hc = jnp.where(gidx == nyp - 1, _sx(hc), hc)
+        hc = wrapc(hc)
+
+        # flux / vorticity / kinetic-energy fields (interior expressions;
+        # ghosts = _pad zeros + exchange: x-wrap, fn gets the v-point wall)
+        fe = pad_mask(0.5 * (hc + _ex(hc)) * u)
+        fn = pad_mask(0.5 * (hc + _nx(hc)) * v)
+        fn = jnp.where(gidx == nyp - 2, 0.0, fn)  # kind "v" wall mask
+        y = (gidx - 1).astype(jnp.float32) * dy
+        f = f0 + beta * y
+        zeta = (_ex(v) - v) / dx - (_nx(u) - u) / dy
+        thick = 0.25 * (hc + _ex(hc) + _nx(hc) + _nx(_ex(hc)))
+        q = pad_mask((f + zeta) / thick)
+        ke = pad_mask(0.5 * (0.5 * (u ** 2 + _wx(u) ** 2)
+                             + 0.5 * (v ** 2 + _sx(v) ** 2)))
+
+        # tendencies (valid on interior rows ≥ 2 levels from window edge)
+        dh_new = -(fe - _wx(fe)) / dx - (fn - _sx(fn)) / dy
+        du_new = (-g * (_ex(h) - h) / dx
+                  + 0.5 * (q * 0.5 * (fn + _ex(fn))
+                           + _sx(q) * 0.5 * (_sx(fn) + _sx(_ex(fn))))
+                  - (_ex(ke) - ke) / dx)
+        dv_new = (-g * (_nx(h) - h) / dy
+                  - 0.5 * (q * 0.5 * (fe + _nx(fe))
+                           + _wx(q) * 0.5 * (_wx(fe) + _nx(_wx(fe))))
+                  - (_nx(ke) - ke) / dy)
+
+        # Adams–Bashforth update (interior), ghosts keep the BC values
+        hn = jnp.where(interior, h + dt * (ab_a * dh_new + ab_b * dh), h)
+        un = jnp.where(interior, u + dt * (ab_a * du_new + ab_b * du), u)
+        vn = jnp.where(interior, v + dt * (ab_a * dv_new + ab_b * dv), v)
+        hn, un, vn = wrapc(hn), wrapc(un), wrapc(vn)
+        vn = jnp.where(gidx == nyp - 2, 0.0, vn)  # kind "v" wall mask
+
+        # viscous pass (kinds "u","v","u","v": the y-gradients carry the
+        # v-point wall mask, mirroring _exchange's kind list)
+        gxu = pad_mask(nu * (_ex(un) - un) / dx)
+        gyu = pad_mask(nu * (_nx(un) - un) / dy)
+        gyu = jnp.where(gidx == nyp - 2, 0.0, gyu)
+        gxv = pad_mask(nu * (_ex(vn) - vn) / dx)
+        gyv = pad_mask(nu * (_nx(vn) - vn) / dy)
+        gyv = jnp.where(gidx == nyp - 2, 0.0, gyv)
+
+        uf = jnp.where(
+            interior,
+            un + dt * ((gxu - _wx(gxu)) / dx + (gyu - _sx(gyu)) / dy),
+            un,
+        )
+        vf = jnp.where(
+            interior,
+            vn + dt * ((gxv - _wx(gxv)) / dx + (gyv - _sx(gyv)) / dy),
+            vn,
+        )
+        uf, vf = wrapc(uf), wrapc(vf)
+        vf = jnp.where(gidx == nyp - 2, 0.0, vf)
+
+        # the input windows are fully consumed — reuse them as staging for
+        # the results, then DMA the T output rows out of each (Mosaic can
+        # dynamic-slice refs for DMA, not values)
+        off = out_start - in_start
+        hw[...] = hn
+        uw[...] = uf
+        vw[...] = vf
+        dhw[...] = jnp.where(interior, dh_new, 0.0)
+        duw[...] = jnp.where(interior, du_new, 0.0)
+        dvw[...] = jnp.where(interior, dv_new, 0.0)
+
+        stores = [
+            pltpu.make_async_copy(
+                src.at[pl.ds(off, T)], dst.at[pl.ds(out_start, T)],
+                out_sems.at[j],
+            )
+            for j, (src, dst) in enumerate(
+                [(hw, ho_hbm), (uw, uo_hbm), (vw, vo_hbm),
+                 (dhw, dho_hbm), (duw, duo_hbm), (dvw, dvo_hbm)]
+            )
+        ]
+        for c in stores:
+            c.start()
+        for c in stores:
+            c.wait()
+
+    return kernel
+
+
+def fused_step(state, params, *, first: bool, interpret=None,
+               tile_rows: int = 16):
+    """One full shallow-water step as a single Pallas kernel.
+
+    ``state`` fields are single-block padded arrays ``(ny+2, nx+2)`` with
+    valid ghosts (the step_fn invariant).  Returns the next state with the
+    same invariant.  ``first=True`` is the Euler bootstrap (AB with
+    a=1, b=0, matching ``_step_local(first=True)``).
+    """
+    h = state[0]
+    nyp, X = h.shape
+    T = min(tile_rows, nyp)
+    R = min(T + 2 * HALO_ROWS, nyp)
+    if R < 2 * HALO_ROWS + 1 and R < nyp:  # pragma: no cover - guard
+        raise ValueError("tile too small")
+    p = params
+    kern = _make_step_kernel(
+        nyp=nyp, X=X, T=T, R=R,
+        dx=p.dx, dy=p.dy, g=p.gravity, nu=p.viscosity, dt=p.dt,
+        f0=p.coriolis_f, beta=p.coriolis_beta,
+        ab_a=1.0 if first else p.ab_a,
+        ab_b=0.0 if first else p.ab_b,
+    )
+    ntiles = -(-nyp // T)
+    struct = jax.ShapeDtypeStruct((nyp, X), jnp.float32)
+    outs = pl.pallas_call(
+        kern,
+        grid=(ntiles,),
+        out_shape=(struct,) * 6,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6,
+        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 6,
+        scratch_shapes=(
+            [pltpu.VMEM((R, X), jnp.float32)] * 6
+            + [pltpu.SemaphoreType.DMA((6,)), pltpu.SemaphoreType.DMA((6,))]
+        ),
+        interpret=_interpret(interpret),
+    )(*(f.astype(jnp.float32) for f in state))
+    return type(state)(*outs)

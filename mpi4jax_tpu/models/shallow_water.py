@@ -346,20 +346,48 @@ class ShallowWater:
         )(dummy)
 
     def step_fn(self, n_steps: int, first: bool = False,
-                donate: bool = False):
+                donate: bool = False, impl: str = "auto"):
         """A jitted function advancing the stacked-block state n_steps.
 
         ``donate=True`` donates the input state's buffers to the output
         (callers must not reuse the argument after the call) — saves one
         state-sized allocation per invocation on HBM-bound configs.
+
+        ``impl``: "xla" — slice-stencil step (`_step_local`, works on any
+        grid); "pallas" — the fused single-kernel step
+        (`_sw_pallas.fused_step`, single-block periodic-x grids only:
+        6 reads + 6 writes of HBM per step instead of ~a dozen
+        materialized intermediates); "auto" — pallas when eligible.
         """
         gy, gx = self.grid.shape
         bs = self.block_shape
+        if impl not in ("auto", "xla", "pallas"):
+            raise ValueError(f"unknown impl {impl!r}")
+        eligible = (gy, gx) == (1, 1) and self.params.periodic_x
+        if impl == "pallas" and not eligible:
+            raise ValueError(
+                "impl='pallas' needs a 1x1 grid with periodic_x=True"
+            )
+        if impl == "auto" and eligible:
+            # compiled-kernel path only where it pays; off-TPU the kernel
+            # would run interpreted (tests opt in via impl="pallas")
+            from ..ops.flash import target_platform
+
+            use_pallas = target_platform() == "tpu"
+        else:
+            use_pallas = impl == "pallas"
+
+        def one_step(s, is_first):
+            if use_pallas:
+                from ._sw_pallas import fused_step
+
+                return fused_step(s, self.params, first=is_first)
+            return self._step_local(s, is_first)
 
         def local(*flat):
             s = SWState(*flat)
             if first:
-                s = self._step_local(s, True)
+                s = one_step(s, True)
                 remaining = n_steps - 1
             else:
                 remaining = n_steps
@@ -367,7 +395,7 @@ class ShallowWater:
                 s = lax.fori_loop(
                     0,
                     remaining,
-                    lambda _, st: self._step_local(st, False),
+                    lambda _, st: one_step(st, False),
                     s,
                 )
             return s
