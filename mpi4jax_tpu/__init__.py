@@ -56,6 +56,7 @@ from .parallel import (  # noqa: E402
     make_mesh,
     spmd,
 )
+from .utils.status import ANY_SOURCE, ANY_TAG, Status  # noqa: E402
 from .utils.tracing import set_logging  # noqa: E402
 
 __version__ = "0.1.0"
@@ -124,5 +125,8 @@ __all__ = [
     "spmd",
     "set_logging",
     "has_ici_support",
+    "Status",
+    "ANY_TAG",
+    "ANY_SOURCE",
     "__version__",
 ]

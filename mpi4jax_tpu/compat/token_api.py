@@ -53,8 +53,10 @@ def gather(x, root=0, *, comm=None, token=None):
     return _ops.gather(x, root, comm=comm, token=_start(token, x))
 
 
-def recv(x, source, tag=0, *, comm=None, token=None):
-    return _ops.recv(x, source, tag, comm=comm, token=_start(token, x))
+def recv(x, source, tag=None, *, comm=None, token=None, status=None):
+    return _ops.recv(
+        x, source, tag, comm=comm, token=_start(token, x), status=status
+    )
 
 
 def reduce(x, op=SUM, root=0, *, comm=None, token=None):
@@ -74,11 +76,13 @@ def send(x, dest, tag=0, *, comm=None, token=None):
 
 
 def sendrecv(
-    x, *, perm=None, shift=None, wrap=True, comm=None, token=None
+    x, *, perm=None, shift=None, wrap=True, source=None, dest=None,
+    tag=None, sendtag=0, recvtag=None, status=None, comm=None, token=None
 ):
     return _ops.sendrecv(
-        x, perm=perm, shift=shift, wrap=wrap, comm=comm,
-        token=_start(token, x),
+        x, perm=perm, shift=shift, wrap=wrap, source=source, dest=dest,
+        tag=tag, sendtag=sendtag, recvtag=recvtag, status=status,
+        comm=comm, token=_start(token, x),
     )
 
 

@@ -466,6 +466,13 @@ class ShallowWater:
                         f"compile; falling back to the XLA step: {exc}"
                     )
                     chosen["fn"] = build(False)
+                    try:
+                        return chosen["fn"](state)
+                    except Exception as exc2:
+                        # e.g. a marker-matching *runtime* fault after
+                        # donation consumed the inputs: surface the
+                        # original error as the cause, don't mask it
+                        raise exc2 from exc
             return chosen["fn"](state)
 
         return stepper

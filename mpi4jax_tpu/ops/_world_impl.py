@@ -255,22 +255,37 @@ def _host_send(x, *, comm, dest, tag):
     return np.zeros((), np.int32)
 
 
-def _host_recv(x, *, comm, source, tag):
+def _host_recv(x, *, comm, source, tag, status=None):
     from ..runtime import bridge
 
     with tracing.CallTrace(comm.rank(), "Recv", f"from {source} tag {tag}"):
-        return bridge.recv(comm.handle, x.shape, x.dtype, source, tag)
+        if status is None:
+            # strict path: arrived size must equal the buffer exactly
+            return bridge.recv(comm.handle, x.shape, x.dtype, source, tag)
+        out, src, tg, cnt = bridge.recv_status(
+            comm.handle, x.shape, x.dtype, source, tag
+        )
+    status.obj._fill(src, tg, cnt)
+    return out
 
 
-def _host_sendrecv(x, *, comm, source, dest, tag):
+def _host_sendrecv(x, *, comm, source, dest, sendtag, recvtag, status=None):
     from ..runtime import bridge
 
     with tracing.CallTrace(
         comm.rank(), "Sendrecv", f"to {dest} from {source}"
     ):
-        return bridge.sendrecv(
-            comm.handle, x, x.shape, x.dtype, source, dest, tag
+        if status is None and sendtag == recvtag:
+            return bridge.sendrecv(
+                comm.handle, x, x.shape, x.dtype, source, dest, sendtag
+            )
+        out, src, tg, cnt = bridge.sendrecv_status(
+            comm.handle, x, x.shape, x.dtype, source, dest, sendtag,
+            recvtag,
         )
+    if status is not None:
+        status.obj._fill(src, tg, cnt)
+    return out
 
 
 # ---------------- primitives ----------------
@@ -346,8 +361,6 @@ for _p, _target in (
     (scan_p, "tpucomm_scan"),
     (bcast_p, "tpucomm_bcast"),
     (alltoall_p, "tpucomm_alltoall"),
-    (sendrecv_p, "tpucomm_sendrecv"),
-    (recv_p, "tpucomm_recv"),
     (send_p, "tpucomm_send"),
     (barrier_p, "tpucomm_barrier"),
     (allgather_p, "tpucomm_allgather"),
@@ -355,6 +368,39 @@ for _p, _target in (
     (scatter_p, "tpucomm_scatter"),
 ):
     _register_ffi_lowering(_p, _target)
+
+
+# recv/sendrecv route around the FFI fast path when the call carries a
+# Status (the fill is a host-side effect the Python callback performs) or
+# split send/recv tags (the strict native sendrecv takes one tag).
+def _recv_ffi_lowering(ctx, *args, **params):
+    from ..runtime import bridge
+
+    if params.get("status") is not None or not bridge.ffi_available():
+        return recv_p._callback_lowering(ctx, *args, **params)
+    params.pop("status", None)
+    return _emit_ffi_call(ctx, "tpucomm_recv", args, _ffi_attrs(**params))
+
+
+def _sendrecv_ffi_lowering(ctx, *args, **params):
+    from ..runtime import bridge
+
+    if (
+        params.get("status") is not None
+        or params["sendtag"] != params["recvtag"]
+        or not bridge.ffi_available()
+    ):
+        return sendrecv_p._callback_lowering(ctx, *args, **params)
+    params.pop("status", None)
+    tag = params.pop("sendtag")
+    params.pop("recvtag")
+    return _emit_ffi_call(
+        ctx, "tpucomm_sendrecv", args, _ffi_attrs(tag=tag, **params)
+    )
+
+
+mlir.register_lowering(recv_p, _recv_ffi_lowering, platform="cpu")
+mlir.register_lowering(sendrecv_p, _sendrecv_ffi_lowering, platform="cpu")
 
 
 # ---------------- AD rules (reference parity) ----------------
@@ -390,26 +436,32 @@ ad.primitive_jvps[allreduce_p] = _allreduce_jvp
 ad.primitive_transposes[allreduce_p] = _allreduce_transpose
 
 
-def _sendrecv_jvp(primals, tangents, *, comm, source, dest, tag):
+def _sendrecv_jvp(primals, tangents, *, comm, source, dest, sendtag,
+                  recvtag, status=None):
     # improvement over the reference (which raises for fwd mode,
-    # sendrecv.py:150-155): tangents ride the same message edge
+    # sendrecv.py:150-155): tangents ride the same message edge.  Only the
+    # primal pass fills a Status — one receive, one record.
     (x,), (t,) = primals, tangents
     primal_out = sendrecv_p.bind(x, comm=comm, source=source, dest=dest,
-                                 tag=tag)
+                                 sendtag=sendtag, recvtag=recvtag,
+                                 status=status)
     if type(t) is ad.Zero:
         tangent_out = ad.Zero.from_primal_value(primal_out)
     else:
         tangent_out = sendrecv_p.bind(
-            t, comm=comm, source=source, dest=dest, tag=tag
+            t, comm=comm, source=source, dest=dest, sendtag=sendtag,
+            recvtag=recvtag, status=None,
         )
     return primal_out, tangent_out
 
 
-def _sendrecv_transpose(ct, x, *, comm, source, dest, tag):
+def _sendrecv_transpose(ct, x, *, comm, source, dest, sendtag, recvtag,
+                        status=None):
     # the cotangent flows backward along the message edge: swap source/dest
     # (reference sendrecv.py:390-409)
     return (
-        sendrecv_p.bind(ct, comm=comm, source=dest, dest=source, tag=tag),
+        sendrecv_p.bind(ct, comm=comm, source=dest, dest=source,
+                        sendtag=sendtag, recvtag=recvtag, status=None),
     )
 
 
@@ -531,8 +583,13 @@ def send(x, dest, tag, comm, token):
     return None
 
 
-def recv(x, source, tag, comm, token):
-    result = recv_p.bind(jnp.asarray(x), comm=comm, source=source, tag=tag)
+def recv(x, source, tag, comm, token, status=None):
+    from ..utils.status import HashableStatus, Status
+
+    if isinstance(status, Status):
+        status = HashableStatus(status)
+    result = recv_p.bind(jnp.asarray(x), comm=comm, source=source, tag=tag,
+                         status=status)
     if token is not None:
         from . import _dispatch
 
@@ -541,12 +598,19 @@ def recv(x, source, tag, comm, token):
 
 
 def sendrecv_dispatch(x, *, perm, shift, wrap, comm, token,
-                      source=None, dest=None, tag=0):
+                      source=None, dest=None, sendtag=0, recvtag=None,
+                      status=None):
     """World-tier sendrecv: per-rank explicit source/dest (reference style).
 
     Accepts explicit ``source``/``dest`` ints, or the mesh-tier
     ``perm``/``shift`` conveniences resolved against this process's rank.
     """
+    from ..utils.status import ANY_TAG, HashableStatus, Status
+
+    if recvtag is None:
+        recvtag = ANY_TAG if status is not None else sendtag
+    if isinstance(status, Status):
+        status = HashableStatus(status)
     rank, size = comm.rank(), comm.size()
     if source is None or dest is None:
         if shift is not None:
@@ -571,7 +635,8 @@ def sendrecv_dispatch(x, *, perm, shift, wrap, comm, token,
             raise ValueError("pass source/dest, perm=, or shift=")
 
     result = sendrecv_p.bind(
-        jnp.asarray(x), comm=comm, source=source, dest=dest, tag=tag
+        jnp.asarray(x), comm=comm, source=source, dest=dest,
+        sendtag=sendtag, recvtag=recvtag, status=status,
     )
     if token is not None:
         from . import _dispatch

@@ -13,14 +13,31 @@ from ..utils import validation as _validation
 from . import _dispatch
 
 
-def recv(x, source, tag=0, *, comm=None, token=None):
+def recv(x, source, tag=None, *, comm=None, token=None, status=None):
     """Receive into the shape/dtype of ``x`` from rank ``source``.
+
+    ``tag=None`` accepts any tag (the reference's ``MPI.ANY_TAG`` default,
+    recv.py:43-50 there); pass an int to require it (a mismatch is a
+    fail-fast transport abort).  ``status``: a
+    :class:`mpi4jax_tpu.Status` filled with the actual
+    (source, tag, byte count) when the receive executes — eagerly or
+    under ``jit`` (reference recv.py:120-123).  ``ANY_SOURCE`` is not
+    supported: the transport matches messages per-socket in program
+    order (see utils/status.py).
 
     World tier only (one process per rank); see module docstring.
     """
+    from ..utils.status import ANY_SOURCE, ANY_TAG, Status
+
     x = _validation.check_array("x", x)
     source = _validation.check_static_int("source", source)
+    if tag is None:
+        tag = ANY_TAG
     tag = _validation.check_static_int("tag", tag)
+    if status is not None and not isinstance(status, Status):
+        raise TypeError(
+            f"status must be an mpi4jax_tpu.Status, got {type(status)}"
+        )
     comm = _dispatch.resolve_comm(comm)
 
     if _dispatch.is_mesh(comm):
@@ -34,5 +51,11 @@ def recv(x, source, tag=0, *, comm=None, token=None):
 
     from . import _world_impl
 
+    if source == ANY_SOURCE:
+        raise NotImplementedError(
+            "ANY_SOURCE is not supported: the ordered transport matches "
+            "messages per-source socket (see mpi4jax_tpu/utils/status.py); "
+            "pass the concrete source rank"
+        )
     _validation.check_in_range("source", source, comm.size())
-    return _world_impl.recv(x, source, tag, comm, token)
+    return _world_impl.recv(x, source, tag, comm, token, status)

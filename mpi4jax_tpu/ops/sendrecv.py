@@ -38,7 +38,8 @@ def _resolve_perm(comm, perm, shift, wrap):
 
 
 def sendrecv(x, *, perm=None, shift=None, wrap=True, source=None, dest=None,
-             tag=0, comm=None, token=None):
+             tag=None, sendtag=0, recvtag=None, status=None, comm=None,
+             token=None):
     """Exchange ``x`` along a static rank permutation.
 
     Each pair ``(s, d)`` in the permutation delivers rank ``s``'s ``x`` to
@@ -47,12 +48,23 @@ def sendrecv(x, *, perm=None, shift=None, wrap=True, source=None, dest=None,
 
     On the world tier (one process per rank) the reference's per-rank
     ``source=``/``dest=`` integers are also accepted
-    (/root/reference/mpi4jax/_src/collective_ops/sendrecv.py:46-125); on
-    the mesh tier a single SPMD program cannot take per-rank arguments —
-    express the pattern as ``perm``/``shift`` instead.
+    (/root/reference/mpi4jax/_src/collective_ops/sendrecv.py:46-125), as
+    are split ``sendtag``/``recvtag`` (sendrecv.py:52-53 there;
+    ``recvtag=None`` matches the send tag, or any tag when ``status`` is
+    given) and ``status`` introspection (filled with the received
+    source/tag/byte-count at execution; tested by
+    tests/collective_ops/test_sendrecv.py:29-61 there).  ``tag=k`` is
+    shorthand for ``sendtag=k, recvtag=k``.  On the mesh tier a single
+    SPMD program cannot take per-rank arguments — express the pattern as
+    ``perm``/``shift`` instead.
     """
     x = _validation.check_array("x", x)
     comm = _dispatch.resolve_comm(comm)
+    if tag is not None:
+        sendtag = recvtag = _validation.check_static_int("tag", tag)
+    sendtag = _validation.check_static_int("sendtag", sendtag)
+    if recvtag is not None:
+        recvtag = _validation.check_static_int("recvtag", recvtag)
 
     if _dispatch.is_mesh(comm):
         if source is not None or dest is not None:
@@ -62,6 +74,18 @@ def sendrecv(x, *, perm=None, shift=None, wrap=True, source=None, dest=None,
                 "all ranks execute one SPMD program. Use the world tier "
                 "(launcher) for per-rank MPMD arguments."
             )
+        if status is not None:
+            raise ValueError(
+                "status introspection is world-tier only: mesh-tier "
+                "sendrecv compiles to lax.ppermute over ICI, which has no "
+                "per-message envelope"
+            )
+        if sendtag != 0 or recvtag is not None:
+            raise ValueError(
+                "message tags are world-tier only: mesh-tier sendrecv "
+                "compiles to lax.ppermute over ICI, which has no tag "
+                "matching"
+            )
         pairs = _resolve_perm(comm, perm, shift, wrap)
         body = lambda v: _mesh_impl.sendrecv(v, pairs, comm.axis)
         return _dispatch.maybe_tokenized(body, x, token)
@@ -70,7 +94,8 @@ def sendrecv(x, *, perm=None, shift=None, wrap=True, source=None, dest=None,
 
     return _world_impl.sendrecv_dispatch(
         x, perm=perm, shift=shift, wrap=wrap, comm=comm, token=token,
-        source=source, dest=dest, tag=tag,
+        source=source, dest=dest, sendtag=sendtag, recvtag=recvtag,
+        status=status,
     )
 
 

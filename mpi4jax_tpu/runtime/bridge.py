@@ -183,9 +183,21 @@ def ffi_available() -> bool:
 
 
 def _abort(opname: str, rc: int):
+    # include the native layer's human-readable reason, the analog of the
+    # reference's ierr -> MPI_Error_string conversion before MPI_Abort
+    # (mpi_xla_bridge.pyx:67-91 there)
+    detail = ""
+    try:
+        lib = get_lib()
+        lib.tpucomm_last_error.restype = ctypes.c_char_p
+        text = (lib.tpucomm_last_error() or b"").decode(errors="replace")
+        if text:
+            detail = f": {text}"
+    except Exception:
+        pass
     print(
-        f"tpucomm_{opname} returned error code {rc}", file=sys.stderr,
-        flush=True,
+        f"tpucomm_{opname} returned error code {rc}{detail}",
+        file=sys.stderr, flush=True,
     )
     # fail-fast across the job: peers will observe dead sockets and abort
     os._exit(1)
@@ -239,6 +251,40 @@ def recv(handle, shape, dtype, source: int, tag: int) -> np.ndarray:
     )
     _check("Recv", rc)
     return out
+
+
+def recv_status(handle, shape, dtype, source: int, tag: int):
+    """recv + (source, tag, byte count) from the transport frame header.
+
+    zeros (not empty): a message shorter than the buffer fills only its
+    prefix, and the tail must be deterministic, not heap garbage.
+    """
+    out = np.zeros(shape, dtype)
+    src = ctypes.c_int32()
+    tg = ctypes.c_int32()
+    cnt = ctypes.c_int64()
+    rc = get_lib().tpucomm_recv_status(
+        _i64(handle), _ptr(out), _i64(out.nbytes), source, tag,
+        ctypes.byref(src), ctypes.byref(tg), ctypes.byref(cnt),
+    )
+    _check("Recv", rc)
+    return out, src.value, tg.value, cnt.value
+
+
+def sendrecv_status(handle, sendbuf, recv_shape, recv_dtype, source, dest,
+                    sendtag, recvtag):
+    sendbuf = _contig(sendbuf)
+    out = np.zeros(recv_shape, recv_dtype)  # deterministic short-message tail
+    src = ctypes.c_int32()
+    tg = ctypes.c_int32()
+    cnt = ctypes.c_int64()
+    rc = get_lib().tpucomm_sendrecv_status(
+        _i64(handle), _ptr(sendbuf), _i64(sendbuf.nbytes), dest,
+        _ptr(out), _i64(out.nbytes), source, sendtag, recvtag,
+        ctypes.byref(src), ctypes.byref(tg), ctypes.byref(cnt),
+    )
+    _check("Sendrecv", rc)
+    return out, src.value, tg.value, cnt.value
 
 
 def sendrecv(handle, sendbuf, recv_shape, recv_dtype, source, dest, tag):

@@ -1,0 +1,93 @@
+"""Receive-status introspection (the MPI_Status analog).
+
+The reference threads a user-supplied ``MPI.Status`` pointer through the
+custom call and lets libmpi fill it at run time
+(/root/reference/mpi4jax/_src/collective_ops/recv.py:120-123,
+mpi_xla_bridge.pyx:23-27 there, tested in
+tests/collective_ops/test_sendrecv.py:29-61).  Here the same contract is
+kept — a mutable :class:`Status` object passed to ``recv``/``sendrecv``
+is filled when the receive executes, eagerly or under ``jit`` — with the
+fill performed by the ordered host callback from the native transport's
+frame header (source, tag, byte count).
+
+Wildcards: ``ANY_TAG`` is supported (the transport reports the tag that
+arrived).  ``ANY_SOURCE`` is exported for API compatibility but rejected
+at call time: the transport matches messages per-socket in program order
+(deadlock-freedom by construction), and wildcard sources would reintroduce
+the nondeterminism that design removes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Accept a message with any tag (reported via :class:`Status`).
+ANY_TAG = -1
+
+#: Exported for source compatibility with the reference API; rejected by
+#: ``recv`` (see module docstring).
+ANY_SOURCE = -2
+
+#: Value of Status fields before any receive has filled them.
+UNDEFINED = -32766
+
+
+class Status:
+    """Mutable record filled by the most recent receive it was passed to.
+
+    Mirrors the ``mpi4py.MPI.Status`` surface the reference tests use:
+    ``Get_source`` / ``Get_tag`` / ``Get_count`` / ``Get_elements``.
+    """
+
+    __slots__ = ("source", "tag", "count_bytes")
+
+    def __init__(self):
+        self.source = UNDEFINED
+        self.tag = UNDEFINED
+        self.count_bytes = UNDEFINED
+
+    def _fill(self, source: int, tag: int, count_bytes: int) -> None:
+        self.source = int(source)
+        self.tag = int(tag)
+        self.count_bytes = int(count_bytes)
+
+    def Get_source(self) -> int:
+        return self.source
+
+    def Get_tag(self) -> int:
+        return self.tag
+
+    def Get_count(self, dtype=None) -> int:
+        """Received size: bytes, or elements when ``dtype`` is given."""
+        if dtype is None:
+            return self.count_bytes
+        return self.count_bytes // np.dtype(dtype).itemsize
+
+    # mpi4py spells element counts Get_elements(datatype)
+    Get_elements = Get_count
+
+    def __repr__(self):
+        return (
+            f"Status(source={self.source}, tag={self.tag}, "
+            f"count_bytes={self.count_bytes})"
+        )
+
+
+class HashableStatus:
+    """Wrap a Status as a hashable static primitive param.
+
+    Keyed on object identity, like the reference's pointer-keyed
+    ``HashableMPIType`` (utils.py:133-152 there): rebinding with a new
+    Status object retraces, rebinding with the same one hits the cache.
+    """
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj: Status):
+        self.obj = obj
+
+    def __hash__(self):
+        return hash(("mpi4jax_tpu.Status", id(self.obj)))
+
+    def __eq__(self, other):
+        return isinstance(other, HashableStatus) and other.obj is self.obj

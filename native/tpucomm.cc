@@ -27,6 +27,7 @@
 
 #include <chrono>
 #include <cmath>
+#include <cstdarg>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -77,11 +78,29 @@ struct LogScope {
   }
 };
 
+/* Last-error text, readable from Python via tpucomm_last_error() so the
+ * abort path can print a human-readable reason next to the error code
+ * (the analog of the reference's ierr -> MPI_Error_string conversion,
+ * mpi_xla_bridge.pyx:67-91 there). */
+char g_last_error[512] = {0};
+std::mutex g_last_error_mu;
+
+void set_last_error(int rank, const char* fmt, ...) {
+  char body[448];
+  va_list ap;
+  va_start(ap, fmt);
+  std::vsnprintf(body, sizeof(body), fmt, ap);
+  va_end(ap);
+  std::lock_guard<std::mutex> lock(g_last_error_mu);
+  std::snprintf(g_last_error, sizeof(g_last_error), "r%d: %s", rank, body);
+}
+
 #define FAIL(comm, ...)                                              \
   do {                                                               \
     std::fprintf(stderr, "tpucomm r%d: ", (comm)->rank);             \
     std::fprintf(stderr, __VA_ARGS__);                               \
     std::fprintf(stderr, "\n");                                      \
+    set_last_error((comm)->rank, __VA_ARGS__);                       \
     return 1;                                                        \
   } while (0)
 
@@ -146,21 +165,41 @@ int send_msg(Comm* c, int dest, int tag, const void* buf, int64_t nbytes) {
   return 0;
 }
 
-int recv_msg(Comm* c, int source, int tag, void* buf, int64_t nbytes) {
+/* MPI_ANY_TAG analog: accept whatever tag arrives (reported via status). */
+constexpr int kAnyTag = -1;
+
+/* Full-featured receive: ANY_TAG wildcard and short messages allowed
+ * (buffer larger than the payload — MPI receive semantics), with the
+ * actual tag/byte-count reported for status introspection.  The strict
+ * recv_msg below keeps the exact-match contract collectives rely on. */
+int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
+                    int32_t* out_tag, int64_t* out_count) {
   if (source < 0 || source >= c->size)
     FAIL(c, "recv from invalid rank %d", source);
   if (source == c->rank) FAIL(c, "recv from self is not supported");
   MsgHeader h{};
   if (read_all(c->socks[source], &h, sizeof(h)))
     FAIL(c, "recv header from %d failed: %s", source, std::strerror(errno));
-  if (h.tag != tag)
+  if (tag != kAnyTag && h.tag != tag)
     FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
          tag, source, h.tag);
-  if (h.nbytes != nbytes)
-    FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
-         source, (long long)nbytes, (long long)h.nbytes);
-  if (read_all(c->socks[source], buf, nbytes))
+  if (h.nbytes > nbytes)
+    FAIL(c, "message truncated: rank %d sent %lld bytes into a %lld-byte "
+         "buffer", source, (long long)h.nbytes, (long long)nbytes);
+  if (read_all(c->socks[source], buf, h.nbytes))
     FAIL(c, "recv payload from %d failed: %s", source, std::strerror(errno));
+  if (out_tag) *out_tag = h.tag;
+  if (out_count) *out_count = h.nbytes;
+  return 0;
+}
+
+int recv_msg(Comm* c, int source, int tag, void* buf, int64_t nbytes) {
+  int64_t count = 0;
+  if (recv_msg_status(c, source, tag, buf, nbytes, nullptr, &count))
+    return 1;
+  if (count != nbytes)
+    FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
+         source, (long long)nbytes, (long long)count);
   return 0;
 }
 
@@ -549,6 +588,46 @@ int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag) {
                    std::to_string(nbytes) + " bytes, tag " +
                    std::to_string(tag) + ")");
   return recv_msg(c, source, tag, buf, nbytes);
+}
+
+const char* tpucomm_last_error(void) {
+  std::lock_guard<std::mutex> lock(g_last_error_mu);
+  return g_last_error;
+}
+
+int tpucomm_recv_status(int64_t h, void* buf, int64_t nbytes, int source,
+                        int tag, int32_t* out_src, int32_t* out_tag,
+                        int64_t* out_count) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Recv",
+               "from " + std::to_string(source) + " (" +
+                   std::to_string(nbytes) + " bytes, tag " +
+                   std::to_string(tag) + ", status)");
+  if (out_src) *out_src = source;
+  return recv_msg_status(c, source, tag, buf, nbytes, out_tag, out_count);
+}
+
+int tpucomm_sendrecv_status(int64_t h, const void* sendbuf,
+                            int64_t send_nbytes, int dest, void* recvbuf,
+                            int64_t recv_nbytes, int source, int sendtag,
+                            int recvtag, int32_t* out_src, int32_t* out_tag,
+                            int64_t* out_count) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(c->mu);
+  LogScope log(c->rank, "Sendrecv",
+               "to " + std::to_string(dest) + " from " +
+                   std::to_string(source) + " (status)");
+  if (out_src) *out_src = source;
+  int send_rc = 0;
+  std::thread sender([&] { send_rc = send_msg(c, dest, sendtag, sendbuf,
+                                              send_nbytes); });
+  int recv_rc = recv_msg_status(c, source, recvtag, recvbuf, recv_nbytes,
+                                out_tag, out_count);
+  sender.join();
+  return send_rc || recv_rc;
 }
 
 int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,

@@ -42,12 +42,28 @@ int tpucomm_rank(int64_t h);
 int tpucomm_size(int64_t h);
 void tpucomm_set_logging(int enabled);
 
+/* Human-readable text for the most recent failure in this process (the
+ * analog of MPI_Error_string); "" if none. */
+const char* tpucomm_last_error(void);
+
 int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
                  int tag);
 int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag);
 int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
                      int dest, void* recvbuf, int64_t recv_nbytes,
                      int source, int tag);
+
+/* Status-reporting variants: tag may be -1 (ANY_TAG); messages shorter
+ * than the buffer are accepted; the actual source/tag/byte-count are
+ * written to the out-params (MPI_Status analog). */
+int tpucomm_recv_status(int64_t h, void* buf, int64_t nbytes, int source,
+                        int tag, int32_t* out_src, int32_t* out_tag,
+                        int64_t* out_count);
+int tpucomm_sendrecv_status(int64_t h, const void* sendbuf,
+                            int64_t send_nbytes, int dest, void* recvbuf,
+                            int64_t recv_nbytes, int source, int sendtag,
+                            int recvtag, int32_t* out_src, int32_t* out_tag,
+                            int64_t* out_count);
 int tpucomm_barrier(int64_t h);
 int tpucomm_bcast(int64_t h, void* buf, int64_t nbytes, int root);
 int tpucomm_gather(int64_t h, const void* sendbuf, int64_t nbytes,

@@ -62,6 +62,15 @@ def test_ordering():
     assert res.returncode == 0, res.stderr + res.stdout
 
 
+def test_status_ops():
+    # status introspection on recv/sendrecv (reference
+    # test_sendrecv.py:29-61): eager, jit, ANY_TAG, split tags, short
+    # messages
+    res = run_launcher("status_ops.py", 2)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("status_ops OK") == 2
+
+
 def test_autodiff():
     res = run_launcher("autodiff.py", 2)
     assert res.returncode == 0, res.stderr + res.stdout
