@@ -283,7 +283,21 @@ def _host_sendrecv(x, *, comm, source, dest, sendtag, recvtag, status=None):
             comm.handle, x, x.shape, x.dtype, source, dest, sendtag,
             recvtag,
         )
-    if status is not None:
+    if status is None:
+        # no status to report a short message through: keep the strict
+        # exact-size fail-fast contract of the plain path
+        if cnt != out.nbytes:
+            import sys
+
+            print(
+                f"tpucomm_Sendrecv: size mismatch from rank {source}: "
+                f"expected {out.nbytes} bytes, got {cnt}",
+                file=sys.stderr, flush=True,
+            )
+            import os
+
+            os._exit(1)
+    else:
         status.obj._fill(src, tg, cnt)
     return out
 
@@ -458,10 +472,21 @@ def _sendrecv_jvp(primals, tangents, *, comm, source, dest, sendtag,
 def _sendrecv_transpose(ct, x, *, comm, source, dest, sendtag, recvtag,
                         status=None):
     # the cotangent flows backward along the message edge: swap source/dest
-    # (reference sendrecv.py:390-409)
+    # (reference sendrecv.py:390-409).  Tags swap with the direction: the
+    # forward edge matched because sendtag(sender) == recvtag(receiver),
+    # so the reversed edge must send with the old recvtag and expect the
+    # old sendtag.  A wildcard recvtag can't be sent on the wire — keep
+    # the own sendtag and accept any, which is consistent on every edge
+    # whose forward recv was also a wildcard.
+    from ..utils.status import ANY_TAG
+
+    if recvtag == ANY_TAG:
+        t_send, t_recv = sendtag, ANY_TAG
+    else:
+        t_send, t_recv = recvtag, sendtag
     return (
         sendrecv_p.bind(ct, comm=comm, source=dest, dest=source,
-                        sendtag=sendtag, recvtag=recvtag, status=None),
+                        sendtag=t_send, recvtag=t_recv, status=None),
     )
 
 

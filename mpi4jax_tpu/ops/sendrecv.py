@@ -80,7 +80,10 @@ def sendrecv(x, *, perm=None, shift=None, wrap=True, source=None, dest=None,
                 "sendrecv compiles to lax.ppermute over ICI, which has no "
                 "per-message envelope"
             )
-        if sendtag != 0 or recvtag is not None:
+        # reject non-default tags loudly (a silently dropped tag would
+        # change matching semantics for ported world code); tag=0 /
+        # matching tags are the no-op spelling and stay accepted
+        if sendtag != 0 or (recvtag is not None and recvtag != sendtag):
             raise ValueError(
                 "message tags are world-tier only: mesh-tier sendrecv "
                 "compiles to lax.ppermute over ICI, which has no tag "

@@ -121,6 +121,16 @@ def get_lib() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
     ]
     lib.tpucomm_set_logging.argtypes = [ctypes.c_int]
+    # guarded: a stale prebuilt .so without split/dup must still serve
+    # the other ops (split then fails at call time, not load time)
+    if hasattr(lib, "tpucomm_split"):
+        lib.tpucomm_split.restype = ctypes.c_int64
+        lib.tpucomm_split.argtypes = [
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        ]
+    if hasattr(lib, "tpucomm_dup"):
+        lib.tpucomm_dup.restype = ctypes.c_int64
+        lib.tpucomm_dup.argtypes = [ctypes.c_int64]
     if config.debug_enabled():
         lib.tpucomm_set_logging(1)
     _lib = lib
@@ -232,6 +242,29 @@ def _ptr(a: np.ndarray):
 
 def _i64(v) -> ctypes.c_int64:
     return ctypes.c_int64(int(v))
+
+
+def split(handle, color: int, key: int):
+    """Collective sub-communicator creation; None when color < 0."""
+    h = get_lib().tpucomm_split(_i64(handle), int(color), int(key))
+    if h == 0:
+        _abort("Split", 1)
+    return None if h == -1 else h
+
+
+def dup(handle):
+    h = get_lib().tpucomm_dup(_i64(handle))
+    if h == 0:
+        _abort("Dup", 1)
+    return h
+
+
+def comm_rank(handle) -> int:
+    return get_lib().tpucomm_rank(_i64(handle))
+
+
+def comm_size(handle) -> int:
+    return get_lib().tpucomm_size(_i64(handle))
 
 
 # Every function below takes/returns contiguous numpy arrays.

@@ -47,13 +47,30 @@ def get_world_comm() -> "WorldComm":
 
 
 class WorldComm:
-    """One-process-per-rank communicator backed by the native transport."""
+    """One-process-per-rank communicator backed by the native transport.
 
-    def __init__(self, rank: int, size: int, coord: str):
+    ``split``/``dup`` create sub-communicators over the same transport,
+    the analog of the reference's arbitrary-mpi4py-comm support (users
+    Split()/Clone() freely, /root/reference/mpi4jax/_src/comm.py:4-11 and
+    docs/sharp-bits.rst:82-143 there).
+    """
+
+    def __init__(self, rank: int, size: int, coord: str, *, handle=None,
+                 lineage=(0,), parent=None):
         self._rank = rank
         self._size = size
         self._coord = coord
-        self._handle = None  # native comm handle, created lazily
+        self._handle = handle  # native comm handle, created lazily
+        # identity of this comm in the split tree: (0,) is the world;
+        # children append (call seq, color).  Deterministic across ranks,
+        # so primitive-param hashes — and therefore cached jaxprs — agree
+        # process-wide (the reference's stable-hash requirement,
+        # utils.py:133-152 there).  Computed without touching the native
+        # handle: hashing must not force a TCP connection at trace time.
+        self._lineage = lineage
+        self._split_seq = 0
+        # keep the parent alive: children borrow its sockets
+        self._parent = parent
 
     def rank(self) -> int:
         return self._rank
@@ -61,17 +78,68 @@ class WorldComm:
     def size(self) -> int:
         return self._size
 
+    def split(self, color: int, key=None):
+        """Collective: ranks sharing ``color`` form a new communicator,
+        ordered by ``(key, parent rank)`` (``key`` defaults to the parent
+        rank). ``color < 0`` opts this rank out and returns None.
+
+        Every member of this comm must call ``split`` at the same program
+        point (it is itself a collective over the parent transport).
+        """
+        from . import bridge
+
+        color = int(color)
+        key = self._rank if key is None else int(key)
+        self._split_seq += 1  # mirrors the native collective-call counter
+        seq = self._split_seq
+        handle = bridge.split(self.handle, color, key)
+        if handle is None:
+            return None
+        return WorldComm(
+            bridge.comm_rank(handle),
+            bridge.comm_size(handle),
+            self._coord,
+            handle=handle,
+            lineage=self._lineage + (seq, color),
+            parent=self,
+        )
+
+    def dup(self):
+        """Collective: same membership, isolated message space (the
+        reference's default-comm Clone() hygiene, comm.py:4-11 there)."""
+        from . import bridge
+
+        # native dup is split(color=0, key=rank) underneath — mirror its
+        # collective-call counter so lineage stays in sync with comm_id
+        self._split_seq += 1
+        seq = self._split_seq
+        handle = bridge.dup(self.handle)
+        return WorldComm(
+            self._rank,
+            self._size,
+            self._coord,
+            handle=handle,
+            lineage=self._lineage + (seq, 0),
+            parent=self,
+        )
+
+    clone = dup
+    Clone = dup
+    Split = split
+
     def __repr__(self):
-        return f"WorldComm(rank={self._rank}, size={self._size})"
+        kind = "WorldComm" if self._parent is None else "SubComm"
+        return f"{kind}(rank={self._rank}, size={self._size})"
 
     def __hash__(self):
-        return hash(("mpi4jax_tpu.WorldComm", self._size))
+        return hash(("mpi4jax_tpu.WorldComm", self._size, self._lineage))
 
     def __eq__(self, other):
         return (
             isinstance(other, WorldComm)
             and other._size == self._size
             and other._rank == self._rank
+            and other._lineage == self._lineage
         )
 
     def __enter__(self):

@@ -25,6 +25,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <chrono>
 #include <cmath>
 #include <cstdarg>
@@ -107,7 +108,7 @@ void set_last_error(int rank, const char* fmt, ...) {
 struct MsgHeader {
   int64_t nbytes;
   int32_t tag;
-  int32_t pad;
+  int32_t comm_id;  // communicator the message belongs to (world = 0)
 };
 
 struct Comm {
@@ -115,6 +116,9 @@ struct Comm {
   int size = 0;
   std::vector<int> socks;  // per-peer fd, -1 for self
   std::mutex mu;           // one op at a time (ordered effects upstream)
+  int32_t comm_id = 0;     // deterministic across ranks (world = 0)
+  bool owns_socks = true;  // split/dup comms borrow the parent's sockets
+  int32_t next_split_seq = 1;  // collective-call counter, agrees rank-wide
 };
 
 std::mutex g_comms_mu;
@@ -158,7 +162,7 @@ int read_all(int fd, void* buf, int64_t n) {
 int send_msg(Comm* c, int dest, int tag, const void* buf, int64_t nbytes) {
   if (dest < 0 || dest >= c->size) FAIL(c, "send to invalid rank %d", dest);
   if (dest == c->rank) FAIL(c, "send to self is not supported");
-  MsgHeader h{nbytes, tag, 0};
+  MsgHeader h{nbytes, tag, c->comm_id};
   if (write_all(c->socks[dest], &h, sizeof(h)) ||
       write_all(c->socks[dest], buf, nbytes))
     FAIL(c, "send to %d failed: %s", dest, std::strerror(errno));
@@ -180,6 +184,11 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
   MsgHeader h{};
   if (read_all(c->socks[source], &h, sizeof(h)))
     FAIL(c, "recv header from %d failed: %s", source, std::strerror(errno));
+  if (h.comm_id != c->comm_id)
+    FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
+         "is comm %d — ops on sibling communicators must run in a "
+         "consistent order on both endpoints", source, h.comm_id,
+         c->comm_id);
   if (tag != kAnyTag && h.tag != tag)
     FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
          tag, source, h.tag);
@@ -552,10 +561,76 @@ void tpucomm_finalize(int64_t h) {
   std::lock_guard<std::mutex> lock(g_comms_mu);
   auto it = g_comms.find(h);
   if (it == g_comms.end()) return;
-  for (int fd : it->second->socks)
-    if (fd >= 0) ::close(fd);
+  if (it->second->owns_socks)
+    for (int fd : it->second->socks)
+      if (fd >= 0) ::close(fd);
   delete it->second;
   g_comms.erase(it);
+}
+
+/* Sub-communicators (the analog of MPI_Comm_split / MPI_Comm_dup —
+ * the reference accepts any mpi4py comm, users Split()/Clone() freely,
+ * comm.py:4-11 + docs/sharp-bits.rst:82-143 there).
+ *
+ * Collective over the parent: every member must call in the same program
+ * position.  Ranks sharing a `color` form a new communicator ordered by
+ * (key, parent rank); color < 0 opts out (returns the null handle -1).
+ * The child borrows the parent's sockets with ranks remapped; message
+ * isolation between sibling comms is enforced by the comm_id carried in
+ * every frame header (mismatch = fail-fast, consistent with the ordered
+ * transport's no-reordering contract). */
+int64_t tpucomm_split(int64_t h, int color, int key) {
+  Comm* c = get_comm(h);
+  if (!c) return 0;
+  std::vector<int32_t> mine{(int32_t)color, (int32_t)key};
+  std::vector<int32_t> all(2 * (size_t)c->size);
+  if (tpucomm_allgather(h, mine.data(), 2 * sizeof(int32_t), all.data()))
+    return 0;
+  int32_t seq;
+  {
+    std::lock_guard<std::mutex> lock(c->mu);
+    seq = c->next_split_seq++;
+  }
+  if (color < 0) return -1;  // null comm: this rank opted out
+
+  std::vector<std::pair<int, int>> members;  // (key, parent rank)
+  for (int r = 0; r < c->size; r++)
+    if (all[2 * r] == color) members.push_back({all[2 * r + 1], r});
+  std::stable_sort(members.begin(), members.end());
+
+  auto* nc = new Comm;
+  nc->size = (int)members.size();
+  nc->socks.assign(nc->size, -1);
+  nc->owns_socks = false;
+  for (int nr = 0; nr < nc->size; nr++) {
+    int old = members[nr].second;
+    if (old == c->rank)
+      nc->rank = nr;
+    else
+      nc->socks[nr] = c->socks[old];
+  }
+  /* FNV mix of (parent id, call seq, color): identical on every member,
+   * distinct across sibling groups and successive splits */
+  uint32_t id = 2166136261u;
+  for (uint32_t v : {(uint32_t)c->comm_id, (uint32_t)seq, (uint32_t)color}) {
+    id ^= v;
+    id *= 16777619u;
+  }
+  nc->comm_id = (int32_t)(id & 0x7fffffff);
+  if (nc->comm_id == 0) nc->comm_id = 1;  // 0 is reserved for the world
+
+  std::lock_guard<std::mutex> lock(g_comms_mu);
+  int64_t nh = g_next_handle++;
+  g_comms[nh] = nc;
+  return nh;
+}
+
+int64_t tpucomm_dup(int64_t h) {
+  Comm* c = get_comm(h);
+  if (!c) return 0;
+  /* split with one shared color, keyed by rank: same membership and
+   * ordering, fresh comm_id (isolated message space) */
+  return tpucomm_split(h, 0, c->rank);
 }
 
 int tpucomm_rank(int64_t h) {

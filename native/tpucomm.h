@@ -42,6 +42,14 @@ int tpucomm_rank(int64_t h);
 int tpucomm_size(int64_t h);
 void tpucomm_set_logging(int enabled);
 
+/* Collective sub-communicator creation (MPI_Comm_split / MPI_Comm_dup
+ * analogs). Returns a new handle, -1 when color < 0 (not a member), or
+ * 0 on failure. The child shares the parent's sockets (keep the parent
+ * alive); frame headers carry the comm id so misrouted messages between
+ * sibling comms abort instead of corrupting. */
+int64_t tpucomm_split(int64_t h, int color, int key);
+int64_t tpucomm_dup(int64_t h);
+
 /* Human-readable text for the most recent failure in this process (the
  * analog of MPI_Error_string); "" if none. */
 const char* tpucomm_last_error(void);

@@ -201,6 +201,18 @@ def test_sendrecv_ring(mesh):
     np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(N), 1))
 
 
+def test_sendrecv_mesh_accepts_default_tags(mesh):
+    # tag=0 / matching tags are the no-op spelling and must keep working
+    # on the mesh tier; a non-default tag is rejected loudly
+    import pytest
+
+    x = jnp.arange(N, dtype=jnp.float32)
+    out = m4j.spmd(lambda v: m4j.sendrecv(v, shift=1, tag=0), mesh=mesh)(x)
+    np.testing.assert_allclose(np.asarray(out), np.roll(np.arange(N), 1))
+    with pytest.raises(ValueError, match="world-tier only"):
+        m4j.spmd(lambda v: m4j.sendrecv(v, shift=1, tag=3), mesh=mesh)(x)
+
+
 def test_sendrecv_ring_backward(mesh):
     x = jnp.arange(N, dtype=jnp.float32)
     out = m4j.spmd(lambda v: m4j.sendrecv(v, shift=-1), mesh=mesh)(x)

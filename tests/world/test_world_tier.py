@@ -62,6 +62,14 @@ def test_ordering():
     assert res.returncode == 0, res.stderr + res.stdout
 
 
+def test_subcomm_ops():
+    # split/dup sub-communicators on a 2x2 rank grid (reference analog:
+    # arbitrary mpi4py comms, comm.py:4-11 + sharp-bits there)
+    res = run_launcher("subcomm_ops.py", 4)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("subcomm_ops OK") == 4
+
+
 def test_status_ops():
     # status introspection on recv/sendrecv (reference
     # test_sendrecv.py:29-61): eager, jit, ANY_TAG, split tags, short

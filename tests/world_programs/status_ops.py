@@ -78,6 +78,17 @@ def main():
         np.testing.assert_allclose(np.asarray(out)[:2], [0.0, 1.0])
         assert status5.Get_count(np.float32) == 2, status5
 
+    # reverse-mode AD with asymmetric split tags: the transpose must swap
+    # tags along with source/dest (forward matched sendtag(s) ==
+    # recvtag(d), so the reversed edge sends with the old recvtag)
+    g = jax.grad(
+        lambda v: m4j.sendrecv(
+            v, source=other, dest=other, sendtag=rank + 1,
+            recvtag=other + 1, comm=comm,
+        ).sum()
+    )(arr)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+
     # explicit-token compat shim carries status too
     from mpi4jax_tpu.compat import token_api
 
