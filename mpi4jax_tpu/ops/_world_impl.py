@@ -222,6 +222,8 @@ def _host_gather(x, *, comm, root):
     from ..runtime import bridge
 
     with tracing.CallTrace(comm.rank(), "Gather", f"root {root}"):
+        # root gets (size, *x.shape); non-root sends and gets x back
+        # (exact reference contract, gather.py:86-96,213-226 there)
         return bridge.gather(comm.handle, x, comm.size(), root, comm.rank())
 
 
@@ -362,12 +364,21 @@ def _stacked_aval(x_aval, *, comm, **params):
     return core.ShapedArray((comm.size(),) + x_aval.shape, x_aval.dtype)
 
 
+def _gather_aval(x_aval, *, comm, root):
+    # rank-dependent output, possible because each world process traces
+    # its own program: root (size, *in), others the input back (exact
+    # reference contract, gather.py:86-96,213-226 there)
+    if comm.rank() == root:
+        return core.ShapedArray((comm.size(),) + x_aval.shape, x_aval.dtype)
+    return core.ShapedArray(x_aval.shape, x_aval.dtype)
+
+
 def _unstacked_aval(x_aval, *, comm, **params):
     return core.ShapedArray(x_aval.shape[1:], x_aval.dtype)
 
 
 allgather_p = _make_primitive("allgather", _stacked_aval, _host_allgather)
-gather_p = _make_primitive("gather", _stacked_aval, _host_gather)
+gather_p = _make_primitive("gather", _gather_aval, _host_gather)
 scatter_p = _make_primitive("scatter", _unstacked_aval, _host_scatter)
 
 for _p, _target in (
@@ -528,7 +539,17 @@ def _leading_axis_batching(p, out_bd):
 
 
 _stacking_batching(allgather_p)
-_stacking_batching(gather_p)
+
+
+def _gather_batching(batched_args, batch_dims, *, comm, root):
+    # root output gains the stacking axis in front (batch axis shifts
+    # right); non-root output is the input unchanged
+    (x,), (bd,) = batched_args, batch_dims
+    out = gather_p.bind(x, comm=comm, root=root)
+    return out, (bd + 1 if comm.rank() == root else bd)
+
+
+batching.primitive_batchers[gather_p] = _gather_batching
 _leading_axis_batching(alltoall_p, out_bd=1)  # out same shape as in
 _leading_axis_batching(scatter_p, out_bd=0)   # out drops axis 0
 

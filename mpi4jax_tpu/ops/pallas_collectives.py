@@ -127,15 +127,24 @@ def _dst_logical(axis, shift):
 
 
 def can_route(axis) -> bool:
-    """True when the DMA path can address the ring: single named axis and
-    every mesh axis manual (so the global logical id is computable)."""
+    """True when the DMA path can address the ring: a single named axis of
+    a non-empty abstract mesh, with every mesh axis manual (so the global
+    logical id is computable from the row-major linearization).
+
+    ``axis in mesh.axis_names`` is required — an axis bound some other way
+    (e.g. by pmap) would fall into ``_dst_logical``'s ring-coordinate
+    fallback, which silently assumes ring coordinate == logical device id;
+    that addressing is unverified there, so such programs keep the XLA
+    collective path instead.
+    """
     if not isinstance(axis, str):
         return False
     try:
         mesh = jax.sharding.get_abstract_mesh()
+        if axis not in mesh.axis_names:
+            return False
         for name in mesh.axis_names:
             lax.axis_index(name)
-        lax.axis_index(axis)
         return True
     except Exception:
         return False
@@ -479,7 +488,11 @@ def _make_alltoall_kernel(n: int):
     i's output (landing at the row indexed by *our* rank) — n simultaneous
     DMAs, one network hop, no ring.  Message from sender s lands in our
     row s and signals our recv semaphore slot s, so each transfer has an
-    unambiguous (row, semaphore) pair."""
+    unambiguous (row, semaphore) pair.  The i == me row is a loopback
+    remote copy to our own logical id — deliberately: ``me`` is a traced
+    scalar, so special-casing it would put a predicated branch in an
+    otherwise uniform descriptor loop to save one local-loop descriptor;
+    correctness is identical either way."""
 
     def kernel(meta_ref, x_ref, o_ref, send_sems, recv_sems):
         me = meta_ref[0]

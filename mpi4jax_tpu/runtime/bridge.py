@@ -387,13 +387,21 @@ def allgather(handle, buf, size: int) -> np.ndarray:
 
 def gather(handle, buf, size: int, root: int, rank: int) -> np.ndarray:
     buf = _contig(buf)
-    # uniform output on all ranks; only root's is meaningful
-    out = np.zeros((size,) + buf.shape, buf.dtype)
+    if rank == root:
+        out = np.empty((size,) + buf.shape, buf.dtype)
+        rc = get_lib().tpucomm_gather(
+            _i64(handle), _ptr(buf), _i64(buf.nbytes), _ptr(out), root
+        )
+        _check("Gather", rc)
+        return out
+    # non-root only sends (the native call ignores recvbuf off-root) and
+    # gets its input back — the exact reference contract
+    # (gather.py:213-226 there)
     rc = get_lib().tpucomm_gather(
-        _i64(handle), _ptr(buf), _i64(buf.nbytes), _ptr(out), root
+        _i64(handle), _ptr(buf), _i64(buf.nbytes), _ptr(buf), root
     )
     _check("Gather", rc)
-    return out
+    return buf
 
 
 def scatter(handle, buf, root: int) -> np.ndarray:

@@ -8,10 +8,6 @@ table, with one parser.
 Knobs (all prefixed ``MPI4JAX_TPU_``):
 
 - ``MPI4JAX_TPU_DEBUG``       — per-call debug tracing (rank | call-id | op | dt).
-- ``MPI4JAX_TPU_PREFER_TOKEN``— route the primary API through the explicit-token
-                                compat layer (inverse of the reference's
-                                ``MPI4JAX_PREFER_NOTOKEN``: ordered-effects /
-                                SPMD ordering is our default, tokens the opt-in).
 - ``MPI4JAX_TPU_TRANSPORT``   — world-tier transport ("tcp" only for now).
 - ``MPI4JAX_TPU_NO_WARN_JAX_VERSION`` — silence the jax version check.
 - ``MPI4JAX_TPU_DISABLE_FFI`` — skip the native XLA FFI custom-call fast
@@ -21,7 +17,16 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels
                                 (``ops/pallas_collectives.py``) instead of
-                                XLA's builtin collectives.
+                                XLA's builtin collectives.  Reverse-mode AD
+                                only: the routed kernels carry a custom_vjp,
+                                so ``jvp``/``jacfwd`` through them raises —
+                                leave the flag off for forward-mode code.
+
+There is intentionally no token/notoken routing knob (the reference's
+``MPI4JAX_PREFER_NOTOKEN``, utils.py:167-169 there): ordered effects ARE
+the core here, and reference-style explicit-token signatures live in
+``mpi4jax_tpu.compat.token_api`` as a direct import — an env var that
+changes the primary API's return types at a distance would be a footgun.
 """
 
 from __future__ import annotations
@@ -55,10 +60,6 @@ def setting(name: str, default: str) -> str:
 
 def debug_enabled() -> bool:
     return flag("MPI4JAX_TPU_DEBUG")
-
-
-def prefer_token() -> bool:
-    return flag("MPI4JAX_TPU_PREFER_TOKEN")
 
 
 def transport_name() -> str:

@@ -119,7 +119,13 @@ struct Comm {
   int32_t comm_id = 0;     // deterministic across ranks (world = 0)
   bool owns_socks = true;  // split/dup comms borrow the parent's sockets
   int32_t next_split_seq = 1;  // collective-call counter, agrees rank-wide
+  Comm* lock_root = this;  // sub-comms serialize on the socket owner's mu:
+                           // two comms sharing fds must never interleave
+                           // header/payload writes on one socket
 };
+
+/* every op entry point locks the socket-owning ancestor */
+std::mutex& comm_mu(Comm* c) { return c->lock_root->mu; }
 
 std::mutex g_comms_mu;
 std::map<int64_t, Comm*> g_comms;

@@ -135,8 +135,13 @@ ffi::Error GatherImpl(ffi::Token, ffi::AnyBuffer x,
                       ffi::Result<ffi::Token>,
                       ffi::Result<ffi::AnyBuffer> out,
                       int64_t comm, int32_t root) {
-  /* uniform output on all ranks, zeros off-root (bridge.py::gather) */
-  std::memset(out->untyped_data(), 0, out->size_bytes());
+  /* rank-dependent result (bridge.py::gather): root's out is the full
+   * (size, ...) stack; non-root's out is x-shaped and gets the input
+   * back (exact reference contract, gather.py:213-226 there; the native
+   * call ignores recvbuf off-root) */
+  if (tpucomm_rank(comm) != root)
+    std::memcpy(out->untyped_data(), x.untyped_data(),
+                (size_t)x.size_bytes());
   check_abort("Gather",
               tpucomm_gather(comm, x.untyped_data(), (int64_t)x.size_bytes(),
                              out->untyped_data(), root));

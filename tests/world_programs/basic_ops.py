@@ -72,11 +72,16 @@ def main():
         np.asarray(sc), [sum(range(1, rank + 2))]
     )
 
-    # gather / scatter
+    # gather / scatter (rank-dependent gather output: root stacks,
+    # non-root gets its input back — reference gather.py:213-226)
     g = m4j.gather(x, root=0, comm=comm)
     if rank == 0:
+        assert g.shape == (size, 4), g.shape
         for r in range(size):
             np.testing.assert_allclose(np.asarray(g)[r], np.arange(4) + r)
+    else:
+        assert g.shape == (4,), g.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(x))
     sc_in = jnp.tile(jnp.arange(size, dtype=jnp.float32)[:, None], (1, 2))
     mine = m4j.scatter(sc_in, root=0, comm=comm)
     np.testing.assert_allclose(np.asarray(mine), float(rank))

@@ -57,6 +57,9 @@ def main():
     if rank == 0:
         for r in range(size):
             np.testing.assert_allclose(np.asarray(g)[r], np.arange(4) + r)
+    else:
+        assert g.shape == x.shape, g.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(x))
     mine = m4j.scatter(
         jnp.tile(jnp.arange(size, dtype=jnp.float32)[:, None], (1, 3)),
         root=0, comm=comm,

@@ -43,9 +43,14 @@ def main():
     loop = jnp.stack([m4j.allgather(x[i], comm=comm) for i in range(B)])
     np.testing.assert_allclose(np.asarray(vm), np.asarray(loop))
 
-    # gather (root-valid only; off-root is zeros on both paths)
+    # gather: rank-dependent output — root (B, size, N) stacks, non-root
+    # gets its batched input back (reference contract)
     vm = jax.vmap(lambda v: m4j.gather(v, root=0, comm=comm))(x)
-    assert vm.shape == (B, size, N)
+    if rank == 0:
+        assert vm.shape == (B, size, N), vm.shape
+    else:
+        assert vm.shape == (B, N), vm.shape
+        np.testing.assert_allclose(np.asarray(vm), np.asarray(x))
     loop = jnp.stack([m4j.gather(x[i], root=0, comm=comm) for i in range(B)])
     np.testing.assert_allclose(np.asarray(vm), np.asarray(loop))
 
