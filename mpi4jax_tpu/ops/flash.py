@@ -45,6 +45,11 @@ NEG_INF = -1e30
 _TRANS_B = (((1,), (1,)), ((), ()))  # contract last dims: x @ y.T
 _TRANS_A = (((0,), (0,)), ((), ()))  # contract first dims: x.T @ y
 
+# Scoped-VMEM budget for the tuned kernels: the (block_q, block_k) f32
+# temporaries at the 1024-block sweet spot exceed Mosaic's 16MB default;
+# v5e has 128MB of VMEM per core.  Shared by the shallow-water kernel.
+VMEM_LIMIT_BYTES = 100 * 1024 * 1024
+
 
 def target_platform() -> str:
     """Platform the surrounding computation executes on.
@@ -83,9 +88,9 @@ def pick_block(t: int, preferred: int) -> int:
 
 
 def _scores(q_ref, k_ref, q_start, k_start, scale, causal, block_q, block_k):
-    q = q_ref[...].astype(jnp.float32)
-    k = k_ref[...].astype(jnp.float32)
-    s = lax.dot_general(q, k, _TRANS_B,
+    # feed the MXU in the input dtype (bf16 x bf16 -> f32 runs at full
+    # rate; upcasting first would force multi-pass f32 matmuls)
+    s = lax.dot_general(q_ref[...], k_ref[...], _TRANS_B,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         rows = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
@@ -120,9 +125,9 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
         alpha = jnp.exp(m_prev - m_next)
         m_s[...] = m_next
         l_s[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[...].astype(jnp.float32)
         acc[...] = acc[...] * alpha + lax.dot(
-            p, v, preferred_element_type=jnp.float32)
+            p.astype(v_ref.dtype), v_ref[...],
+            preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
     def _store():
@@ -172,7 +177,8 @@ def _flash_fwd_block(q, k, v, q_off, k_off, *, scale, causal,
             jax.ShapeDtypeStruct((bh, tq, 1), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(offs, q, k, v)
 
@@ -200,11 +206,10 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
         s = _scores(q_ref, k_ref, q_start, k_start, scale, causal,
                     block_q, block_k)
         p = jnp.exp(s - lse_ref[...])                        # (BQ, BK)
-        do = do_ref[...].astype(jnp.float32)
-        dp = lax.dot_general(do, v_ref[...].astype(jnp.float32), _TRANS_B,
+        dp = lax.dot_general(do_ref[...], v_ref[...], _TRANS_B,
                              preferred_element_type=jnp.float32)
         ds = p * (dp - dlt_ref[...]) * scale
-        dq_acc[...] += lax.dot(ds, k_ref[...].astype(jnp.float32),
+        dq_acc[...] += lax.dot(ds.astype(k_ref.dtype), k_ref[...],
                                preferred_element_type=jnp.float32)
 
     @pl.when(ik == nk - 1)
@@ -232,13 +237,13 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
         s = _scores(q_ref, k_ref, q_start, k_start, scale, causal,
                     block_q, block_k)
         p = jnp.exp(s - lse_ref[...])
-        do = do_ref[...].astype(jnp.float32)
-        dv_acc[...] += lax.dot_general(p, do, _TRANS_A,
+        dv_acc[...] += lax.dot_general(p.astype(do_ref.dtype), do_ref[...],
+                                       _TRANS_A,
                                        preferred_element_type=jnp.float32)
-        dp = lax.dot_general(do, v_ref[...].astype(jnp.float32), _TRANS_B,
+        dp = lax.dot_general(do_ref[...], v_ref[...], _TRANS_B,
                              preferred_element_type=jnp.float32)
         ds = p * (dp - dlt_ref[...]) * scale
-        dk_acc[...] += lax.dot_general(ds, q_ref[...].astype(jnp.float32),
+        dk_acc[...] += lax.dot_general(ds.astype(q_ref.dtype), q_ref[...],
                                        _TRANS_A,
                                        preferred_element_type=jnp.float32)
 
@@ -272,7 +277,10 @@ def _flash_bwd_block(q, k, v, do, lse, delta, q_off, k_off, *,
         ),
         out_shape=[jax.ShapeDtypeStruct((bh, tq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            # (block_q, block_k) f32 temporaries (s/p/dp/ds) blow the
+            # 16MB default scoped-vmem cap at the tuned 1024 blocks
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(offs, q, k, v, do, lse, delta)[0]
 
@@ -294,7 +302,8 @@ def _flash_bwd_block(q, k, v, do, lse, delta, q_off, k_off, *,
         out_shape=[jax.ShapeDtypeStruct((bh, tk, d), jnp.float32),
                    jax.ShapeDtypeStruct((bh, tk, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=VMEM_LIMIT_BYTES),
         interpret=interpret,
     )(offs, q, k, v, do, lse, delta)
     return dq, dk, dv
@@ -368,9 +377,9 @@ def _ring_flash_bwd(axis, causal, scale, block_q, block_k, interpret,
     my = lax.axis_index(axis)
     b, t, h, d = q.shape
     qf, kf, vf = _to_bhtd(q), _to_bhtd(k), _to_bhtd(v)
-    dof = _to_bhtd(g).astype(jnp.float32)
+    dof = _to_bhtd(g)  # keep cotangent in its own dtype for bf16 MXU dots
     outf = _to_bhtd(out).astype(jnp.float32)
-    delta = jnp.sum(dof * outf, axis=-1, keepdims=True)
+    delta = jnp.sum(dof.astype(jnp.float32) * outf, axis=-1, keepdims=True)
     q_off = my * t
 
     def step(carry, i):
@@ -401,7 +410,7 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_flash_attention(q, k, v, *, axis, causal=False, scale=None,
-                         block_q=128, block_k=128, interpret=None):
+                         block_q=1024, block_k=1024, interpret=None):
     """Ring attention with Pallas flash kernels for the local blocks.
 
     Same contract as :func:`mpi4jax_tpu.parallel.ring.ring_attention`:

@@ -18,12 +18,14 @@ def recv(x, source, tag=None, *, comm=None, token=None, status=None):
 
     ``tag=None`` accepts any tag (the reference's ``MPI.ANY_TAG`` default,
     recv.py:43-50 there); pass an int to require it (a mismatch is a
-    fail-fast transport abort).  ``status``: a
+    fail-fast transport abort).  ``source`` may be
+    :data:`mpi4jax_tpu.ANY_SOURCE` — the reference's *default*
+    (recv.py:45 there; libmpi matches the wildcard natively): the
+    transport polls every peer socket and takes the first complete
+    frame, per-socket order still strict.  ``status``: a
     :class:`mpi4jax_tpu.Status` filled with the actual
     (source, tag, byte count) when the receive executes — eagerly or
-    under ``jit`` (reference recv.py:120-123).  ``ANY_SOURCE`` is not
-    supported: the transport matches messages per-socket in program
-    order (see utils/status.py).
+    under ``jit`` (reference recv.py:120-123).
 
     World tier only (one process per rank); see module docstring.
     """
@@ -51,11 +53,6 @@ def recv(x, source, tag=None, *, comm=None, token=None, status=None):
 
     from . import _world_impl
 
-    if source == ANY_SOURCE:
-        raise NotImplementedError(
-            "ANY_SOURCE is not supported: the ordered transport matches "
-            "messages per-source socket (see mpi4jax_tpu/utils/status.py); "
-            "pass the concrete source rank"
-        )
-    _validation.check_in_range("source", source, comm.size())
+    if source != ANY_SOURCE:
+        _validation.check_in_range("source", source, comm.size())
     return _world_impl.recv(x, source, tag, comm, token, status)

@@ -44,8 +44,8 @@ def _ring_shift_many(xs, axis):
 
 
 def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None,
-                   impl: str = "auto", block_q: int = 128,
-                   block_k: int = 128):
+                   impl: str = "auto", block_q: int = None,
+                   block_k: int = None):
     """Exact (flash-accumulated) attention across a sequence-sharded ring.
 
     Args:
@@ -59,7 +59,8 @@ def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None,
             ``"xla"`` — fused-einsum flash recurrence below; ``"auto"``
             picks pallas.
         block_q, block_k: Pallas tile sizes (clamped to divisors of
-            ``T_local``).
+            ``T_local``); default: ``ring_flash_attention``'s tuned
+            1024-block configuration.
 
     Returns:
         ``(B, T_local, H, D)`` attention output, sequence-sharded like q.
@@ -82,9 +83,13 @@ def ring_attention(q, k, v, *, axis, causal: bool = False, scale=None,
                 "value (use impl='xla' for a learnable scale)")
         from ..ops.flash import ring_flash_attention
 
+        kw = {}
+        if block_q is not None:
+            kw["block_q"] = block_q
+        if block_k is not None:
+            kw["block_k"] = block_k
         return ring_flash_attention(
-            q, k, v, axis=axis, causal=causal, scale=scale,
-            block_q=block_q, block_k=block_k)
+            q, k, v, axis=axis, causal=causal, scale=scale, **kw)
     size = lax.axis_size(axis)
     my_block = lax.axis_index(axis)
     b, t_loc, h, d = q.shape

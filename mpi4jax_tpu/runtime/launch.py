@@ -32,9 +32,27 @@ def main(argv=None):
                         help="base TCP port (default: derived from pid)")
     parser.add_argument("--platform", default=None,
                         help="JAX_PLATFORMS for the ranks (default: cpu)")
+    parser.add_argument("--hosts", default=None,
+                        help="comma-separated per-rank host list for the "
+                             "native transport (pod/DCN layout; default: "
+                             "all ranks on 127.0.0.1). Rank i listens on "
+                             "hosts[i]; peers dial it there. NOTE: this "
+                             "launcher always spawns every rank locally "
+                             "(the list is for multi-homed hosts and "
+                             "loopback-alias testing); on a real pod, "
+                             "start one process per rank with your "
+                             "scheduler and set MPI4JAX_TPU_RANK/SIZE "
+                             "plus MPI4JAX_TPU_HOSTS directly.")
     parser.add_argument("prog", help="python program to run")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.hosts:
+        nhosts = len(args.hosts.split(","))
+        if nhosts != args.np:
+            parser.error(
+                f"--hosts lists {nhosts} entries for {args.np} ranks"
+            )
 
     base_port = args.port or (40000 + os.getpid() % 20000)
     procs = []
@@ -43,6 +61,8 @@ def main(argv=None):
         env["MPI4JAX_TPU_RANK"] = str(rank)
         env["MPI4JAX_TPU_SIZE"] = str(args.np)
         env["MPI4JAX_TPU_COORD"] = f"127.0.0.1:{base_port}"
+        if args.hosts:
+            env["MPI4JAX_TPU_HOSTS"] = args.hosts
         if args.platform:
             env["JAX_PLATFORMS"] = args.platform
         else:

@@ -11,10 +11,12 @@ fill performed by the ordered host callback from the native transport's
 frame header (source, tag, byte count).
 
 Wildcards: ``ANY_TAG`` is supported (the transport reports the tag that
-arrived).  ``ANY_SOURCE`` is exported for API compatibility but rejected
-at call time: the transport matches messages per-socket in program order
-(deadlock-freedom by construction), and wildcard sources would reintroduce
-the nondeterminism that design removes.
+arrived), and so is ``ANY_SOURCE`` (the reference's default source,
+recv.py:45 there): the native transport polls every peer socket and
+takes whichever completes a frame first, reporting the actual source
+through the Status.  Per-socket order stays strict, so a wildcard
+receive composes with — rather than replaces — the ordered-transport
+contract.
 """
 
 from __future__ import annotations
@@ -24,8 +26,9 @@ import numpy as np
 #: Accept a message with any tag (reported via :class:`Status`).
 ANY_TAG = -1
 
-#: Exported for source compatibility with the reference API; rejected by
-#: ``recv`` (see module docstring).
+#: Accept a message from any peer (first complete frame wins; the actual
+#: sender is reported via :class:`Status`).  Matches the reference's
+#: ``MPI.ANY_SOURCE`` default for ``recv``.
 ANY_SOURCE = -2
 
 #: Value of Status fields before any receive has filled them.

@@ -54,6 +54,9 @@ int64_t tpucomm_dup(int64_t h);
  * analog of MPI_Error_string); "" if none. */
 const char* tpucomm_last_error(void);
 
+/* Point-to-point.  dest/source == own rank is legal (MPI-style
+ * self-messaging: send enqueues on an in-process queue, recv pops it;
+ * source may also be -2 = ANY_SOURCE, resolved by polling all peers). */
 int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
                  int tag);
 int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag);

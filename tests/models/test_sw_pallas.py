@@ -19,11 +19,12 @@ def _model(ny=32, nx=64):
     return ShallowWater(grid, (ny, nx), SWParams(dx=5e3, dy=5e3))
 
 
-def _advance(model, impl, n_steps):
+def _advance(model, impl, n_steps, **kw):
     state = model.init()
-    state = model.step_fn(1, first=True, impl=impl)(state)
+    state = model.step_fn(1, first=True, impl=impl, **kw)(state)
     if n_steps > 1:
-        state = model.step_fn(n_steps - 1, first=False, impl=impl)(state)
+        state = model.step_fn(n_steps - 1, first=False, impl=impl, **kw)(
+            state)
     return state
 
 
@@ -51,6 +52,21 @@ def test_fused_step_tile_edge_cases():
                 np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6,
                 err_msg=f"domain ({ny},{nx})",
             )
+
+
+@pytest.mark.parametrize("tile_rows,fuse", [(16, 1), (16, 2), (32, 2)])
+def test_fused_step_multi_tile(tile_rows, fuse):
+    """Force ntiles >= 2 so the clamped interior halo index maps and the
+    cross-tile halo consistency under temporal blocking actually run (the
+    tuned defaults pad the small CI domains into a single tile)."""
+    model = _model(ny=70, nx=32)  # nyp=72 -> >= 3 tiles at T=16/32
+    ref = _advance(model, "xla", 7)
+    got = _advance(model, "pallas", 7, tile_rows=tile_rows, fuse=fuse)
+    for name, a, b in zip(ref._fields, got, ref):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=1e-6,
+            err_msg=f"field {name} tile_rows={tile_rows} fuse={fuse}",
+        )
 
 
 def test_fused_step_conserves_mass():

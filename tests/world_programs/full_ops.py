@@ -1,0 +1,215 @@
+"""Full per-op identity battery for the world tier (run under the
+launcher) — the multi-process twin of the mesh tier's coverage, matching
+the reference's dual-mode CI where the *entire* suite runs again under
+``mpirun -np 2`` (reference .github/workflows/mpi-tests.yml:74-90,
+docs/developers.rst:16-28 there).
+
+Covers, per op: dtype sweep (bf16/f16/f32/f64/ints/bool/complex),
+identity vs closed form, double-transpose ≡ identity (reference
+test_allreduce.py:105-138), vmap, and grad/jvp where the op supports
+autodiff.  Any assertion failure exits nonzero -> failed job.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+REDUCE_DTYPES = [
+    jnp.bfloat16, jnp.float16, jnp.float32, jnp.float64,
+    jnp.int8, jnp.int16, jnp.int32, jnp.int64,
+    jnp.uint8, jnp.uint16, jnp.uint32, jnp.uint64,
+]
+MOVE_DTYPES = REDUCE_DTYPES + [jnp.bool_, jnp.complex64, jnp.complex128]
+
+
+def _mk(dtype, rank, n=4):
+    if dtype == jnp.bool_:
+        return jnp.asarray([True, False, True, bool(rank % 2)])
+    if jnp.issubdtype(dtype, jnp.complexfloating):
+        return (jnp.arange(n) + 1j * (rank + 1)).astype(dtype)
+    return (jnp.arange(n, dtype=jnp.float64) + rank).astype(dtype)
+
+
+def _f64(a):
+    a = np.asarray(a)
+    return a.astype(np.complex128 if np.iscomplexobj(a) else np.float64)
+
+
+def check_allreduce_dtypes(comm, rank, size):
+    for dtype in REDUCE_DTYPES:
+        x = _mk(dtype, rank)
+        out = m4j.allreduce(x, op=m4j.SUM, comm=comm)
+        assert out.dtype == x.dtype, (dtype, out.dtype)
+        expect = np.arange(4) * size + sum(range(size))
+        np.testing.assert_allclose(_f64(out), expect, rtol=1e-2)
+        out = m4j.allreduce(x, op=m4j.MAX, comm=comm)
+        np.testing.assert_allclose(_f64(out), np.arange(4) + size - 1,
+                                   rtol=1e-2)
+    # complex SUM / PROD
+    for dtype in (jnp.complex64, jnp.complex128):
+        x = jnp.full((3,), 1 + 1j, dtype)
+        out = m4j.allreduce(x, op=m4j.SUM, comm=comm)
+        np.testing.assert_allclose(_f64(out), size * (1 + 1j))
+        out = m4j.allreduce(x, op=m4j.PROD, comm=comm)
+        np.testing.assert_allclose(_f64(out), (1 + 1j) ** size)
+    # bool logical ops
+    mine = jnp.asarray([rank == 0, True, False])
+    lor = m4j.allreduce(mine, op=m4j.LOR, comm=comm)
+    np.testing.assert_array_equal(np.asarray(lor), [True, True, False])
+    land = m4j.allreduce(mine, op=m4j.LAND, comm=comm)
+    np.testing.assert_array_equal(np.asarray(land), [size == 1, True, False])
+    # int bitwise
+    bits = jnp.asarray([1 << rank, 3], jnp.int32)
+    bor = m4j.allreduce(bits, op=m4j.BOR, comm=comm)
+    np.testing.assert_array_equal(np.asarray(bor), [(1 << size) - 1, 3])
+
+
+def check_movement_dtypes(comm, rank, size):
+    """allgather / alltoall / bcast / gather / scatter / sendrecv / scan
+    across the dtype table."""
+    for dtype in MOVE_DTYPES:
+        x = _mk(dtype, rank)
+        ag = m4j.allgather(x, comm=comm)
+        assert ag.shape == (size, 4) and ag.dtype == x.dtype
+        for r in range(size):
+            np.testing.assert_allclose(_f64(ag[r]), _f64(_mk(dtype, r)),
+                                       rtol=1e-2)
+
+        a2a_in = jnp.stack([_mk(dtype, rank)] * size)
+        a2a = m4j.alltoall(a2a_in, comm=comm)
+        for r in range(size):
+            np.testing.assert_allclose(_f64(a2a[r]), _f64(_mk(dtype, r)),
+                                       rtol=1e-2)
+
+        b = m4j.bcast(x, root=size - 1, comm=comm)
+        np.testing.assert_allclose(_f64(b), _f64(_mk(dtype, size - 1)),
+                                   rtol=1e-2)
+
+        g = m4j.gather(x, root=0, comm=comm)
+        if rank == 0:
+            for r in range(size):
+                np.testing.assert_allclose(_f64(g[r]), _f64(_mk(dtype, r)),
+                                           rtol=1e-2)
+
+        sc_in = jnp.stack([_mk(dtype, r) for r in range(size)])
+        mine = m4j.scatter(sc_in, root=0, comm=comm)
+        np.testing.assert_allclose(_f64(mine), _f64(_mk(dtype, rank)),
+                                   rtol=1e-2)
+
+        ring = m4j.sendrecv(x, shift=1, comm=comm)
+        np.testing.assert_allclose(
+            _f64(ring), _f64(_mk(dtype, (rank - 1) % size)), rtol=1e-2)
+
+    # scan on ordered dtypes
+    for dtype in (jnp.float32, jnp.float64, jnp.int32, jnp.bfloat16):
+        sc = m4j.scan(jnp.ones((2,), dtype) * (rank + 1), op=m4j.SUM,
+                      comm=comm)
+        np.testing.assert_allclose(_f64(sc), sum(range(1, rank + 2)),
+                                   rtol=1e-2)
+
+
+def check_transpose_identities(comm, rank, size):
+    """Reference test_allreduce.py:105-138: linear_transpose of
+    allreduce-SUM is identity-shaped, and the double transpose equals the
+    original allreduce.  Same for the sendrecv ring (source/dest swap)."""
+    x = jnp.arange(4, dtype=jnp.float32) + rank
+
+    def ar(v):
+        return m4j.allreduce(v, op=m4j.SUM, comm=comm)
+
+    (xt,) = jax.linear_transpose(ar, x)(jnp.ones((4,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(xt), 1.0)
+
+    def double_t(v):
+        def t1(u):
+            return jax.linear_transpose(ar, x)(u)[0]
+
+        return jax.linear_transpose(t1, jnp.ones((4,), jnp.float32))(v)[0]
+
+    np.testing.assert_allclose(
+        np.asarray(double_t(x)), np.asarray(ar(x)), rtol=1e-6)
+
+    def ring(v):
+        return m4j.sendrecv(v, shift=1, comm=comm)
+
+    # transpose of shift +1 routes the cotangent back along shift -1:
+    # transposing twice restores the original routing
+    def ring_double_t(v):
+        def t1(u):
+            return jax.linear_transpose(ring, x)(u)[0]
+
+        return jax.linear_transpose(t1, x)(v)[0]
+
+    np.testing.assert_allclose(
+        np.asarray(ring_double_t(x)), np.asarray(ring(x)), rtol=1e-6)
+
+    # grad through allreduce (SUM-only autodiff, reference
+    # allreduce.py:188-218: the transpose lowers to *identity*, so the
+    # cotangent passes through unreduced) and through the ring
+    g = jax.grad(lambda v: ar(v).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), 1.0)
+    g = jax.grad(lambda v: (ring(v) * (rank + 1.0)).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), float((rank + 1) % size + 1))
+
+    # jvp through allreduce
+    _, tang = jax.jvp(ar, (x,), (jnp.ones_like(x),))
+    np.testing.assert_allclose(np.asarray(tang), float(size))
+
+
+def check_vmap(comm, rank, size):
+    xb = jnp.stack([jnp.arange(4, dtype=jnp.float32) + rank,
+                    jnp.full((4,), float(rank))])
+
+    out = jax.vmap(lambda v: m4j.allreduce(v, op=m4j.SUM, comm=comm))(xb)
+    np.testing.assert_allclose(
+        np.asarray(out)[0], np.arange(4) * size + sum(range(size)))
+    np.testing.assert_allclose(np.asarray(out)[1], sum(range(size)))
+
+    out = jax.vmap(lambda v: m4j.allgather(v, comm=comm))(xb)
+    assert out.shape == (2, size, 4)
+    for r in range(size):
+        np.testing.assert_allclose(np.asarray(out)[1, r], float(r))
+
+    out = jax.vmap(lambda v: m4j.sendrecv(v, shift=1, comm=comm))(xb)
+    np.testing.assert_allclose(
+        np.asarray(out)[1], float((rank - 1) % size))
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size >= 2, "run under the launcher with -n >= 2"
+
+    check_allreduce_dtypes(comm, rank, size)
+    check_movement_dtypes(comm, rank, size)
+    check_transpose_identities(comm, rank, size)
+    check_vmap(comm, rank, size)
+
+    # everything again under one jit (effects thread through one program)
+    def prog(v):
+        a = m4j.allreduce(v, op=m4j.SUM, comm=comm)
+        b = m4j.sendrecv(a, shift=1, comm=comm)
+        c = m4j.allgather(b, comm=comm)
+        return c.sum()
+
+    x = jnp.arange(4, dtype=jnp.float32) + rank
+    got = jax.jit(prog)(x)
+    expect = (np.arange(4) * size + sum(range(size))).sum() * size
+    np.testing.assert_allclose(float(got), expect)
+
+    print(f"rank {rank}: full_ops OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

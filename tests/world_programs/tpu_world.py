@@ -1,0 +1,104 @@
+"""World tier ON THE TPU PLATFORM — the staging-tier evidence run.
+
+A 1-rank world job executed with the TPU runtime (no JAX_PLATFORMS=cpu
+pin): every world op lowers to the ordered host callback, which on this
+platform IS the HBM→host staging path (the structural analog of the
+reference's GPU bridge staging D2H → MPI → H2D,
+mpi_xla_bridge_gpu.pyx:233-251 there).  Exercises every collective, the
+p2p ops via MPI-style self-messaging, Status introspection, ordering
+inside lax.scan, and grad — all under jit on the accelerator runtime.
+
+Launched by bench.py with --platform left to the ambient TPU backend;
+also runnable by hand:
+    python -m mpi4jax_tpu.runtime.launch -n 1 --platform tpu,cpu \
+        tests/world_programs/tpu_world.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+
+def main():
+    dev = jax.devices()[0]
+    platform = dev.platform
+    assert platform != "cpu", (
+        f"this program must run on the accelerator runtime, got {platform}"
+    )
+
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+
+    x = jnp.arange(8, dtype=jnp.float32) + rank
+
+    # every collective, eagerly (device buffers staged through the host)
+    out = m4j.allreduce(x, op=m4j.SUM, comm=comm)
+    expect = np.arange(8) * size + sum(range(size))
+    np.testing.assert_allclose(np.asarray(out), expect)
+    np.testing.assert_allclose(
+        np.asarray(m4j.allreduce(x, op=m4j.MAX, comm=comm)),
+        np.arange(8) + size - 1)
+    ag = m4j.allgather(x, comm=comm)
+    assert ag.shape == (size, 8)
+    a2a = m4j.alltoall(jnp.stack([x] * size), comm=comm)
+    assert a2a.shape == (size, 8)
+    np.testing.assert_allclose(
+        np.asarray(m4j.bcast(x, root=0, comm=comm)), np.arange(8))
+    red = m4j.reduce(x, op=m4j.SUM, root=0, comm=comm)
+    if rank == 0:
+        np.testing.assert_allclose(np.asarray(red), expect)
+    sc = m4j.scan(x, op=m4j.SUM, comm=comm)
+    np.testing.assert_allclose(
+        np.asarray(sc), np.cumsum([np.arange(8) + r for r in range(rank + 1)],
+                                  axis=0)[-1])
+    g = m4j.gather(x, root=0, comm=comm)
+    if rank == 0:
+        assert g.shape == (size, 8)
+    mine = m4j.scatter(jnp.stack([x] * size), root=0, comm=comm)
+    np.testing.assert_allclose(np.asarray(mine), np.asarray(x))
+    m4j.barrier(comm=comm)
+
+    # p2p + Status via self-messaging (reference allows self-sendrecv —
+    # its exit-flush regression depends on it, test_common.py:91-114)
+    st = m4j.Status()
+    out = m4j.sendrecv(x, source=rank, dest=rank, status=st, comm=comm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    assert st.Get_source() == rank and st.Get_count(np.float32) == 8, st
+
+    m4j.send(x * 2, dest=rank, tag=9, comm=comm)
+    st2 = m4j.Status()
+    out = m4j.recv(x, source=m4j.ANY_SOURCE, status=st2, comm=comm)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 2)
+    assert st2.Get_source() == rank and st2.Get_tag() == 9, st2
+
+    # the whole stack under one jit on the TPU runtime: ordered effects
+    # must serialize the callbacks inside lax.scan (the reference's
+    # fori_loop halo pattern, shallow_water.py:415-420 there)
+    def body(carry, _):
+        carry = m4j.allreduce(carry, op=m4j.SUM, comm=comm) / size
+        carry = m4j.sendrecv(carry, source=rank, dest=rank, comm=comm)
+        return carry, ()
+
+    looped, _ = jax.jit(
+        lambda v: jax.lax.scan(body, v, None, length=4)
+    )(jnp.ones((4,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(looped), 1.0, rtol=1e-6)
+
+    # autodiff through the staged path
+    grad = jax.grad(
+        lambda v: m4j.allreduce(v, op=m4j.SUM, comm=comm).sum()
+    )(x)
+    np.testing.assert_allclose(np.asarray(grad), 1.0)
+
+    print(f"tpu_world OK (rank {rank}, platform {platform})", flush=True)
+
+
+if __name__ == "__main__":
+    main()

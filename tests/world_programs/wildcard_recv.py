@@ -1,0 +1,131 @@
+"""ANY_SOURCE wildcard receive (reference parity: MPI.ANY_SOURCE is the
+reference's *default* recv source, recv.py:45 there; libmpi matches the
+wildcard natively).  The native transport polls across peer sockets and
+takes the first complete frame; the Status reports who actually sent.
+
+Run at -n 4: rank 0 collects from everyone via wildcards — eagerly,
+under jit, mixed with directed receives, and with ANY_TAG."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size == 4, "run with -n 4"
+
+    template = jnp.zeros((4,), jnp.float32)
+
+    # --- 1. pure wildcard: rank 0 drains one message from each sender ---
+    if rank == 0:
+        got = {}
+        for _ in range(size - 1):
+            status = m4j.Status()
+            out = m4j.recv(
+                template, source=m4j.ANY_SOURCE, status=status, comm=comm
+            )
+            src = status.Get_source()
+            assert src not in got, f"duplicate source {src}"
+            got[src] = np.asarray(out)
+            assert status.Get_tag() == 100 + src, status
+        assert sorted(got) == [1, 2, 3], got
+        for src, val in got.items():
+            np.testing.assert_allclose(val, float(src))
+        # phase gate: senders must not race ahead, or their next-phase
+        # frames would be wildcard-eligible here
+        for r in (1, 2, 3):
+            m4j.send(template, dest=r, tag=99, comm=comm)
+    else:
+        m4j.send(template + rank, dest=0, tag=100 + rank, comm=comm)
+        m4j.recv(template, source=0, tag=99, comm=comm)  # phase gate
+
+    # --- 2. mixed wildcard/directed ordering: a directed recv must pull
+    # from its own socket even when wildcard-eligible frames from other
+    # peers are already waiting ---
+    if rank == 0:
+        # give the sends time to land so wildcard-eligible frames are
+        # already queued when the directed recv runs (can't barrier here:
+        # barrier frames would queue behind the un-received data frames
+        # on these same ordered sockets)
+        import time
+
+        time.sleep(0.3)
+        status_d = m4j.Status()
+        out = m4j.recv(
+            template, source=2, tag=m4j.ANY_TAG, status=status_d, comm=comm
+        )
+        np.testing.assert_allclose(np.asarray(out), 20.0)
+        assert status_d.Get_source() == 2 and status_d.Get_tag() == 202
+        seen = set()
+        for _ in range(2):
+            status_w = m4j.Status()
+            out = m4j.recv(
+                template, source=m4j.ANY_SOURCE, tag=m4j.ANY_TAG,
+                status=status_w, comm=comm,
+            )
+            src = status_w.Get_source()
+            seen.add(src)
+            np.testing.assert_allclose(np.asarray(out), src * 10.0)
+            assert status_w.Get_tag() == 200 + src
+        assert seen == {1, 3}, seen
+    else:
+        m4j.send(template + rank * 10.0, dest=0, tag=200 + rank, comm=comm)
+
+    # --- 3. wildcard under jit (status filled by the ordered callback) ---
+    # rank 1 must not send before phase 2 is fully drained, or its
+    # phase-3 frame would be wildcard-eligible there: rank 0 posts an
+    # explicit go-ahead
+    if rank == 0:
+        m4j.send(template, dest=1, tag=300, comm=comm)
+        status_j = m4j.Status()
+        out = jax.jit(
+            lambda v: m4j.recv(
+                v, source=m4j.ANY_SOURCE, status=status_j, comm=comm
+            )
+        )(template)
+        np.testing.assert_allclose(
+            np.asarray(out), float(status_j.Get_source())
+        )
+        assert status_j.Get_source() in (1, 2, 3), status_j
+        assert status_j.Get_count(np.float32) == 4, status_j
+    elif rank == 1:
+        m4j.recv(template, source=0, tag=300, comm=comm)  # go-ahead
+        m4j.send(template + 1.0, dest=0, tag=0, comm=comm)
+    # ranks 2, 3 idle in phase 3 (exactly one jit message outstanding)
+
+    # --- 4. concrete-tag wildcard must skip a mismatched self head and
+    # match the peer frame instead (regression: the self-queue shortcut
+    # used to pop unconditionally and abort on the tag mismatch) ---
+    if rank == 0:
+        m4j.send(template, dest=3, tag=301, comm=comm)  # phase gate
+        m4j.send(template + 7.0, dest=0, tag=7, comm=comm)  # self, tag 7
+        status_m = m4j.Status()
+        out = m4j.recv(
+            template, source=m4j.ANY_SOURCE, tag=5, status=status_m,
+            comm=comm,
+        )
+        np.testing.assert_allclose(np.asarray(out), 5.0)
+        assert status_m.Get_source() == 3 and status_m.Get_tag() == 5
+        out = m4j.recv(template, source=0, tag=7, comm=comm)  # drain self
+        np.testing.assert_allclose(np.asarray(out), 7.0)
+    elif rank == 3:
+        m4j.recv(template, source=0, tag=301, comm=comm)  # phase gate
+        m4j.send(template + 5.0, dest=0, tag=5, comm=comm)
+
+    print(f"wildcard_recv OK (rank {rank})")
+
+
+if __name__ == "__main__":
+    main()
