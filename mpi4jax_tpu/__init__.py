@@ -40,6 +40,7 @@ from .ops import (  # noqa: E402
     barrier,
     bcast,
     create_token,
+    custom_op,
     gather,
     permute,
     recv,
@@ -107,6 +108,7 @@ __all__ = [
     "sendrecv",
     "ReduceOp",
     "as_reduce_op",
+    "custom_op",
     "ALL_OPS",
     "SUM",
     "PROD",

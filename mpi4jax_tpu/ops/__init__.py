@@ -20,6 +20,7 @@ from .reduce_ops import (
     SUM,
     ReduceOp,
     as_reduce_op,
+    custom_op,
 )
 from .scan import scan
 from .scatter import scatter
@@ -44,6 +45,7 @@ __all__ = [
     "sendrecv",
     "ReduceOp",
     "as_reduce_op",
+    "custom_op",
     "ALL_OPS",
     "SUM",
     "PROD",

@@ -120,6 +120,11 @@ def allreduce(x, op: ReduceOp, axis):
         return lax.pmax(x, axis)
     if op.lax_kind == "min":
         return lax.pmin(x, axis)
+    if op.custom:
+        # user-defined: always the generic gather+reduce path — the
+        # domain-based fast paths below are for the named builtins only
+        stacked = lax.all_gather(x, axis, axis=0, tiled=False)
+        return op.reduce(stacked).astype(x.dtype)
     if op.domain == "bool":
         # Logical ops ride the fused min/max collectives on a 0/1 view
         # (truthiness, so integer inputs behave like MPI's logical ops).

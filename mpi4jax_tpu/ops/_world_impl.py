@@ -569,18 +569,37 @@ batching.primitive_batchers[send_p] = _send_batching
 
 def allreduce(x, op: ReduceOp, comm):
     op.check_dtype(jnp.result_type(x))
-    return allreduce_p.bind(jnp.asarray(x), comm=comm, op=op,
-                            transpose=False)
+    x = jnp.asarray(x)
+    if op.custom:
+        # user-defined op: the wire protocol carries no user code, so
+        # compose from allgather + a local jax fold (the analog of the
+        # reference handing a user MPI_Op to libmpi, utils.py:133-152)
+        rows = allgather_p.bind(x, comm=comm)
+        return op.reduce(rows).astype(x.dtype)
+    return allreduce_p.bind(x, comm=comm, op=op, transpose=False)
 
 
 def reduce(x, op: ReduceOp, root, comm):
     op.check_dtype(jnp.result_type(x))
-    return reduce_p.bind(jnp.asarray(x), comm=comm, op=op, root=root)
+    x = jnp.asarray(x)
+    if op.custom:
+        # rank-dependent result (root reduces, others pass through) is
+        # fine here: world programs are per-rank (reference
+        # reduce.py:71-80 has the same contract)
+        rows = gather_p.bind(x, comm=comm, root=root)
+        if comm.rank() == root:
+            return op.reduce(rows).astype(x.dtype)
+        return rows
+    return reduce_p.bind(x, comm=comm, op=op, root=root)
 
 
 def scan(x, op: ReduceOp, comm):
     op.check_dtype(jnp.result_type(x))
-    return scan_p.bind(jnp.asarray(x), comm=comm, op=op)
+    x = jnp.asarray(x)
+    if op.custom:
+        rows = allgather_p.bind(x, comm=comm)
+        return op.reduce(rows[: comm.rank() + 1]).astype(x.dtype)
+    return scan_p.bind(x, comm=comm, op=op)
 
 
 def bcast(x, root, comm):

@@ -34,6 +34,10 @@ class ReduceOp:
     # "any" | "numeric" | "bool" | "integer"
     domain: str = "numeric"
     differentiable: bool = False
+    # user-defined (custom_op): no native/wire code — the world tier
+    # composes it from allgather + a local fold, the mesh tier uses the
+    # generic gather+reduce path
+    custom: bool = False
 
     def __repr__(self):
         return f"ReduceOp({self.name})"
@@ -107,6 +111,72 @@ BXOR = ReduceOp(
 
 ALL_OPS = (SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR)
 _BY_NAME = {op.name: op for op in ALL_OPS}
+_CUSTOM_REGISTRY: dict = {}  # name -> combine fn (identity guard)
+
+
+def custom_op(name: str, combine: Callable, *, reduce: Callable = None,
+              domain: str = "any") -> ReduceOp:
+    """A user-defined reduction operator (MPI_Op_create analog).
+
+    The reference accepts arbitrary ``MPI.Op`` handles including
+    user-created ones (/root/reference/mpi4jax/_src/utils.py:133-152
+    wraps whatever mpi4py provides); this is the framework-native
+    equivalent.
+
+    Args:
+        name: unique identifier.  Like the reference's pointer-keyed op
+            handles, the op is identified by this name in cached jaxprs —
+            every rank must create the op with the same name and the
+            same semantics, and reusing a built-in name is rejected.
+        combine: associative ``(a, b) -> c`` elementwise jax function.
+            Must be associative; ring/tree schedules also assume
+            commutativity (as does MPI's default ``commute=True``).
+        reduce: optional stack reduction ``(n, ...) -> (...)`` over axis
+            0; default: a left fold of ``combine``.
+        domain: dtype admissibility, one of ``"any"`` / ``"numeric"`` /
+            ``"bool"`` / ``"integer"``.
+
+    Works with ``allreduce`` / ``reduce`` / ``scan`` on both tiers: the
+    mesh tier runs the generic gather+reduce path (XLA fuses the fold);
+    the world tier composes allgather + a local fold (the wire protocol
+    carries no user code).  Not differentiable (matching the reference,
+    where only SUM has autodiff).
+
+    Example::
+
+        absmax = m4j.custom_op("ABSMAX", lambda a, b:
+                               jnp.maximum(jnp.abs(a), jnp.abs(b)))
+        out = m4j.allreduce(x, op=absmax)
+    """
+    if not isinstance(name, str) or not name:
+        raise TypeError(f"custom op name must be a non-empty str: {name!r}")
+    if name.upper() in _BY_NAME:
+        raise ValueError(
+            f"{name!r} is a built-in ReduceOp name; pick a distinct one"
+        )
+    if domain not in ("any", "numeric", "bool", "integer"):
+        raise ValueError(f"unknown domain {domain!r}")
+    # Name IS the identity (stable across processes for cached jaxprs),
+    # so one name must never mean two different functions in a process:
+    # jit caches key on the op's hash and would silently reuse the first
+    # function's compilation.  Re-creating the op with the same code
+    # object (e.g. the same lambda in a loop) is fine.
+    prior = _CUSTOM_REGISTRY.get(name)
+    if prior is not None and not (
+        prior is combine
+        or getattr(prior, "__code__", prior)
+        == getattr(combine, "__code__", combine)
+    ):
+        raise ValueError(
+            f"custom op {name!r} already registered with a different "
+            f"combine function; custom-op identity is name-based — use a "
+            f"distinct name (or reuse the original ReduceOp object)"
+        )
+    _CUSTOM_REGISTRY[name] = combine
+    return ReduceOp(
+        name, None, combine, reduce if reduce is not None else _fold(combine),
+        domain=domain, custom=True,
+    )
 
 
 def as_reduce_op(op) -> ReduceOp:
@@ -116,6 +186,6 @@ def as_reduce_op(op) -> ReduceOp:
     if isinstance(op, str) and op.upper() in _BY_NAME:
         return _BY_NAME[op.upper()]
     raise TypeError(
-        f"expected a mpi4jax_tpu ReduceOp (e.g. mpi4jax_tpu.SUM) or one of "
-        f"{sorted(_BY_NAME)}, got {op!r}"
+        f"expected a mpi4jax_tpu ReduceOp (e.g. mpi4jax_tpu.SUM), a "
+        f"custom_op(...), or one of {sorted(_BY_NAME)}, got {op!r}"
     )

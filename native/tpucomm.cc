@@ -826,7 +826,7 @@ int64_t tpucomm_split(int64_t h, int color, int key) {
     return 0;
   int32_t seq;
   {
-    std::lock_guard<std::mutex> lock(c->mu);
+    std::lock_guard<std::mutex> lock(comm_mu(c));
     seq = c->next_split_seq++;
   }
   if (color < 0) return -1;  // null comm: this rank opted out
@@ -888,7 +888,7 @@ int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
                  int tag) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Send",
                "to " + std::to_string(dest) + " (" + std::to_string(nbytes) +
                    " bytes, tag " + std::to_string(tag) + ")");
@@ -898,7 +898,7 @@ int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
 int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Recv",
                "from " + std::to_string(source) + " (" +
                    std::to_string(nbytes) + " bytes, tag " +
@@ -916,7 +916,7 @@ int tpucomm_recv_status(int64_t h, void* buf, int64_t nbytes, int source,
                         int64_t* out_count) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Recv",
                "from " + std::to_string(source) + " (" +
                    std::to_string(nbytes) + " bytes, tag " +
@@ -932,7 +932,7 @@ int tpucomm_sendrecv_status(int64_t h, const void* sendbuf,
                             int64_t* out_count) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Sendrecv",
                "to " + std::to_string(dest) + " from " +
                    std::to_string(source) + " (status)");
@@ -948,7 +948,7 @@ int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
                      int tag) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Sendrecv",
                "to " + std::to_string(dest) + " from " +
                    std::to_string(source));
@@ -963,7 +963,7 @@ int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
 int tpucomm_barrier(int64_t h) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Barrier", "");
   /* dissemination barrier: log2(size) rounds of token exchange */
   uint8_t token = 1;
@@ -982,7 +982,7 @@ int tpucomm_barrier(int64_t h) {
 int tpucomm_bcast(int64_t h, void* buf, int64_t nbytes, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Bcast", std::to_string(nbytes) + " bytes, root " +
                                      std::to_string(root));
   return bcast_internal(c, buf, nbytes, root);
@@ -992,7 +992,7 @@ int tpucomm_gather(int64_t h, const void* sendbuf, int64_t nbytes,
                    void* recvbuf, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Gather", std::to_string(nbytes) + " bytes, root " +
                                       std::to_string(root));
   if (c->rank == root) {
@@ -1012,7 +1012,7 @@ int tpucomm_scatter(int64_t h, const void* sendbuf, void* recvbuf,
                     int64_t nbytes, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Scatter", std::to_string(nbytes) + " bytes, root " +
                                        std::to_string(root));
   if (c->rank == root) {
@@ -1032,7 +1032,7 @@ int tpucomm_allgather(int64_t h, const void* sendbuf, int64_t nbytes,
                       void* recvbuf) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Allgather", std::to_string(nbytes) + " bytes");
   /* ring: size-1 rounds, each forwarding the chunk received last round */
   char* out = static_cast<char*>(recvbuf);
@@ -1058,7 +1058,7 @@ int tpucomm_alltoall(int64_t h, const void* sendbuf, void* recvbuf,
                      int64_t chunk) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Alltoall", std::to_string(chunk) + " bytes/chunk");
   const char* in = static_cast<const char*>(sendbuf);
   char* out = static_cast<char*>(recvbuf);
@@ -1138,7 +1138,7 @@ int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
                       int64_t count, int dtype, int op) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Allreduce",
                std::to_string(count) + " elems dtype " +
                    std::to_string(dtype) + " op " + std::to_string(op));
@@ -1169,7 +1169,7 @@ int tpucomm_reduce(int64_t h, const void* sendbuf, void* recvbuf,
                    int64_t count, int dtype, int op, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Reduce", std::to_string(count) + " elems, root " +
                                       std::to_string(root));
   int64_t esize = dtype_size(dtype);
@@ -1195,7 +1195,7 @@ int tpucomm_scan(int64_t h, const void* sendbuf, void* recvbuf,
                  int64_t count, int dtype, int op) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(c->mu);
+  std::lock_guard<std::mutex> lock(comm_mu(c));
   LogScope log(c->rank, "Scan", std::to_string(count) + " elems");
   int64_t esize = dtype_size(dtype);
   if (esize == 0) FAIL(c, "bad dtype %d", dtype);

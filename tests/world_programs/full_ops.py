@@ -186,11 +186,37 @@ def check_vmap(comm, rank, size):
         np.asarray(out)[1], float((rank - 1) % size))
 
 
+def check_custom_op(comm, rank, size):
+    """User-defined reduction (MPI_Op_create analog) on the world tier:
+    composed from allgather + a local fold."""
+    absmax = m4j.custom_op(
+        "ABSMAX_W", lambda a, b: jnp.maximum(jnp.abs(a), jnp.abs(b)))
+    x = jnp.asarray([float(rank) - 1.5, -float(rank)], jnp.float32)
+    out = m4j.allreduce(x, op=absmax, comm=comm)
+    expect = np.max(np.abs(np.asarray(
+        [[r - 1.5, -r] for r in range(size)], np.float32)), axis=0)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+    red = m4j.reduce(x, op=absmax, root=0, comm=comm)
+    if rank == 0:
+        np.testing.assert_allclose(np.asarray(red), expect)
+    else:
+        np.testing.assert_allclose(np.asarray(red), np.asarray(x))
+
+    sc = m4j.scan(x, op=absmax, comm=comm)
+    raw = np.asarray([[r - 1.5, -r] for r in range(size)], np.float32)
+    want = raw[0]
+    for r in range(1, rank + 1):
+        want = np.maximum(np.abs(want), np.abs(raw[r]))
+    np.testing.assert_allclose(np.asarray(sc), want)
+
+
 def main():
     comm = m4j.get_default_comm()
     rank, size = comm.rank(), comm.size()
     assert size >= 2, "run under the launcher with -n >= 2"
 
+    check_custom_op(comm, rank, size)
     check_allreduce_dtypes(comm, rank, size)
     check_movement_dtypes(comm, rank, size)
     check_transpose_identities(comm, rank, size)
