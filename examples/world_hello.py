@@ -1,0 +1,64 @@
+"""World-tier (multi-process MPMD) hello — the launcher quickstart.
+
+The reference's quickstart is ``mpirun -n 4 python script.py`` (its
+README); here the bundled launcher plays that role:
+
+    python -m mpi4jax_tpu.runtime.launch -n 4 examples/world_hello.py
+
+Each rank is one process; ``get_default_comm()`` returns the world
+communicator wired up from the launcher's environment.  Everything below
+also works inside ``jax.jit`` (ordered effects serialize the transport
+calls per rank).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+
+# World ranks are host processes: pin the CPU backend in-process (an
+# accelerator plugin may ignore the JAX_PLATFORMS env var and try to
+# claim the device per rank; set WORLD_HELLO_PLATFORM to opt in).
+jax.config.update(
+    "jax_platforms", os.environ.get("WORLD_HELLO_PLATFORM", "cpu"))
+
+if os.environ.get("MPI4JAX_TPU_RANK") is None:
+    sys.exit("run me under the launcher: "
+             "python -m mpi4jax_tpu.runtime.launch -n 4 "
+             "examples/world_hello.py")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+comm = m4j.get_default_comm()
+rank, size = comm.rank(), comm.size()
+
+# collective: every rank contributes, every rank receives
+x = jnp.arange(4, dtype=jnp.float32) + rank
+total = m4j.allreduce(x, op=m4j.SUM, comm=comm)
+
+# point-to-point ring under jit (per-rank source/dest — true MPMD)
+ring = jax.jit(lambda v: m4j.sendrecv(v, shift=1, comm=comm))(x)
+
+# wildcard receive with status introspection (rank 0 drains everyone)
+if rank == 0:
+    sources = []
+    for _ in range(size - 1):
+        status = m4j.Status()
+        m4j.recv(x, source=m4j.ANY_SOURCE, status=status, comm=comm)
+        sources.append(status.Get_source())
+    print(f"rank 0 heard from ranks {sorted(sources)}")
+else:
+    m4j.send(x, dest=0, tag=rank, comm=comm)
+
+# user-defined reduction (MPI_Op_create analog)
+absmax = m4j.custom_op(
+    "ABSMAX", lambda a, b: jnp.maximum(jnp.abs(a), jnp.abs(b)))
+peak = m4j.allreduce(jnp.float32(rank - 1.5), op=absmax, comm=comm)
+
+print(f"rank {rank}/{size}: sum={np.asarray(total)[:2]} "
+      f"ring={np.asarray(ring)[:2]} absmax={float(peak)}")

@@ -111,7 +111,31 @@ BXOR = ReduceOp(
 
 ALL_OPS = (SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR)
 _BY_NAME = {op.name: op for op in ALL_OPS}
-_CUSTOM_REGISTRY: dict = {}  # name -> combine fn (identity guard)
+_CUSTOM_REGISTRY: dict = {}  # name -> (combine sig, reduce sig, domain)
+
+
+def _fn_sig(fn):
+    """Best-effort semantic signature of a user callable: code object plus
+    closure captures (factory-made lambdas share one code object but
+    differ in their cells).  Unintrospectable values fall back to object
+    identity — erring toward a loud rejection over a silent collision."""
+    if fn is None:
+        return None
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return fn  # builtin / C function: identity
+    cells = []
+    for cell in fn.__closure__ or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:  # empty cell
+            v = "<empty>"
+        try:
+            hash(v)
+        except TypeError:
+            v = id(v)  # unhashable capture: identity
+        cells.append(v)
+    return (code, tuple(cells))
 
 
 def custom_op(name: str, combine: Callable, *, reduce: Callable = None,
@@ -157,22 +181,21 @@ def custom_op(name: str, combine: Callable, *, reduce: Callable = None,
     if domain not in ("any", "numeric", "bool", "integer"):
         raise ValueError(f"unknown domain {domain!r}")
     # Name IS the identity (stable across processes for cached jaxprs),
-    # so one name must never mean two different functions in a process:
+    # so one name must never mean two different semantics in a process:
     # jit caches key on the op's hash and would silently reuse the first
-    # function's compilation.  Re-creating the op with the same code
-    # object (e.g. the same lambda in a loop) is fine.
+    # registration's compilation.  Re-creating the op with the same code
+    # (e.g. the same lambda in a loop) is fine; differing combine/reduce
+    # functions, closure captures, or domain are rejected.
+    sig = (_fn_sig(combine), _fn_sig(reduce), domain)
     prior = _CUSTOM_REGISTRY.get(name)
-    if prior is not None and not (
-        prior is combine
-        or getattr(prior, "__code__", prior)
-        == getattr(combine, "__code__", combine)
-    ):
+    if prior is not None and prior != sig:
         raise ValueError(
-            f"custom op {name!r} already registered with a different "
-            f"combine function; custom-op identity is name-based — use a "
-            f"distinct name (or reuse the original ReduceOp object)"
+            f"custom op {name!r} already registered with different "
+            f"semantics (combine/reduce/domain); custom-op identity is "
+            f"name-based — use a distinct name (or reuse the original "
+            f"ReduceOp object)"
         )
-    _CUSTOM_REGISTRY[name] = combine
+    _CUSTOM_REGISTRY[name] = sig
     return ReduceOp(
         name, None, combine, reduce if reduce is not None else _fold(combine),
         domain=domain, custom=True,

@@ -91,6 +91,18 @@ def test_custom_name_rules():
     for _ in range(2):
         m4j.custom_op("LOOPED", lambda a, b: jnp.maximum(a, b))
 
+    # factory closures share a code object but differ in captures —
+    # still rejected (they are semantically different functions)
+    def make(n):
+        return lambda a, b: a + b * n
+
+    m4j.custom_op("SCALED", make(2))
+    with pytest.raises(ValueError, match="different"):
+        m4j.custom_op("SCALED", make(3))
+    # a differing reduce= or domain under one name is likewise rejected
+    with pytest.raises(ValueError, match="different"):
+        m4j.custom_op("SCALED", make(2), domain="numeric")
+
 
 def test_custom_not_differentiable(mesh):
     x = jnp.arange(N * 2, dtype=jnp.float32)
