@@ -52,10 +52,14 @@ def _watchdog(flag):
         now = time.time()
         if now >= flag["deadline"]:
             print(json.dumps({
+                # headline metric key so the driver records a structured
+                # failure; 'phase' names what actually stalled
                 "metric": "shallow_water_1800x3600_0.1day_1chip",
                 "value": None, "unit": "s", "vs_baseline": 0.0,
-                "error": ("device init / compile / warmup did not complete "
-                          f"in {INIT_TIMEOUT_S}s"),
+                "phase": flag.get("phase", "init"),
+                "error": (f"init phase {flag.get('phase', 'init')!r} did "
+                          f"not complete within {INIT_TIMEOUT_S}s of its "
+                          "window"),
             }), flush=True)
             os._exit(2)
         time.sleep(min(10.0, flag["deadline"] - now + 0.1))
@@ -472,6 +476,7 @@ def main():
     ]
     metrics = []
     for name, fn in sections:
+        flag["phase"] = name
         try:
             rec = fn()
         except Exception as err:  # keep going: one broken section

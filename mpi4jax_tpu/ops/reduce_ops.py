@@ -114,11 +114,22 @@ _BY_NAME = {op.name: op for op in ALL_OPS}
 _CUSTOM_REGISTRY: dict = {}  # name -> (combine sig, reduce sig, domain)
 
 
+def _capture_sig(v):
+    """Type-tagged value signature: 2, 2.0 and True are *different*
+    captures (they change dtype-promotion semantics under JAX)."""
+    try:
+        hash(v)
+    except TypeError:
+        return (type(v).__name__, id(v))  # unhashable capture: identity
+    return (type(v).__name__, v)
+
+
 def _fn_sig(fn):
-    """Best-effort semantic signature of a user callable: code object plus
-    closure captures (factory-made lambdas share one code object but
-    differ in their cells).  Unintrospectable values fall back to object
-    identity — erring toward a loud rejection over a silent collision."""
+    """Best-effort semantic signature of a user callable: code object,
+    closure captures, and default arguments (factory-made lambdas share
+    one code object but differ in their cells or ``n=n`` defaults).
+    Unintrospectable values fall back to object identity — erring toward
+    a loud rejection over a silent collision."""
     if fn is None:
         return None
     code = getattr(fn, "__code__", None)
@@ -130,12 +141,13 @@ def _fn_sig(fn):
             v = cell.cell_contents
         except ValueError:  # empty cell
             v = "<empty>"
-        try:
-            hash(v)
-        except TypeError:
-            v = id(v)  # unhashable capture: identity
-        cells.append(v)
-    return (code, tuple(cells))
+        cells.append(_capture_sig(v))
+    defaults = tuple(_capture_sig(v) for v in fn.__defaults__ or ())
+    kwdefaults = tuple(
+        (k, _capture_sig(v))
+        for k, v in sorted((fn.__kwdefaults__ or {}).items())
+    )
+    return (code, tuple(cells), defaults, kwdefaults)
 
 
 def custom_op(name: str, combine: Callable, *, reduce: Callable = None,

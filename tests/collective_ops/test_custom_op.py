@@ -103,6 +103,17 @@ def test_custom_name_rules():
     with pytest.raises(ValueError, match="different"):
         m4j.custom_op("SCALED", make(2), domain="numeric")
 
+    # default-argument captures (the n=n late-binding idiom) and
+    # cross-type captures (2 vs 2.0) are semantic differences too
+    def make_d(n):
+        return lambda a, b, n=n: a + b * n
+
+    m4j.custom_op("DEFCAP", make_d(2))
+    with pytest.raises(ValueError, match="different"):
+        m4j.custom_op("DEFCAP", make_d(3))
+    with pytest.raises(ValueError, match="different"):
+        m4j.custom_op("SCALED", make(2.0))
+
 
 def test_custom_not_differentiable(mesh):
     x = jnp.arange(N * 2, dtype=jnp.float32)

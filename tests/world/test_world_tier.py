@@ -109,6 +109,17 @@ def test_status_ops():
     assert res.stdout.count("status_ops OK") == 2
 
 
+@pytest.mark.parametrize("seed", [0, 7, 23])
+def test_fuzz_ops(seed):
+    # randomized matched-op program, replayed against numpy — exercises
+    # framing, eager/writer concurrency, self-queue, and wildcards in
+    # combination (the generative big sibling of the ordering tortures)
+    res = run_launcher("fuzz_ops.py", 2,
+                       env_extra={"FUZZ_SEED": str(seed)})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("fuzz_ops OK") == 2
+
+
 def test_wildcard_recv():
     # ANY_SOURCE receives at np=4, incl. mixed wildcard/directed ordering
     # (the reference's default recv source, recv.py:45 there)
