@@ -26,7 +26,8 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
         comm: communicator (default: ambient).
         token: optional ordering token; if given, returns ``(result, token)``.
         compression: ``"int8"`` for the bandwidth-saving quantized path
-            (mesh tier, SUM only, ~1e-2 relative error; ops/quantized.py).
+            (SUM only, ~1e-2 relative error, both tiers;
+            ops/quantized.py).
     """
     op = as_reduce_op(op)
     x = _validation.check_array("x", x)
@@ -35,14 +36,18 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
     if compression is not None:
         if compression != "int8":
             raise ValueError(f"unknown compression {compression!r}")
-        if not _dispatch.is_mesh(comm) or op.name != "SUM":
+        if op.name != "SUM":
             raise NotImplementedError(
-                "compression='int8' is supported on the mesh tier with "
-                "op=SUM"
+                "compression='int8' is supported with op=SUM"
             )
-        from .quantized import quantized_allreduce_sum
+        if _dispatch.is_mesh(comm):
+            from .quantized import quantized_allreduce_sum
 
-        body = lambda v: quantized_allreduce_sum(v, comm.axis)
+            body = lambda v: quantized_allreduce_sum(v, comm.axis)
+        else:
+            from .quantized import quantized_allreduce_sum_world
+
+            body = lambda v: quantized_allreduce_sum_world(v, comm)
         return _dispatch.maybe_tokenized(body, x, token)
 
     if _dispatch.is_mesh(comm):

@@ -216,6 +216,13 @@ def main():
     rank, size = comm.rank(), comm.size()
     assert size >= 2, "run under the launcher with -n >= 2"
 
+    # int8-compressed allreduce over the native transport (~1e-2 rel err)
+    xq = jnp.linspace(-3.0, 5.0, 257, dtype=jnp.float32) * (rank + 1)
+    outq = m4j.allreduce(xq, op=m4j.SUM, compression="int8", comm=comm)
+    expectq = np.linspace(-3.0, 5.0, 257) * sum(r + 1 for r in range(size))
+    np.testing.assert_allclose(np.asarray(outq), expectq, rtol=5e-2,
+                               atol=0.2)
+
     check_custom_op(comm, rank, size)
     check_allreduce_dtypes(comm, rank, size)
     check_movement_dtypes(comm, rank, size)
