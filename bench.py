@@ -82,26 +82,32 @@ def bench_shallow_water(flag):
     n_steps = int(days * params.day_seconds / params.dt)  # 451
 
     # ALL steps in ONE jitted call: the tunnel costs ~100 ms per call,
-    # which round 2 paid 9 times (VERDICT.md weak #2 traced to this)
-    state0 = model.init()
-    run = model.step_fn(n_steps, first=True)
+    # which round 2 paid 9 times (VERDICT.md weak #2 traced to this).
+    # Timed region matches the reference's "Solution took" exactly: the
+    # multistep loop only — initial conditions, the Euler bootstrap
+    # step, and compilation all happen before its timer starts
+    # (/root/reference/examples/shallow_water.py:423-470).
+    state1 = model.step_fn(1, first=True)(model.init())
+    run = model.step_fn(n_steps - 1, first=False)
 
-    float(jnp.sum(run(state0).h))  # compile + warmup, fetch-forced
+    float(jnp.sum(run(state1).h))  # compile + warmup, fetch-forced
     flag["ready"] = True
 
     t0 = time.perf_counter()
-    state = run(model.init())
+    state = run(state1)
     float(jnp.sum(state.h))  # drain the queue
     elapsed = time.perf_counter() - t0
 
     h = model.interior(state.h)
     if not np.all(np.isfinite(np.asarray(h))):
         raise RuntimeError("diverged")
+    timed = n_steps - 1
     return {
         "metric": "shallow_water_1800x3600_0.1day_1chip",
         "value": round(elapsed, 3), "unit": "s",
         "vs_baseline": round(BASELINE_GPU_SECONDS / elapsed, 3),
-        "steps": n_steps, "ms_per_step": round(elapsed / n_steps * 1e3, 3),
+        "steps": timed, "ms_per_step": round(elapsed / timed * 1e3, 3),
+        "timed_region": "multistep loop (= reference 'Solution took')",
         "platform": jax.devices()[0].platform,
     }
 
@@ -275,15 +281,20 @@ def bench_world_on_tpu():
     # pass the platform explicitly: the launcher pins ranks to cpu when
     # the parent env exports no JAX_PLATFORMS
     platform = os.environ.get("JAX_PLATFORMS") or "tpu,cpu"
+    env = dict(os.environ)
+    # persistent compile cache: through the tunnel every distinct
+    # executable costs 20-40 s in the remote compile helper; cache them
+    # across runs (and across rounds when the dir survives)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_compile_cache")
     res = subprocess.run(
         [sys.executable, "-m", "mpi4jax_tpu.runtime.launch", "-n", "1",
          "--port", "46100", "--platform", platform,
          os.path.join(REPO, "tests", "world_programs", "tpu_world.py")],
-        # resolve before the battery watchdog (INIT_TIMEOUT_S, 600s)
-        # can fire: this section runs first, ahead of any device claim
-        # by the parent
-        capture_output=True, text=True, timeout=INIT_TIMEOUT_S * 0.8,
-        cwd=REPO,
+        # this section runs first, ahead of any device claim by the
+        # parent; its budget is a full INIT_TIMEOUT_S window (the
+        # watchdog deadline was pushed past it by main())
+        capture_output=True, text=True, timeout=INIT_TIMEOUT_S,
+        cwd=REPO, env=env,
     )
     ok = res.returncode == 0 and "tpu_world OK" in res.stdout
     rec = {
@@ -343,7 +354,9 @@ def bench_dp_resnet():
     mesh = m4j.make_mesh(1)
     params = resnet.init_params(cfg)
     step = resnet.make_dp_train_step(cfg, mesh, lr=0.05)
-    B = 64
+    # B=64 at 224^2 overflows the tunnel's remote compile helper
+    # (HTTP 500 regardless of model depth — bisected r3); B=32 compiles
+    B = 32
     x = jnp.ones((B, 224, 224, 3), jnp.float32)
     y = jnp.zeros((B,), jnp.int32)
     K = 5
@@ -458,7 +471,12 @@ def bench_spectral():
 
 
 def main():
-    flag = {"ready": False, "deadline": time.time() + INIT_TIMEOUT_S}
+    # persistent compile cache for the parent's own sections as well
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/jax_compile_cache")
+    # the first section (world-on-tpu) gets a full INIT_TIMEOUT_S of its
+    # own before the parent's device claim starts its window
+    flag = {"ready": False, "deadline": time.time() + 2 * INIT_TIMEOUT_S}
     threading.Thread(target=_watchdog, args=(flag,), daemon=True).start()
 
     sections = [

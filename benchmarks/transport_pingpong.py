@@ -1,0 +1,78 @@
+"""Native-transport latency microbenchmark (raw ctypes, no JAX dispatch).
+
+Reproduces the transport-latency table in ``docs/benchmarks.md``: times
+the bridge-level ``sendrecv``/``allreduce`` calls directly against the
+C++ transport (``native/tpucomm.cc``), so the numbers isolate framing +
+socket + reduction cost from XLA callback overhead.  Run under the
+launcher; rank 0 prints one JSON line per row:
+
+    python -m mpi4jax_tpu.runtime.launch -n 2 \
+        benchmarks/transport_pingpong.py
+
+The reference has no analog (its transport is libmpi); these rows are
+the native tier's answer to an MPI pingpong (osu_latency-style).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from mpi4jax_tpu.runtime import bridge, transport
+
+
+def timeit(fn, reps):
+    fn()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fn()
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    comm = transport.get_world_comm()
+    handle, rank, size = comm.handle, comm.rank(), comm.size()
+    assert size == 2, "pingpong wants exactly 2 ranks"
+    peer = 1 - rank
+    rows = []
+
+    # sendrecv round: each rank sends to the peer and receives back —
+    # one full round of the persistent-writer (or eager inline) path
+    for nbytes in (1024, 65536):
+        buf = np.ones(nbytes // 4, np.float32)
+        reps = 2000 if nbytes <= 4096 else 300
+
+        def round_trip():
+            bridge.sendrecv(handle, buf, buf.shape, buf.dtype,
+                            peer, peer, 7)
+
+        dt = timeit(round_trip, reps)
+        rows.append({"op": "sendrecv_round", "bytes": nbytes,
+                     "us": round(dt * 1e6, 2), "reps": reps})
+
+    # allreduce: the doc table's three sizes
+    for nbytes, reps in ((1024, 2000), (65536, 300), (16 << 20, 5)):
+        buf = np.ones(nbytes // 4, np.float32)
+
+        def reduce_once():
+            bridge.allreduce(handle, buf, 0)  # 0 = SUM
+
+        dt = timeit(reduce_once, reps)
+        rows.append({"op": "allreduce", "bytes": nbytes,
+                     "us": round(dt * 1e6, 2), "reps": reps,
+                     "GBps": round(2 * (size - 1) / size * nbytes / dt
+                                   / 1e9, 3)})
+
+    bridge.barrier(handle)
+    if rank == 0:
+        for r in rows:
+            print(json.dumps(r), flush=True)
+    print("pingpong OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

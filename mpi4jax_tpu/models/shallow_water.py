@@ -331,23 +331,31 @@ class ShallowWater:
 
     def init(self) -> SWState:
         """Initial state as stacked-block global arrays."""
+        # cache the jitted builder: a fresh jax.jit wrapper per call
+        # would retrace AND recompile every time (2.4 s/call through the
+        # tunnel's remote compile helper, bench r3)
+        fn = getattr(self, "_init_fn", None)
+        if fn is None:
 
-        def go(dummy):
-            del dummy
-            # local blocks are concatenated along both grid axes by
-            # out_specs, yielding stacked-block global arrays directly
-            return self._initial_local()
+            def go(dummy):
+                del dummy
+                # local blocks are concatenated along both grid axes by
+                # out_specs, yielding stacked-block global arrays directly
+                return self._initial_local()
+
+            fn = jax.jit(
+                self._spmd(go, out_specs=SWState(*(P(*self.grid.axes),) * 6))
+            )
+            self._init_fn = fn
 
         dummy = jnp.zeros(
             (self.grid.shape[0], self.grid.shape[1]), jnp.float32
         )
-        return jax.jit(
-            self._spmd(go, out_specs=SWState(*(P(*self.grid.axes),) * 6))
-        )(dummy)
+        return fn(dummy)
 
     def step_fn(self, n_steps: int, first: bool = False,
                 donate: bool = False, impl: str = "auto",
-                tile_rows: int = 128, fuse: int = 2):
+                tile_rows: int = 120, fuse: int = 3):
         """A jitted function advancing the stacked-block state n_steps.
 
         ``donate=True`` donates the input state's buffers to the output
@@ -365,7 +373,10 @@ class ShallowWater:
 
         ``tile_rows``/``fuse`` tune the Pallas path: row-tile height and
         temporal blocking factor (``fuse`` steps per HBM round-trip —
-        see ``_sw_pallas.fused_step``).  Defaults tuned on a v5e.
+        see ``_sw_pallas.fused_step``).  Defaults tuned on a v5e at the
+        flagship (1800, 3600) size: 120/3 ≈ 0.69 ms/step; larger
+        windows (144/3, 128/4, 120/5) overflow what the Mosaic compiler
+        will build.
         """
         gy, gx = self.grid.shape
         if impl not in ("auto", "xla", "pallas"):
@@ -384,7 +395,12 @@ class ShallowWater:
         else:
             use_pallas = impl == "pallas"
 
-        def build(with_pallas: bool):
+        def build(cfg):
+            # cfg: None for the XLA step, else a (tile_rows, fuse) pair
+            # for the fused Pallas step
+            with_pallas = cfg is not None
+            tr, fz = cfg if with_pallas else (0, 1)
+
             def local(*flat):
                 s = SWState(*flat)
                 if with_pallas:
@@ -395,10 +411,8 @@ class ShallowWater:
                     # the time loop (12 extra copies/step otherwise).
                     # Single-step calls reuse the fused tiling's T so
                     # both kernels agree on the padded shape.
-                    T_eff, _, _ = _sw_pallas._tiling(
-                        shape[0], tile_rows, fuse)
-                    s = _sw_pallas.pad_rows(
-                        s, tile_rows=tile_rows, fuse=fuse)
+                    T_eff, _, _ = _sw_pallas._tiling(shape[0], tr, fz)
+                    s = _sw_pallas.pad_rows(s, tile_rows=tr, fuse=fz)
 
                     def one_step(st, is_first):
                         return _sw_pallas.fused_step(
@@ -410,8 +424,8 @@ class ShallowWater:
                     def fused_steps(st):
                         return _sw_pallas.fused_step(
                             st, self.params, first=False,
-                            logical_shape=shape, tile_rows=tile_rows,
-                            fuse=fuse,
+                            logical_shape=shape, tile_rows=tr,
+                            fuse=fz,
                         )
                 else:
                     def one_step(st, is_first):
@@ -424,10 +438,10 @@ class ShallowWater:
                     remaining = n_steps - 1
                 else:
                     remaining = n_steps
-                if fused_steps is not None and fuse > 1:
+                if fused_steps is not None and fz > 1:
                     # temporal blocking: whole fused calls, then the
                     # remainder one step at a time
-                    whole, rest = divmod(remaining, fuse)
+                    whole, rest = divmod(remaining, fz)
                     if whole > 0:
                         s = lax.fori_loop(
                             0, whole, lambda _, st: fused_steps(st), s)
@@ -459,50 +473,60 @@ class ShallowWater:
 
         if not use_pallas or impl == "pallas":
             # explicit choice (or XLA): no fallback — fail loudly
-            return build(use_pallas)
+            return build((tile_rows, fuse) if use_pallas else None)
 
-        # impl="auto" chose pallas: fall back to XLA on compile failure.
-        # (An AOT lower+compile probe would be cleaner, but .lower()
-        # hangs on tunneled TPU backends, so the first real call is the
-        # probe.)  Only *compile-time* failures trigger the fallback —
-        # they occur before execution starts, so donated input buffers
-        # are still intact for the retry.  Runtime failures re-raise:
-        # after donation the inputs may be consumed, and masking the
-        # real error with a doomed XLA retry would mislead.  Limitation:
-        # if `stepper` is traced by an outer jit, the pallas call
-        # inlines and a compile failure surfaces at the outer jit's
+        # impl="auto" chose pallas: walk a fallback ladder on compile
+        # failure — requested config, then a conservative small-window
+        # config that sits far below the Mosaic program-size ceiling,
+        # then the XLA step.  (An AOT lower+compile probe would be
+        # cleaner, but .lower() hangs on tunneled TPU backends, so the
+        # first real call is the probe.)  Only *compile-time* failures
+        # trigger the fallback — they occur before execution starts, so
+        # donated input buffers are still intact for the retry.  Runtime
+        # failures re-raise: after donation the inputs may be consumed,
+        # and masking the real error with a doomed retry would mislead.
+        # Limitation: if `stepper` is traced by an outer jit, the pallas
+        # call inlines and a compile failure surfaces at the outer jit's
         # compile — loud, but past this fallback.
+        ladder = [(tile_rows, fuse)]
+        if (tile_rows, fuse) != (64, 1):
+            ladder.append((64, 1))
+        ladder.append(None)
         chosen = {"fn": None}
         _COMPILE_MARKERS = (
             "Mosaic", "compile", "Compile", "lowering", "Lowering",
         )
 
         def stepper(state):
-            if chosen["fn"] is None:
-                pallas_jit = build(True)
+            if chosen["fn"] is not None:
+                return chosen["fn"](state)
+            last_exc = None
+            for i, cfg in enumerate(ladder):
+                fn = build(cfg)
                 try:
-                    out = pallas_jit(state)
-                    chosen["fn"] = pallas_jit
+                    out = fn(state)
+                    chosen["fn"] = fn
                     return out
                 except Exception as exc:
                     msg = f"{type(exc).__name__}: {exc}"
-                    if not any(k in msg for k in _COMPILE_MARKERS):
-                        raise
+                    is_last = i == len(ladder) - 1
+                    if is_last or not any(
+                        k in msg for k in _COMPILE_MARKERS
+                    ):
+                        # a marker-matching *runtime* fault after
+                        # donation consumed the inputs: surface the
+                        # first compile error as the cause, not mask it
+                        raise exc from last_exc
                     import warnings
 
+                    nxt = ladder[i + 1]
                     warnings.warn(
-                        "fused Pallas shallow-water step failed to "
-                        f"compile; falling back to the XLA step: {exc}"
+                        f"fused Pallas shallow-water step {cfg} failed "
+                        "to compile; falling back to "
+                        f"{'XLA' if nxt is None else f'pallas {nxt}'}: "
+                        f"{exc}"
                     )
-                    chosen["fn"] = build(False)
-                    try:
-                        return chosen["fn"](state)
-                    except Exception as exc2:
-                        # e.g. a marker-matching *runtime* fault after
-                        # donation consumed the inputs: surface the
-                        # original error as the cause, don't mask it
-                        raise exc2 from exc
-            return chosen["fn"](state)
+                    last_exc = exc
 
         return stepper
 

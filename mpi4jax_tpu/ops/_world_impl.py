@@ -43,6 +43,44 @@ from .reduce_ops import ALL_OPS, ReduceOp
 
 _OP_CODE = {op.name: i for i, op in enumerate(ALL_OPS)}
 
+_STAGED_EAGER = None
+
+
+def _use_staged_eager() -> bool:
+    """True when the local backend cannot run host callbacks inside
+    compiled programs, so *eager* world ops must stage HBM↔host
+    explicitly in Python instead.
+
+    Known case: the axon TPU tunnel's PJRT plugin reports
+    ``UNIMPLEMENTED: axon_pjrt does not support host send/recv
+    callbacks`` (and the *ordered* callback path hangs rather than
+    erroring).  Real TPU VMs (libtpu) support send/recv callbacks and
+    keep the in-program ordered-callback path.  Staged-eager dispatch
+    preserves the ordering contract trivially: Python program order is
+    execution order.  Override with ``MPI4JAX_TPU_STAGED_EAGER=0/1``.
+
+    Detection: the tunnel registers as platform "tpu", so the plugin is
+    identified by the PJRT ``platform_version`` string ("axon x.y.z"),
+    which costs no compile.
+    """
+    global _STAGED_EAGER
+    if _STAGED_EAGER is None:
+        import os
+
+        env = os.environ.get("MPI4JAX_TPU_STAGED_EAGER", "").strip().lower()
+        if env in ("1", "true", "on", "yes"):
+            _STAGED_EAGER = True
+        elif env in ("0", "false", "off", "no"):
+            _STAGED_EAGER = False
+        elif jax.default_backend() == "cpu":
+            _STAGED_EAGER = False
+        else:
+            ver = getattr(
+                jax.devices()[0].client, "platform_version", ""
+            )
+            _STAGED_EAGER = "axon" in str(ver).lower()
+    return _STAGED_EAGER
+
 
 def _contig(x) -> np.ndarray:
     # NB: np.ascontiguousarray promotes 0-d to 1-d; np.asarray + explicit
@@ -55,6 +93,56 @@ def _np(x, aval):
     return _contig(np.asarray(x, dtype=aval.dtype))
 
 
+def _check_callback_support(ctx):
+    """Fail at compile time where the ordered-callback path would HANG
+    at run time (axon_pjrt implements no host send/recv callbacks).
+
+    Keyed on the *lowering target*: a world op jitted for the cpu
+    platform works in any process (cpu host callbacks always exist),
+    even when the process's default backend is the callback-less
+    tunnel — e.g. the Status-carrying recv/sendrecv cpu route.
+    """
+    platforms = tuple(getattr(ctx.module_context, "platforms", ()) or ())
+    if platforms and all(p == "cpu" for p in platforms):
+        return
+    if _use_staged_eager():
+        raise NotImplementedError(
+            "world-tier ops inside jit need host send/recv callbacks, "
+            "which the axon TPU tunnel does not implement; call the op "
+            "eagerly (staged-eager dispatch handles D2H/H2D), or run "
+            "this rank on JAX_PLATFORMS=cpu, or use a real TPU VM"
+        )
+
+
+def _staged_eager_impl(p, out_aval_fn, host_fn):
+    """Eager impl with an explicit staging tier for callback-less
+    backends: pull the device buffers to the host (D2H), run the native
+    transport on them, push the result back (H2D) — the reference GPU
+    bridge's staging sequence performed at the dispatch layer
+    (mpi_xla_bridge_gpu.pyx:233-251 there).  Callback-capable backends
+    take the normal apply_primitive route (compiled ordered callback).
+    """
+
+    def eager_impl(*args, **params):
+        if _use_staged_eager():
+            avals = [core.get_aval(a) for a in args]
+            out_aval = out_aval_fn(*avals, **params)
+            host_args = [
+                _np(jax.device_get(a), av) for a, av in zip(args, avals)
+            ]
+            result = host_fn(*host_args, **params)
+            out = _contig(np.asarray(result, dtype=out_aval.dtype))
+            dev = next(
+                (a.device for a in args
+                 if hasattr(a, "device") and a.device is not None),
+                jax.devices()[0],
+            )
+            return jax.device_put(out, dev)
+        return _jax_dispatch.apply_primitive(p, *args, **params)
+
+    return eager_impl
+
+
 def _make_primitive(name, out_aval_fn, host_fn):
     """A world-tier primitive: ordered effect + host-callback lowering.
 
@@ -62,7 +150,7 @@ def _make_primitive(name, out_aval_fn, host_fn):
     ``out_aval_fn(*avals, **params) -> ShapedArray`` declares the result.
     """
     p = core.Primitive(f"mpi4jax_tpu_{name}")
-    p.def_impl(partial(_jax_dispatch.apply_primitive, p))
+    p.def_impl(_staged_eager_impl(p, out_aval_fn, host_fn))
 
     def abstract_eval(*avals, **params):
         return out_aval_fn(*avals, **params), {comm_effect}
@@ -70,6 +158,7 @@ def _make_primitive(name, out_aval_fn, host_fn):
     p.def_effectful_abstract_eval(abstract_eval)
 
     def lowering(ctx, *args, **params):
+        _check_callback_support(ctx)
         out_aval = ctx.avals_out[0]
 
         def _callback(*flat):
@@ -312,7 +401,19 @@ def _host_sendrecv(x, *, comm, source, dest, sendtag, recvtag, status=None):
 # back, so double-transpose ≡ allreduce.  Built by hand (not the factory)
 # because the transposed pass carries no effect and no callback.
 allreduce_p = core.Primitive("mpi4jax_tpu_allreduce")
-allreduce_p.def_impl(partial(_jax_dispatch.apply_primitive, allreduce_p))
+
+
+def _host_allreduce_or_identity(x, *, comm, op, transpose=False):
+    # the transposed pass is a communication-free identity (reference
+    # allreduce.py:87-89 there)
+    return x if transpose else _host_allreduce(x, comm=comm, op=op)
+
+
+allreduce_p.def_impl(_staged_eager_impl(
+    allreduce_p,
+    lambda x_aval, **params: core.ShapedArray(x_aval.shape, x_aval.dtype),
+    _host_allreduce_or_identity,
+))
 
 
 def _allreduce_abstract_eval(x_aval, *, comm, op, transpose=False):
@@ -326,6 +427,7 @@ allreduce_p.def_effectful_abstract_eval(_allreduce_abstract_eval)
 def _allreduce_lowering(ctx, x, *, comm, op, transpose=False):
     if transpose:
         return [x]  # identity pass, no communication
+    _check_callback_support(ctx)
 
     out_aval = ctx.avals_out[0]
 

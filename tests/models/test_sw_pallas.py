@@ -54,7 +54,8 @@ def test_fused_step_tile_edge_cases():
             )
 
 
-@pytest.mark.parametrize("tile_rows,fuse", [(16, 1), (16, 2), (32, 2)])
+@pytest.mark.parametrize("tile_rows,fuse", [(16, 1), (16, 2), (32, 2),
+                                            (24, 3)])
 def test_fused_step_multi_tile(tile_rows, fuse):
     """Force ntiles >= 2 so the clamped interior halo index maps and the
     cross-tile halo consistency under temporal blocking actually run (the
