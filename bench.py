@@ -58,7 +58,8 @@ def _watchdog(flag):
                 "value": None, "unit": "s", "vs_baseline": 0.0,
                 "phase": flag.get("phase", "init"),
                 "error": (f"init phase {flag.get('phase', 'init')!r} did "
-                          f"not complete within {INIT_TIMEOUT_S}s of its "
+                          f"not complete within its "
+                          f"{flag.get('window_s', INIT_TIMEOUT_S):.0f}s "
                           "window"),
             }), flush=True)
             os._exit(2)
@@ -476,7 +477,8 @@ def main():
                           "/tmp/jax_compile_cache")
     # the first section (world-on-tpu) gets a full INIT_TIMEOUT_S of its
     # own before the parent's device claim starts its window
-    flag = {"ready": False, "deadline": time.time() + 2 * INIT_TIMEOUT_S}
+    flag = {"ready": False, "deadline": time.time() + 2 * INIT_TIMEOUT_S,
+            "window_s": 2 * INIT_TIMEOUT_S}
     threading.Thread(target=_watchdog, args=(flag,), daemon=True).start()
 
     sections = [
@@ -504,6 +506,7 @@ def main():
             # init phase continues: give the parent's own device claim +
             # first compile a fresh window
             flag["deadline"] = time.time() + INIT_TIMEOUT_S
+            flag["window_s"] = INIT_TIMEOUT_S
         else:
             # the watchdog only guards init; once the device has run a
             # section (or raised a real error) it must never kill the

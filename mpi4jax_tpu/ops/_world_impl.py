@@ -24,8 +24,6 @@ that
 
 from __future__ import annotations
 
-from functools import partial
-
 import numpy as np
 
 import jax
@@ -409,11 +407,20 @@ def _host_allreduce_or_identity(x, *, comm, op, transpose=False):
     return x if transpose else _host_allreduce(x, comm=comm, op=op)
 
 
-allreduce_p.def_impl(_staged_eager_impl(
+_allreduce_staged = _staged_eager_impl(
     allreduce_p,
     lambda x_aval, **params: core.ShapedArray(x_aval.shape, x_aval.dtype),
     _host_allreduce_or_identity,
-))
+)
+
+
+def _allreduce_impl(x, *, comm, op, transpose=False):
+    if transpose:
+        return x  # identity: skip the staging D2H/H2D round trip too
+    return _allreduce_staged(x, comm=comm, op=op, transpose=transpose)
+
+
+allreduce_p.def_impl(_allreduce_impl)
 
 
 def _allreduce_abstract_eval(x_aval, *, comm, op, transpose=False):
