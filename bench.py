@@ -351,7 +351,8 @@ def bench_dp_resnet():
     import mpi4jax_tpu as m4j
     from mpi4jax_tpu.models import resnet
 
-    cfg = resnet.ResNetConfig(stages=(3, 4, 6, 3), n_classes=1000)
+    cfg = resnet.ResNetConfig(stages=(3, 4, 6, 3), n_classes=1000,
+                              dtype="bfloat16", stem="imagenet")
     mesh = m4j.make_mesh(1)
     params = resnet.init_params(cfg)
     step = resnet.make_dp_train_step(cfg, mesh, lr=0.05)
@@ -375,7 +376,7 @@ def bench_dp_resnet():
     loss = float(many(params, x, y))
     dt = (time.perf_counter() - t0) / K
     return {
-        "metric": "dp_resnet34_grad_allreduce_step",
+        "metric": "dp_resnet34_grad_allreduce_step_bf16",
         "value": round(B / dt, 1), "unit": "img/s",
         "vs_baseline": None,  # BASELINE.json published: {} — first capture
         "ms_per_step": round(dt * 1e3, 1), "batch": B,

@@ -30,7 +30,8 @@ def main():
     from mpi4jax_tpu.models import resnet
 
     cfg = resnet.ResNetConfig(
-        stages=tuple(args.depth), widths=tuple(args.widths), n_classes=10
+        stages=tuple(args.depth), widths=tuple(args.widths), n_classes=10,
+        stem="imagenet" if args.image >= 64 else "small",
     )
     mesh = m4j.make_mesh()
     ndev = len(jax.devices())

@@ -31,23 +31,38 @@ class ResNetConfig(NamedTuple):
     n_classes: int = 10
     in_channels: int = 3
     groups: int = 8
+    dtype: str = "float32"  # conv compute dtype; "bfloat16" on real TPU
+    # mixed precision: master params stay f32 (the optimizer update and
+    # the DP grad-allreduce run in f32); forward casts per use, autodiff
+    # transposes the casts so grads come back f32.  Measured r3 on the
+    # v5e at ResNet-34/B=32/224^2: 6.5x over f32 convs (f32 hits the
+    # MXU at 1/8 rate).
+    stem: str = "small"  # "small": 3x3/1 conv, no pool (CIFAR-style,
+    #                      the historical default — keeps existing
+    #                      configs/params valid); "imagenet": 7x7/2
+    #                      conv + 3x3/2 avg pool, the standard ResNet
+    #                      head — stage 1 sees 1/16 the pixels (use
+    #                      for 224^2-class inputs)
 
 
 def _conv(x, w, stride=1):
     return lax.conv_general_dilated(
-        x, w, (stride, stride), "SAME",
+        x, w.astype(x.dtype), (stride, stride), "SAME",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
 
 
 def _groupnorm(x, scale, bias, groups):
+    # normalization statistics in f32 regardless of the compute dtype
+    # (bf16 mean/var over 224^2 spatial positions loses too many bits)
+    dt = x.dtype
     n, h, w, c = x.shape
     g = min(groups, c)
-    xg = x.reshape(n, h, w, g, c // g)
+    xg = x.astype(jnp.float32).reshape(n, h, w, g, c // g)
     mu = jnp.mean(xg, axis=(1, 2, 4), keepdims=True)
     var = jnp.var(xg, axis=(1, 2, 4), keepdims=True)
     xg = (xg - mu) * lax.rsqrt(var + 1e-5)
-    return xg.reshape(n, h, w, c) * scale + bias
+    return (xg.reshape(n, h, w, c) * scale + bias).astype(dt)
 
 
 def init_params(cfg: ResNetConfig, seed: int = 0):
@@ -61,8 +76,9 @@ def init_params(cfg: ResNetConfig, seed: int = 0):
             )
         )
 
+    stem_k = 7 if cfg.stem == "imagenet" else 3
     params = {
-        "stem": conv_w(3, cfg.in_channels, cfg.widths[0]),
+        "stem": conv_w(stem_k, cfg.in_channels, cfg.widths[0]),
         "stem_gn": (jnp.ones(cfg.widths[0]), jnp.zeros(cfg.widths[0])),
         "stages": [],
         "head": jnp.asarray(
@@ -100,9 +116,26 @@ def _block_plan(cfg: ResNetConfig, stage: int, block: int, cin: int):
 
 def forward(params, x, cfg: ResNetConfig):
     g = cfg.groups
+    x = x.astype(jnp.dtype(cfg.dtype))
+    stem_stride = 2 if cfg.stem == "imagenet" else 1
     h = jnp.maximum(
-        _groupnorm(_conv(x, params["stem"]), *params["stem_gn"], g), 0
+        _groupnorm(
+            _conv(x, params["stem"], stem_stride), *params["stem_gn"], g
+        ),
+        0,
     )
+    if cfg.stem == "imagenet":
+        # 3x3/2 average pool as a depthwise conv (constant 1/9 kernel):
+        # fully differentiable and MXU-scheduled.  Max pool's
+        # SelectAndScatter gradient hangs the tunnel's remote compile
+        # helper at this size (and is slower on TPU generally).
+        c = h.shape[-1]
+        kern = jnp.full((3, 3, 1, c), 1.0 / 9.0, h.dtype)
+        h = lax.conv_general_dilated(
+            h, kern, (2, 2), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c,
+        )
     cin = cfg.widths[0]
     for si, blocks in enumerate(params["stages"]):
         for b, blk in enumerate(blocks):
@@ -115,7 +148,7 @@ def forward(params, x, cfg: ResNetConfig):
                 skip = _conv(h, blk["proj"], stride)
             h = jnp.maximum(y + skip, 0)
             cin = cfg.widths[si]
-    pooled = jnp.mean(h, axis=(1, 2))
+    pooled = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
     return pooled @ params["head"] + params["head_b"]
 
 

@@ -190,7 +190,11 @@ class GPT:
 
         x, _ = lax.scan(body, x, stacked)
         x = _layernorm(x, params.lnf)
-        return x @ params.wte.T  # tied embeddings
+        # tied embeddings.  (Measured r3: casting this projection to the
+        # compute dtype per step is a net LOSS on the v5e — the (d,vocab)
+        # cast materialization outweighs the matmul savings, 127 ms vs
+        # 113 ms per step — so it stays in the residual dtype.)
+        return x @ params.wte.T
 
     def _loss_local(self, params, tokens, targets, mask):
         logits = self._forward_local(params, tokens).astype(jnp.float32)

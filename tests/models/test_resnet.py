@@ -9,7 +9,7 @@ import mpi4jax_tpu as m4j
 from mpi4jax_tpu.models import resnet
 
 CFG = resnet.ResNetConfig(
-    stages=(1, 1), widths=(8, 16), n_classes=4, in_channels=3, groups=4
+    stages=(1, 1), widths=(8, 16), n_classes=4, in_channels=3, groups=4,
 )
 N = 8
 B, HW = 16, 8
@@ -54,3 +54,42 @@ def test_dp_matches_single_device():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
         )
+
+
+def test_imagenet_stem_trains():
+    # the downsampling stem (7x7/2 conv + 3x3/2 avg pool): forward shape
+    # halves twice before stage 1, and the pool's backward is exercised
+    cfg = resnet.ResNetConfig(
+        stages=(1,), widths=(8,), n_classes=3, groups=4, stem="imagenet"
+    )
+    mesh = m4j.make_mesh(1, devices=jax.devices()[:1])
+    params = resnet.init_params(cfg, seed=0)
+    assert params["stem"].shape[:2] == (7, 7)
+    step = resnet.make_dp_train_step(cfg, mesh, lr=0.05)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(4, 32, 32, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 3, (4,)).astype(np.int32))
+    losses = []
+    for _ in range(4):
+        loss, params = step(params, x, y)
+        losses.append(float(loss))
+    assert np.all(np.isfinite(losses))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bf16_compute_close_to_f32():
+    cfg32 = resnet.ResNetConfig(
+        stages=(1,), widths=(8,), n_classes=3, groups=4, stem="small"
+    )
+    cfg16 = cfg32._replace(dtype="bfloat16")
+    params = resnet.init_params(cfg32, seed=0)
+    rng = np.random.RandomState(2)
+    # scale the head so logits are O(1) (groupnorm washes out input
+    # scale): a vacuous tolerance would otherwise pass even if the
+    # bf16 path returned zeros
+    params = dict(params, head=params["head"] * 100.0)
+    x = jnp.asarray(rng.randn(2, 8, 8, 3).astype(np.float32))
+    a = np.asarray(resnet.forward(params, x, cfg32))
+    b = np.asarray(resnet.forward(params, x, cfg16))
+    assert np.abs(a).max() > 0.1, a
+    np.testing.assert_allclose(a, b, rtol=0.15, atol=0.05 * np.abs(a).max())

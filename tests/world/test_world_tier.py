@@ -71,6 +71,17 @@ def test_hosts_list_length_mismatch():
     assert "2 ranks" in res.stderr
 
 
+def test_staged_eager_dispatch():
+    # forced staged-eager (the callback-less-backend path, e.g. the axon
+    # tunnel): eager ops stage through device_get/device_put + the
+    # native transport; jit ops still lower normally on cpu ranks
+    res = run_launcher(
+        "basic_ops.py", 2, env_extra={"MPI4JAX_TPU_STAGED_EAGER": "1"}
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("basic_ops OK") == 2
+
+
 @pytest.mark.parametrize("ffi", ["on", "off"])
 def test_ffi_fast_path(ffi):
     # native custom calls used when available; callback fallback under the
