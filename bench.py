@@ -66,6 +66,43 @@ def _watchdog(flag):
         time.sleep(min(10.0, flag["deadline"] - now + 0.1))
 
 
+def _wait_for_claim(flag, budget_s, label):
+    """Block until a fresh subprocess can claim the device, or the
+    budget runs out.
+
+    The axon tunnel wedges its single device claim for ~15 min after a
+    claim-holding process dies uncleanly (docs/developers.md).  When a
+    section's subprocess had to be killed, the *next* claim would hang
+    and cascade the whole battery into watchdog death (r3: one killed
+    world rank took out every later section).  Probing from short-lived
+    subprocesses turns that into a bounded wait.  Returns True when the
+    claim came back.
+    """
+    t_end = time.time() + budget_s
+    while True:
+        # keep the watchdog off our back while we wait
+        flag["deadline"] = max(flag["deadline"], time.time() + 420)
+        flag["window_s"] = max(flag.get("window_s", 0), budget_s)
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print('claim-ok')"],
+                capture_output=True, text=True, timeout=150,
+            )
+            if res.returncode == 0 and "claim-ok" in res.stdout:
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if time.time() >= t_end:
+            print(json.dumps({
+                "metric": f"device_claim_before_{label}", "value": 0,
+                "unit": "ok", "vs_baseline": None,
+                "error": f"device claim still wedged after {budget_s}s",
+            }), flush=True)
+            return False
+        time.sleep(120)
+
+
 def bench_shallow_water(flag):
     import jax
     import jax.numpy as jnp
@@ -498,6 +535,15 @@ def main():
     metrics = []
     for name, fn in sections:
         flag["phase"] = name
+        if name == "world_on_tpu":
+            # tunnel-health gate: if the claim is wedged (previous
+            # process died uncleanly), wait it out rather than burning
+            # this section's whole timeout on a hung rank
+            _wait_for_claim(flag, 900, "world_on_tpu")
+            # the section's own subprocess timeout bounds it; the
+            # watchdog must outlast that, not fire mid-section
+            flag["deadline"] = time.time() + INIT_TIMEOUT_S + 120
+            flag["window_s"] = INIT_TIMEOUT_S + 120
         try:
             rec = fn()
         except Exception as err:  # keep going: one broken section
@@ -506,6 +552,11 @@ def main():
         if name == "world_on_tpu":
             # init phase continues: give the parent's own device claim +
             # first compile a fresh window
+            failed = not (isinstance(rec, dict) and rec.get("value"))
+            if failed:
+                # the rank was likely killed mid-claim; let the wedge
+                # lapse before the parent claims for its own sections
+                _wait_for_claim(flag, 900, "shallow_water")
             flag["deadline"] = time.time() + INIT_TIMEOUT_S
             flag["window_s"] = INIT_TIMEOUT_S
         else:
