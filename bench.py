@@ -75,14 +75,19 @@ def _wait_for_claim(flag, budget_s, label):
     section's subprocess had to be killed, the *next* claim would hang
     and cascade the whole battery into watchdog death (r3: one killed
     world rank took out every later section).  Probing from short-lived
-    subprocesses turns that into a bounded wait.  Returns True when the
-    claim came back.
+    subprocesses turns that into a bounded wait.
+
+    Returns ``(ok, record)``; ``record`` is a failure metric when the
+    claim never came back (None on success).  At most two probes run: a
+    killed probe re-poisons the claim, so the wait is one long quiet
+    period bracketed by probes rather than rapid-fire retries (which
+    livelock against the ~15-min re-wedge window).
     """
     t_end = time.time() + budget_s
+    # keep the watchdog off our back for the whole wait
+    flag["deadline"] = max(flag["deadline"], t_end + 400)
+    flag["window_s"] = max(flag.get("window_s", 0), budget_s + 400)
     while True:
-        # keep the watchdog off our back while we wait
-        flag["deadline"] = max(flag["deadline"], time.time() + 420)
-        flag["window_s"] = max(flag.get("window_s", 0), budget_s)
         try:
             res = subprocess.run(
                 [sys.executable, "-c",
@@ -90,17 +95,22 @@ def _wait_for_claim(flag, budget_s, label):
                 capture_output=True, text=True, timeout=150,
             )
             if res.returncode == 0 and "claim-ok" in res.stdout:
-                return True
+                # small settle: the probe's own claim needs to release
+                # before the next claimer shows up
+                time.sleep(15)
+                return True, None
         except subprocess.TimeoutExpired:
             pass
-        if time.time() >= t_end:
-            print(json.dumps({
+        # quiet until one final probe window before the budget ends
+        final_start = t_end - 170
+        now = time.time()
+        if now >= final_start:
+            return False, {
                 "metric": f"device_claim_before_{label}", "value": 0,
                 "unit": "ok", "vs_baseline": None,
                 "error": f"device claim still wedged after {budget_s}s",
-            }), flush=True)
-            return False
-        time.sleep(120)
+            }
+        time.sleep(final_start - now)
 
 
 def bench_shallow_water(flag):
@@ -532,6 +542,13 @@ def main():
         ("gpt2", bench_gpt2_step),
         ("spectral", bench_spectral),
     ]
+    # sections whose function claims the device from THIS process; when
+    # the claim is known-wedged they are skipped with structured records
+    # (the CPU-only allreduce_sweep still runs)
+    DEVICE_SECTIONS = {"shallow_water", "flash_mfu", "pallas_census",
+                       "dp_resnet", "gpt2", "spectral"}
+    HEADLINE = "shallow_water_1800x3600_0.1day_1chip"
+    device_ok = True
     metrics = []
     for name, fn in sections:
         flag["phase"] = name
@@ -539,13 +556,27 @@ def main():
             # tunnel-health gate: if the claim is wedged (previous
             # process died uncleanly), wait it out rather than burning
             # this section's whole timeout on a hung rank
-            _wait_for_claim(flag, 900, "world_on_tpu")
+            device_ok, gate_rec = _wait_for_claim(flag, 1200,
+                                                  "world_on_tpu")
+            if gate_rec is not None:
+                metrics.append(gate_rec)
+                print(json.dumps(gate_rec), flush=True)
             # the section's own subprocess timeout bounds it; the
             # watchdog must outlast that, not fire mid-section
             flag["deadline"] = time.time() + INIT_TIMEOUT_S + 120
             flag["window_s"] = INIT_TIMEOUT_S + 120
         try:
-            rec = fn()
+            if not device_ok and (name in DEVICE_SECTIONS
+                                  or name == "world_on_tpu"):
+                rec = {
+                    "metric": HEADLINE if name == "shallow_water"
+                    else (name if name != "world_on_tpu"
+                          else "world_tier_on_tpu_platform"),
+                    "value": None, "unit": None, "vs_baseline": None,
+                    "error": "skipped: device claim wedged",
+                }
+            else:
+                rec = fn()
         except Exception as err:  # keep going: one broken section
             rec = {"metric": name, "value": None, "vs_baseline": None,
                    "error": f"{type(err).__name__}: {err}"[:300]}
@@ -553,10 +584,14 @@ def main():
             # init phase continues: give the parent's own device claim +
             # first compile a fresh window
             failed = not (isinstance(rec, dict) and rec.get("value"))
-            if failed:
+            if failed and device_ok:
                 # the rank was likely killed mid-claim; let the wedge
                 # lapse before the parent claims for its own sections
-                _wait_for_claim(flag, 900, "shallow_water")
+                device_ok, gate_rec = _wait_for_claim(flag, 900,
+                                                      "shallow_water")
+                if gate_rec is not None:
+                    metrics.append(gate_rec)
+                    print(json.dumps(gate_rec), flush=True)
             flag["deadline"] = time.time() + INIT_TIMEOUT_S
             flag["window_s"] = INIT_TIMEOUT_S
         else:

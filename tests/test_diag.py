@@ -1,0 +1,23 @@
+"""The diagnostics CLI: all CPU-tier checks pass in this environment."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_diag_cpu_checks():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.runtime.diag", "--json",
+         "--port", "45990"],
+        capture_output=True, text=True, timeout=240, cwd=REPO, env=env,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    data = json.loads(res.stdout.strip().splitlines()[-1])
+    assert data["failed"] == 0
+    names = {r["check"] for r in data["results"]}
+    assert names == {"native_build", "ffi_fast_path", "transport_loopback"}
