@@ -1,0 +1,117 @@
+"""bench.py's device-claim gate: the driver-critical scheduling logic.
+
+Probes and clocks are faked — no device, no real sleeps.
+"""
+
+import importlib.util
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # top level only defines constants/fns
+
+    class FakeTime:
+        def __init__(self):
+            self.now = 1000.0
+            self.sleeps = []
+
+        def time(self):
+            return self.now
+
+        def sleep(self, s):
+            self.sleeps.append(s)
+            self.now += s
+
+        def perf_counter(self):
+            return self.now
+
+    ft = FakeTime()
+    monkeypatch.setattr(mod, "time", ft)
+    return mod, ft
+
+
+def _flag():
+    return {"ready": False, "deadline": 0.0, "window_s": 0.0}
+
+
+def test_gate_healthy_claim(bench, monkeypatch):
+    mod, ft = bench
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+
+        class R:
+            returncode = 0
+            stdout = "claim-ok\n"
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    flag = _flag()
+    ok, rec = mod._wait_for_claim(flag, 900, "x")
+    assert ok and rec is None
+    assert len(calls) == 1
+    assert 15 in ft.sleeps  # settle delay for the probe's claim release
+    assert flag["deadline"] >= ft.now  # watchdog covered the wait
+
+
+def test_gate_wedged_claim_bounded(bench, monkeypatch):
+    mod, ft = bench
+
+    probes = []
+
+    def fake_run(cmd, **kw):
+        probes.append(ft.now)
+        ft.now += kw["timeout"]  # the probe hangs for its full timeout
+        raise subprocess.TimeoutExpired(cmd, kw["timeout"])
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    flag = _flag()
+    t0 = ft.now
+    ok, rec = mod._wait_for_claim(flag, 900, "world_on_tpu")
+    assert not ok
+    assert rec["metric"] == "device_claim_before_world_on_tpu"
+    assert rec["value"] == 0 and "wedged" in rec["error"]
+    # at most two probes: one upfront, one final — no rapid-fire retries
+    # livelocking against the re-wedge window
+    assert len(probes) == 2, probes
+    # bounded: within the budget plus one final probe timeout
+    assert ft.now - t0 <= 900 + 160
+    # the watchdog deadline covered the whole wait
+    assert flag["deadline"] >= t0 + 900
+
+
+def test_gate_recovers_on_final_probe(bench, monkeypatch):
+    mod, ft = bench
+    state = {"n": 0}
+
+    def fake_run(cmd, **kw):
+        state["n"] += 1
+        if state["n"] == 1:
+            ft.now += kw["timeout"]
+            raise subprocess.TimeoutExpired(cmd, kw["timeout"])
+
+        class R:
+            returncode = 0
+            stdout = "claim-ok\n"
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    ok, rec = mod._wait_for_claim(_flag(), 900, "x")
+    assert ok and rec is None
+    assert state["n"] == 2
