@@ -127,7 +127,7 @@ def bench_shallow_water(flag):
     model = ShallowWater(grid, (ny, nx), params)
 
     days = 0.1
-    n_steps = int(days * params.day_seconds / params.dt)  # 451
+    n_steps = int(days * params.day_seconds / params.dt)  # 432 (timed: 431)
 
     # ALL steps in ONE jitted call: the tunnel costs ~100 ms per call,
     # which round 2 paid 9 times (VERDICT.md weak #2 traced to this).

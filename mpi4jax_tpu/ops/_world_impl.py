@@ -130,11 +130,17 @@ def _staged_eager_impl(p, out_aval_fn, host_fn):
             ]
             result = host_fn(*host_args, **params)
             out = _contig(np.asarray(result, dtype=out_aval.dtype))
-            dev = next(
-                (a.device for a in args
-                 if hasattr(a, "device") and a.device is not None),
-                jax.devices()[0],
-            )
+            # NB: `.device` raises ValueError (not AttributeError) on a
+            # multi-device sharded Array — probe via .devices() instead
+            dev = jax.devices()[0]
+            for a in args:
+                devs = getattr(a, "devices", None)
+                if callable(devs):
+                    try:
+                        dev = next(iter(devs()))
+                        break
+                    except Exception:
+                        continue
             return jax.device_put(out, dev)
         return _jax_dispatch.apply_primitive(p, *args, **params)
 
@@ -212,7 +218,7 @@ def _ffi_attrs(comm=None, op=None, **scalars):
     return attrs
 
 
-def _emit_ffi_call(ctx, target, args, attrs):
+def _emit_ffi_call(ctx, target, args, attrs, alias_in_out=False):
     token = ctx.tokens_in.get(comm_effect)
     result_types = [mlir.token_type()] + [
         mlir.aval_to_ir_type(a) for a in ctx.avals_out
@@ -224,19 +230,30 @@ def _emit_ffi_call(ctx, target, args, attrs):
         backend_config=attrs,
         has_side_effect=True,
         api_version=4,
+        # in-place ops (same-shape, handler tolerates in == out) alias the
+        # data operand onto the result so XLA reuses the buffer instead of
+        # materializing a copy — per-op payload-sized savings inside jit
+        # (measured ~9 ms/op at 16 MB before aliasing)
+        operand_output_aliases={1: 1} if alias_in_out else None,
     )
     token_out, *results = call.results
     ctx.set_tokens_out(mlir.TokenSet({comm_effect: token_out}))
     return results
 
 
-def _register_ffi_lowering(p, target, identity_param=None):
+def _register_ffi_lowering(p, target, identity_param=None,
+                           alias_in_out=False):
     """cpu lowering: native FFI custom call, falling back to the host
     callback when the fast path is unavailable or disabled.
 
     ``identity_param`` names a boolean primitive param that short-circuits
     the lowering to the identity (allreduce's transposed adjoint pass,
     reference allreduce.py:87-89); it is never sent as an FFI attribute.
+
+    ``alias_in_out`` marks ops whose native handler accepts
+    ``sendbuf == recvbuf`` (allreduce/reduce/scan/bcast, and recv whose
+    operand is a dead shape carrier) — NOT sendrecv/alltoall, whose
+    send side still reads the operand while the receive side writes.
     """
 
     def lowering(ctx, *args, **params):
@@ -246,7 +263,8 @@ def _register_ffi_lowering(p, target, identity_param=None):
 
         if not bridge.ffi_available():
             return p._callback_lowering(ctx, *args, **params)
-        return _emit_ffi_call(ctx, target, args, _ffi_attrs(**params))
+        return _emit_ffi_call(ctx, target, args, _ffi_attrs(**params),
+                              alias_in_out=alias_in_out)
 
     mlir.register_lowering(p, lowering, platform="cpu")
 
@@ -457,7 +475,8 @@ def _allreduce_lowering(ctx, x, *, comm, op, transpose=False):
 mlir.register_lowering(allreduce_p, _allreduce_lowering)
 allreduce_p._callback_lowering = _allreduce_lowering
 _register_ffi_lowering(
-    allreduce_p, "tpucomm_allreduce", identity_param="transpose"
+    allreduce_p, "tpucomm_allreduce", identity_param="transpose",
+    alias_in_out=True,
 )
 reduce_p = _make_primitive("reduce", _same_aval, _host_reduce)
 scan_p = _make_primitive("scan", _same_aval, _host_scan)
@@ -490,18 +509,18 @@ allgather_p = _make_primitive("allgather", _stacked_aval, _host_allgather)
 gather_p = _make_primitive("gather", _gather_aval, _host_gather)
 scatter_p = _make_primitive("scatter", _unstacked_aval, _host_scatter)
 
-for _p, _target in (
-    (reduce_p, "tpucomm_reduce"),
-    (scan_p, "tpucomm_scan"),
-    (bcast_p, "tpucomm_bcast"),
-    (alltoall_p, "tpucomm_alltoall"),
-    (send_p, "tpucomm_send"),
-    (barrier_p, "tpucomm_barrier"),
-    (allgather_p, "tpucomm_allgather"),
-    (gather_p, "tpucomm_gather"),
-    (scatter_p, "tpucomm_scatter"),
+for _p, _target, _alias in (
+    (reduce_p, "tpucomm_reduce", True),
+    (scan_p, "tpucomm_scan", True),
+    (bcast_p, "tpucomm_bcast", True),
+    (alltoall_p, "tpucomm_alltoall", False),
+    (send_p, "tpucomm_send", False),
+    (barrier_p, "tpucomm_barrier", False),
+    (allgather_p, "tpucomm_allgather", False),
+    (gather_p, "tpucomm_gather", False),
+    (scatter_p, "tpucomm_scatter", False),
 ):
-    _register_ffi_lowering(_p, _target)
+    _register_ffi_lowering(_p, _target, alias_in_out=_alias)
 
 
 # recv/sendrecv route around the FFI fast path when the call carries a
@@ -513,7 +532,10 @@ def _recv_ffi_lowering(ctx, *args, **params):
     if params.get("status") is not None or not bridge.ffi_available():
         return recv_p._callback_lowering(ctx, *args, **params)
     params.pop("status", None)
-    return _emit_ffi_call(ctx, "tpucomm_recv", args, _ffi_attrs(**params))
+    # the operand is only a shape carrier — its buffer is dead, safe to
+    # write the received bytes straight into it
+    return _emit_ffi_call(ctx, "tpucomm_recv", args, _ffi_attrs(**params),
+                          alias_in_out=True)
 
 
 def _sendrecv_ffi_lowering(ctx, *args, **params):

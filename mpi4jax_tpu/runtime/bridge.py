@@ -342,9 +342,15 @@ def bcast(handle, buf, root) -> np.ndarray:
     return out
 
 
-def allreduce(handle, buf, op_code: int) -> np.ndarray:
+def allreduce(handle, buf, op_code: int, out: Optional[np.ndarray] = None
+              ) -> np.ndarray:
+    """``out`` lets hot loops reuse the result buffer: a fresh multi-MB
+    allocation per call costs page faults that dominate large-message
+    timings (glibc returns big frees to the kernel immediately)."""
     buf = _contig(buf)
-    out = np.empty_like(buf)
+    if (out is None or out.shape != buf.shape or out.dtype != buf.dtype
+            or not out.flags.c_contiguous):
+        out = np.empty_like(buf)
     rc = get_lib().tpucomm_allreduce(
         _i64(handle), _ptr(buf), _ptr(out), _i64(buf.size),
         _dtypes.wire_code(buf.dtype), op_code,

@@ -55,12 +55,18 @@ def main(argv=None):
             )
 
     base_port = args.port or (40000 + os.getpid() % 20000)
+    # job-unique token for /dev/shm arena names: a crashed earlier job
+    # with the same port must never collide with this one's segments
+    import uuid
+
+    jobid = uuid.uuid4().hex[:16]
     procs = []
     for rank in range(args.np):
         env = dict(os.environ)
         env["MPI4JAX_TPU_RANK"] = str(rank)
         env["MPI4JAX_TPU_SIZE"] = str(args.np)
         env["MPI4JAX_TPU_COORD"] = f"127.0.0.1:{base_port}"
+        env["MPI4JAX_TPU_JOBID"] = jobid
         if args.hosts:
             env["MPI4JAX_TPU_HOSTS"] = args.hosts
         if args.platform:

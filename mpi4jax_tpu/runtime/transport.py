@@ -20,10 +20,46 @@ ENV_RANK = "MPI4JAX_TPU_RANK"
 ENV_SIZE = "MPI4JAX_TPU_SIZE"
 ENV_COORD = "MPI4JAX_TPU_COORD"
 
+# Foreign launcher adoption: a job started by mpirun / srun / a PMI-style
+# launcher already carries rank/size in its environment — accept those so
+# this framework is a drop-in for `mpirun -n N python prog.py` workflows
+# (the reference's only launch mode, README.rst:73-77 there).  Pairs are
+# checked in order; the native launcher's own variables win.
+_FOREIGN_RANK_SIZE = (
+    (ENV_RANK, ENV_SIZE),
+    ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),  # Open MPI mpirun
+    ("PMI_RANK", "PMI_SIZE"),                          # MPICH / PMI-1
+    ("SLURM_PROCID", "SLURM_NTASKS"),                  # srun
+)
+
+
+def _detect_rank_size():
+    """(rank, size) from the first launcher env pair present, else None.
+
+    The SLURM pair alone is NOT a world signal: every *batch step*
+    exports ``SLURM_PROCID=0``/``SLURM_NTASKS=N`` into plain ``python``
+    invocations too, and adopting those would hang single-process
+    mesh-tier programs waiting for N-1 phantom peers.  srun-launched
+    tasks additionally carry ``SLURM_LAUNCH_NODE_IPADDR``, so that is
+    required for the SLURM pair.
+
+    Multi-host jobs must also give every rank the per-rank host table
+    via ``MPI4JAX_TPU_HOSTS`` (the coord var only carries the base
+    port); same-host jobs work with the defaults.
+    """
+    for rank_var, size_var in _FOREIGN_RANK_SIZE:
+        if rank_var in os.environ and size_var in os.environ:
+            if (rank_var == "SLURM_PROCID"
+                    and "SLURM_LAUNCH_NODE_IPADDR" not in os.environ):
+                continue
+            return int(os.environ[rank_var]), int(os.environ[size_var])
+    return None
+
 
 def in_world() -> bool:
-    """True when this process was launched as a rank of a world job."""
-    return ENV_RANK in os.environ and ENV_SIZE in os.environ
+    """True when this process was launched as a rank of a world job
+    (by this framework's launcher, mpirun, srun, or any PMI launcher)."""
+    return _detect_rank_size() is not None
 
 
 _world: Optional["WorldComm"] = None
@@ -32,15 +68,17 @@ _world: Optional["WorldComm"] = None
 def get_world_comm() -> "WorldComm":
     global _world
     if _world is None:
-        if not in_world():
+        rs = _detect_rank_size()
+        if rs is None:
             raise RuntimeError(
-                "not running under the mpi4jax_tpu launcher; start with "
+                "not running under a world launcher; start with "
                 "`python -m mpi4jax_tpu.runtime.launch -n <ranks> prog.py` "
+                "(or mpirun/srun — OMPI_*/PMI_*/SLURM_* env is adopted), "
                 "or use the mesh tier (mpi4jax_tpu.spmd) in a single process"
             )
         _world = WorldComm(
-            rank=int(os.environ[ENV_RANK]),
-            size=int(os.environ[ENV_SIZE]),
+            rank=rs[0],
+            size=rs[1],
             coord=os.environ.get(ENV_COORD, "127.0.0.1:49817"),
         )
     return _world

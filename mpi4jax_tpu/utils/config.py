@@ -13,6 +13,17 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
 - ``MPI4JAX_TPU_DISABLE_FFI`` — skip the native XLA FFI custom-call fast
                                 path on cpu and route world-tier ops through
                                 host callbacks instead (debug aid).
+- ``MPI4JAX_TPU_DISABLE_SHM`` — force TCP collectives even when every rank
+                                of a communicator shares one host (the shm
+                                arena is the default there; read natively in
+                                native/tpucomm.cc).
+- ``MPI4JAX_TPU_SHM_MB``      — shm arena slot size in MB (default 32; read
+                                natively).
+- ``MPI4JAX_TPU_SHM_TIMEOUT_S`` — shm barrier timeout seconds (default 180;
+                                read natively).
+- ``MPI4JAX_TPU_JOBID``       — unique token for /dev/shm segment names
+                                (the launcher sets a uuid per job; read
+                                natively).
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels

@@ -113,8 +113,10 @@ ffi::Error BcastImpl(ffi::Token, ffi::AnyBuffer x,
                      ffi::Result<ffi::Token>,
                      ffi::Result<ffi::AnyBuffer> out,
                      int64_t comm, int32_t root) {
-  /* in-place collective on the output (bridge.py::bcast copies first) */
-  std::memcpy(out->untyped_data(), x.untyped_data(), x.size_bytes());
+  /* in-place collective on the output (bridge.py::bcast copies first;
+   * under jit the operand is usually aliased onto the result) */
+  if (out->untyped_data() != x.untyped_data())
+    std::memcpy(out->untyped_data(), x.untyped_data(), x.size_bytes());
   check_abort("Bcast", tpucomm_bcast(comm, out->untyped_data(),
                                      (int64_t)out->size_bytes(), root));
   return ffi::Error::Success();
@@ -139,7 +141,7 @@ ffi::Error GatherImpl(ffi::Token, ffi::AnyBuffer x,
    * (size, ...) stack; non-root's out is x-shaped and gets the input
    * back (exact reference contract, gather.py:213-226 there; the native
    * call ignores recvbuf off-root) */
-  if (tpucomm_rank(comm) != root)
+  if (tpucomm_rank(comm) != root && out->untyped_data() != x.untyped_data())
     std::memcpy(out->untyped_data(), x.untyped_data(),
                 (size_t)x.size_bytes());
   check_abort("Gather",

@@ -41,6 +41,43 @@ def test_basic_ops(np_):
     assert res.stdout.count("basic_ops OK") == np_
 
 
+def test_shm_disabled_tcp_path():
+    # collectives fall back to the framed TCP schedules under the shm
+    # kill switch — numerics must be identical (CI axis for the arena)
+    res = run_launcher(
+        "full_ops.py", 2, timeout=300,
+        env_extra={"MPI4JAX_TPU_DISABLE_SHM": "1"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("full_ops OK") == 2
+
+
+def test_foreign_launcher_env_adoption():
+    # an mpirun-shaped environment (OMPI_COMM_WORLD_RANK/SIZE) with no
+    # MPI4JAX_TPU_* vars must be adopted as the world job description —
+    # the drop-in path for `mpirun -n 2 python prog.py` users
+    # (reference README.rst:73-77)
+    _port[0] += 7
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MPI4JAX_TPU_COORD"] = f"127.0.0.1:{_port[0]}"
+    procs = []
+    for rank in range(2):
+        e = dict(env)
+        e["OMPI_COMM_WORLD_RANK"] = str(rank)
+        e["OMPI_COMM_WORLD_SIZE"] = "2"
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(PROGRAMS, "basic_ops.py")],
+            env=e, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        ))
+    outs = [p.communicate(timeout=180) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err + out
+        assert "basic_ops OK" in out
+
+
 @pytest.mark.parametrize("np_", [2, 4])
 def test_full_ops(np_):
     # the mesh tier's identity battery (dtype sweep, double transpose,
