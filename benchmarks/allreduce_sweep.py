@@ -67,7 +67,7 @@ def mesh_tier_sweep(max_bytes, pallas=False):
     return results
 
 
-def world_tier_rank(max_bytes):
+def world_tier_rank(max_bytes, sizes=None):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -80,8 +80,13 @@ def world_tier_rank(max_bytes):
     import numpy as np
 
     n = comm.size()
-    size = 1024
-    while size <= max_bytes:
+    size_list = sizes or []
+    if not size_list:
+        size = 1024
+        while size <= max_bytes:
+            size_list.append(size)
+            size *= 4
+    for size in size_list:
         x = jnp.ones((size // 4,), jnp.float32)
         # Small sizes: K ops inside ONE jit call — a per-call dispatch of
         # an ordered-effects computation goes through JAX's Python path
@@ -146,7 +151,6 @@ def world_tier_rank(max_bytes):
                     2 * (n - 1) / n * size / raw_dt / 1e9, 3
                 ),
             }), flush=True)
-        size *= 4
 
 
 if __name__ == "__main__":
@@ -154,11 +158,16 @@ if __name__ == "__main__":
     ap.add_argument("--max-mb", type=float, default=64)
     ap.add_argument("--world", action="store_true")
     ap.add_argument("--pallas", action="store_true")
+    ap.add_argument("--sizes", default=None,
+                    help="comma-separated byte sizes (world tier only; "
+                         "overrides the x4 ladder)")
     args = ap.parse_args()
     if args.world and args.pallas:
         ap.error("--pallas applies to the mesh tier; drop --world")
     max_bytes = int(args.max_mb * 1e6)
     if args.world:
-        world_tier_rank(max_bytes)
+        sizes = ([int(s) for s in args.sizes.split(",")]
+                 if args.sizes else None)
+        world_tier_rank(max_bytes, sizes=sizes)
     else:
         mesh_tier_sweep(max_bytes, pallas=args.pallas)

@@ -1,0 +1,88 @@
+"""Per-rank shallow-water program for the world-tier scaling study.
+
+The analog of the reference's ``mpirun -n N python examples/shallow_water.py
+--benchmark`` runs (its CPU scaling table, docs/shallow-water.rst:56-78).
+Launch under the world launcher (or mpirun — the env is adopted):
+
+    python -m mpi4jax_tpu.runtime.launch -n 4 benchmarks/sw_world_rank.py \
+        -- --grid 2 2 --size 1800 3600 --days 0.1
+
+Rank 0 prints one JSON line: wall seconds of the timed multistep region
+(same region as the reference's "Solution took") plus config.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grid", type=int, nargs=2, default=None,
+                    help="(gy gx); default: 1 x size")
+    ap.add_argument("--size", type=int, nargs=2, default=(1800, 3600))
+    ap.add_argument("--days", type=float, default=0.1)
+    ap.add_argument("--check", action="store_true",
+                    help="rank 0 validates against the mesh-tier solver")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m4j
+    from mpi4jax_tpu.models.shallow_water import SWParams
+    from mpi4jax_tpu.models.shallow_water_world import WorldShallowWater
+
+    comm = m4j.get_default_comm()
+    n = comm.size()
+    grid = tuple(args.grid) if args.grid else (1, n)
+    params = SWParams(dx=5e3, dy=5e3)
+    model = WorldShallowWater(comm, grid, tuple(args.size), params)
+
+    n_steps = int(args.days * params.day_seconds / params.dt)
+    state = model.step_fn(1, first=True)(model.init())
+    run = model.step_fn(n_steps - 1, first=False)
+    jax.block_until_ready(run(state))  # compile + warmup
+
+    t0 = time.perf_counter()
+    out = run(state)
+    jax.block_until_ready(out.h)
+    elapsed = time.perf_counter() - t0
+
+    h = np.asarray(model.interior(out.h))
+    assert np.all(np.isfinite(h)), "diverged"
+
+    if args.check:
+        hg = model.gather_global(out.h)
+        if comm.rank() == 0:
+            from mpi4jax_tpu.models.shallow_water import ShallowWater
+            from mpi4jax_tpu.parallel.grid import ProcessGrid
+
+            ref = ShallowWater(
+                ProcessGrid((1, 1), devices=jax.devices()[:1]),
+                tuple(args.size), params,
+            )
+            rs = ref.step_fn(1, first=True)(ref.init())
+            rs = ref.step_fn(n_steps - 1, first=False, impl="xla")(rs)
+            href = np.asarray(ref.interior(rs.h))
+            np.testing.assert_allclose(hg, href, rtol=2e-4, atol=2e-4)
+            print("sw_world CHECK OK", flush=True)
+
+    if comm.rank() == 0:
+        print(json.dumps({
+            "bench": "shallow_water_world", "ranks": n,
+            "grid": list(grid), "size": list(args.size),
+            "steps": n_steps - 1, "seconds": round(elapsed, 3),
+            "steps_per_s": round((n_steps - 1) / elapsed, 2),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -50,6 +50,7 @@ from .ops import (  # noqa: E402
     send,
     sendrecv,
 )
+from .ops._world_impl import explicit_token_ordering  # noqa: E402
 from .parallel import (  # noqa: E402
     MeshComm,
     current_comm,
@@ -127,6 +128,7 @@ __all__ = [
     "spmd",
     "set_logging",
     "has_ici_support",
+    "explicit_token_ordering",
     "Status",
     "ANY_TAG",
     "ANY_SOURCE",

@@ -31,6 +31,10 @@ class ResNetConfig(NamedTuple):
     n_classes: int = 10
     in_channels: int = 3
     groups: int = 8
+    block: str = "basic"  # "basic": two 3x3 convs (ResNet-18/34);
+    #                       "bottleneck": 1x1 -> 3x3 -> 1x1 with 4x
+    #                       expansion (ResNet-50-class: stages
+    #                       (3, 4, 6, 3) + bottleneck = ResNet-50)
     dtype: str = "float32"  # conv compute dtype; "bfloat16" on real TPU
     # mixed precision: master params stay f32 (the optimizer update and
     # the DP grad-allreduce run in f32); forward casts per use, autodiff
@@ -43,6 +47,18 @@ class ResNetConfig(NamedTuple):
     #                      conv + 3x3/2 avg pool, the standard ResNet
     #                      head — stage 1 sees 1/16 the pixels (use
     #                      for 224^2-class inputs)
+
+
+def _expansion(cfg: ResNetConfig) -> int:
+    return 4 if cfg.block == "bottleneck" else 1
+
+
+def resnet50_config(**overrides) -> ResNetConfig:
+    """The BASELINE.md-named config: ResNet-50 = bottleneck (3, 4, 6, 3)."""
+    base = dict(stages=(3, 4, 6, 3), block="bottleneck", n_classes=1000,
+                stem="imagenet")
+    base.update(overrides)
+    return ResNetConfig(**base)
 
 
 def _conv(x, w, stride=1):
@@ -76,13 +92,14 @@ def init_params(cfg: ResNetConfig, seed: int = 0):
             )
         )
 
+    exp = _expansion(cfg)
     stem_k = 7 if cfg.stem == "imagenet" else 3
     params = {
         "stem": conv_w(stem_k, cfg.in_channels, cfg.widths[0]),
         "stem_gn": (jnp.ones(cfg.widths[0]), jnp.zeros(cfg.widths[0])),
         "stages": [],
         "head": jnp.asarray(
-            (rng.randn(cfg.widths[-1], cfg.n_classes) * 0.01).astype(
+            (rng.randn(cfg.widths[-1] * exp, cfg.n_classes) * 0.01).astype(
                 np.float32
             )
         ),
@@ -94,14 +111,27 @@ def init_params(cfg: ResNetConfig, seed: int = 0):
         for b in range(depth):
             stride, has_proj = _block_plan(cfg, si, b, cin)
             del stride  # static; recomputed in forward
-            blocks.append({
-                "conv1": conv_w(3, cin, width),
-                "gn1": (jnp.ones(width), jnp.zeros(width)),
-                "conv2": conv_w(3, width, width),
-                "gn2": (jnp.ones(width), jnp.zeros(width)),
-                "proj": conv_w(1, cin, width) if has_proj else None,
-            })
-            cin = width
+            cout = width * exp
+            if cfg.block == "bottleneck":
+                blk = {
+                    "conv1": conv_w(1, cin, width),
+                    "gn1": (jnp.ones(width), jnp.zeros(width)),
+                    "conv2": conv_w(3, width, width),
+                    "gn2": (jnp.ones(width), jnp.zeros(width)),
+                    "conv3": conv_w(1, width, cout),
+                    "gn3": (jnp.ones(cout), jnp.zeros(cout)),
+                    "proj": conv_w(1, cin, cout) if has_proj else None,
+                }
+            else:
+                blk = {
+                    "conv1": conv_w(3, cin, width),
+                    "gn1": (jnp.ones(width), jnp.zeros(width)),
+                    "conv2": conv_w(3, width, width),
+                    "gn2": (jnp.ones(width), jnp.zeros(width)),
+                    "proj": conv_w(1, cin, cout) if has_proj else None,
+                }
+            blocks.append(blk)
+            cin = cout
         params["stages"].append(blocks)
     return params
 
@@ -109,7 +139,7 @@ def init_params(cfg: ResNetConfig, seed: int = 0):
 def _block_plan(cfg: ResNetConfig, stage: int, block: int, cin: int):
     """Static (stride, needs_projection) for a block — shared by init and
     forward so the pytree holds arrays only."""
-    width = cfg.widths[stage]
+    width = cfg.widths[stage] * _expansion(cfg)
     stride = 2 if (block == 0 and stage > 0) else 1
     return stride, (cin != width or stride > 1)
 
@@ -137,17 +167,31 @@ def forward(params, x, cfg: ResNetConfig):
             feature_group_count=c,
         )
     cin = cfg.widths[0]
+    exp = _expansion(cfg)
     for si, blocks in enumerate(params["stages"]):
         for b, blk in enumerate(blocks):
             stride, _ = _block_plan(cfg, si, b, cin)
-            y = _conv(h, blk["conv1"], stride)
-            y = jnp.maximum(_groupnorm(y, *blk["gn1"], g), 0)
-            y = _groupnorm(_conv(y, blk["conv2"]), *blk["gn2"], g)
+            if cfg.block == "bottleneck":
+                # 1x1 reduce -> 3x3 (strided) -> 1x1 expand
+                y = jnp.maximum(
+                    _groupnorm(_conv(h, blk["conv1"]), *blk["gn1"], g), 0
+                )
+                y = jnp.maximum(
+                    _groupnorm(
+                        _conv(y, blk["conv2"], stride), *blk["gn2"], g
+                    ),
+                    0,
+                )
+                y = _groupnorm(_conv(y, blk["conv3"]), *blk["gn3"], g)
+            else:
+                y = _conv(h, blk["conv1"], stride)
+                y = jnp.maximum(_groupnorm(y, *blk["gn1"], g), 0)
+                y = _groupnorm(_conv(y, blk["conv2"]), *blk["gn2"], g)
             skip = h
             if blk["proj"] is not None:
                 skip = _conv(h, blk["proj"], stride)
             h = jnp.maximum(y + skip, 0)
-            cin = cfg.widths[si]
+            cin = cfg.widths[si] * exp
     pooled = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
     return pooled @ params["head"] + params["head_b"]
 

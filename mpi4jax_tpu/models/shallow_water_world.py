@@ -1,0 +1,246 @@
+"""World-tier shallow-water: one PROCESS per rank, reference style.
+
+The mesh-tier solver (:mod:`.shallow_water`) decomposes the domain over
+a device mesh inside one SPMD program.  This variant is the shape the
+reference actually runs — ``mpirun -n N python …`` with a per-rank
+program, halo exchange as explicit token-ordered point-to-point over the
+communication substrate (/root/reference/examples/shallow_water.py:173-271)
+— here over the framework's world tier (native shm/TCP transport), with
+every step jitted per rank and the world ops lowered as ordered FFI
+custom calls.
+
+All the physics is inherited from :class:`.shallow_water.ShallowWater`;
+only the parallel substrate is swapped:
+
+- rank coordinates are static Python ints (per-rank programs may
+  branch on rank — the reference's model);
+- the halo exchange is world-tier ``sendrecv`` per direction (interior
+  edges) and plain wall handling at physical boundaries;
+- the initial-condition collectives (`scan` along columns, global
+  `allreduce`) dispatch to the world tier through the SAME ``ops``
+  calls the mesh tier uses — the model code is tier-agnostic through
+  the public API, which is the point of the framework.
+
+Launch (the scaling study ``benchmarks/sw_world_rank.py`` wraps this):
+
+    python -m mpi4jax_tpu.runtime.launch -n 4 benchmarks/sw_world_rank.py
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from ..runtime.transport import WorldComm
+from .shallow_water import ShallowWater, SWParams, SWState
+
+
+class _WorldGrid:
+    """The minimal grid surface the model touches in world mode."""
+
+    def __init__(self, comm: WorldComm, shape, coords):
+        self.comm = comm
+        self.shape = shape
+        self.coords = coords
+        self._col_comm = None
+
+    def axis_comm(self, dim: int):
+        assert dim == 0, "world model only scans along y"
+        if self._col_comm is None:
+            iy, ix = self.coords
+            # ranks sharing a column, ordered south→north by iy
+            self._col_comm = self.comm.split(color=ix, key=iy)
+        return self._col_comm
+
+
+class WorldShallowWater(ShallowWater):
+    """Per-rank world-tier solver on a ``(gy, gx)`` rank grid."""
+
+    def __init__(self, comm: WorldComm, grid_shape, global_shape,
+                 params: Optional[SWParams] = None):
+        gy, gx = grid_shape
+        if comm.size() != gy * gx:
+            raise ValueError(
+                f"grid {grid_shape} needs {gy * gx} ranks, world has "
+                f"{comm.size()}"
+            )
+        self.comm = comm
+        self.ny, self.nx = global_shape
+        if self.ny % gy or self.nx % gx:
+            raise ValueError(
+                f"domain {global_shape} not divisible by grid {grid_shape}"
+            )
+        rank = comm.rank()
+        # row-major rank grid: rank = iy * gx + ix (iy south→north)
+        self.iy, self.ix = rank // gx, rank % gx
+        self.gy, self.gx = gy, gx
+        self.ny_loc = self.ny // gy
+        self.nx_loc = self.nx // gx
+        self.params = params or SWParams(dx=5e3, dy=5e3)
+        self.block_shape = (self.ny_loc + 2, self.nx_loc + 2)
+        self.grid = _WorldGrid(comm, (gy, gx), (self.iy, self.ix))
+
+    # -- substrate overrides ---------------------------------------------
+    def _local_coords(self):
+        p = self.params
+        jy = jnp.arange(-1, self.ny_loc + 1) + self.iy * self.ny_loc
+        jx = jnp.arange(-1, self.nx_loc + 1) + self.ix * self.nx_loc
+        y = jy.astype(jnp.float32) * p.dy
+        x = jx.astype(jnp.float32) * p.dx
+        return jnp.meshgrid(y, x, indexing="ij")
+
+    def _neighbor(self, diy, dix):
+        """Rank of the (diy, dix) grid neighbor, or None (wall)."""
+        iy, ix = self.iy + diy, self.ix + dix
+        if not 0 <= iy < self.gy:
+            return None
+        if not 0 <= ix < self.gx:
+            if not self.params.periodic_x:
+                return None
+            ix %= self.gx
+        return iy * self.gx + ix
+
+    def _dir_exchange(self, stack, dim, hi_neighbor, lo_neighbor):
+        """Fill ghost strips of the field stack along one array dim.
+
+        ``stack``: (nfields, my+2, mx+2).  Interior strips go to the
+        neighbors; what arrives fills the ghosts.  Wall sides keep the
+        existing ghost values (the boundary condition) — same contract
+        as the mesh tier's ``halo_exchange``.
+
+        Tags encode the travel DIRECTION (northward 10+dim, southward
+        20+dim) so a rank's send to its high neighbor matches that
+        neighbor's low-side receive.  Degenerate ring sizes get their
+        own schedules: a self-wrap (periodic extent 1) fills ghosts
+        locally, and a 2-rank ring bundles both strips into ONE
+        symmetric sendrecv (two crossing sendrecvs to the same peer
+        would meet each other's tags out of order — the ordered
+        transport would fail fast).
+        """
+        me = self.iy * self.gx + self.ix
+        extent = stack.shape[dim + 1]
+        lo_int = jax.lax.slice_in_dim(stack, 1, 2, axis=dim + 1)
+        hi_int = jax.lax.slice_in_dim(stack, extent - 2, extent - 1,
+                                      axis=dim + 1)
+        from_above = from_below = None
+        if hi_neighbor == me and lo_neighbor == me:
+            # self-wrap: the high ghost wraps around to the LOW interior
+            # strip and vice versa (mesh tier's n==1 periodic case)
+            from_above, from_below = lo_int, hi_int
+        elif hi_neighbor is not None and hi_neighbor == lo_neighbor:
+            # 2-rank ring: both directions are one peer — one message
+            both = jnp.concatenate([lo_int, hi_int], axis=dim + 1)
+            got = ops.sendrecv(
+                both, source=hi_neighbor, dest=hi_neighbor,
+                sendtag=30 + dim, recvtag=30 + dim, comm=self.comm,
+            )
+            from_above = jax.lax.slice_in_dim(got, 0, 1, axis=dim + 1)
+            from_below = jax.lax.slice_in_dim(got, 1, 2, axis=dim + 1)
+        else:
+            # exchange with the high-side neighbor: my high-interior
+            # travels northward; its low-interior arrives southward.
+            # One tag per grid dim suffices: with distinct neighbors the
+            # two directions ride different sockets (and equal
+            # send/recv tags keep the native FFI sendrecv fast path).
+            if hi_neighbor is not None:
+                from_above = ops.sendrecv(
+                    hi_int, source=hi_neighbor, dest=hi_neighbor,
+                    sendtag=40 + dim, recvtag=40 + dim, comm=self.comm,
+                )
+            if lo_neighbor is not None:
+                from_below = ops.sendrecv(
+                    lo_int, source=lo_neighbor, dest=lo_neighbor,
+                    sendtag=40 + dim, recvtag=40 + dim, comm=self.comm,
+                )
+        if from_above is not None:
+            start = [0] * stack.ndim
+            start[dim + 1] = extent - 1
+            stack = jax.lax.dynamic_update_slice(
+                stack, from_above.astype(stack.dtype), start
+            )
+        if from_below is not None:
+            start = [0] * stack.ndim
+            stack = jax.lax.dynamic_update_slice(
+                stack, from_below.astype(stack.dtype), start
+            )
+        return stack
+
+    def _exchange(self, fields, kinds):
+        p = self.params
+        stack = jnp.stack(fields)  # one message per direction, all fields
+        # y (array dim 0): high side = north neighbor (iy+1)
+        stack = self._dir_exchange(
+            stack, 0, self._neighbor(+1, 0), self._neighbor(-1, 0)
+        )
+        # x (array dim 1)
+        stack = self._dir_exchange(
+            stack, 1, self._neighbor(0, +1), self._neighbor(0, -1)
+        )
+        at_north = self.iy == self.gy - 1
+        at_east = self.ix == self.gx - 1
+        result = []
+        for f, kind in zip(stack, kinds):
+            if kind == "v" and at_north:
+                f = f.at[-2, :].set(0.0)
+            elif kind == "u" and not p.periodic_x and at_east:
+                f = f.at[:, -2].set(0.0)
+            result.append(f)
+        return result
+
+    # -- drivers (no shard_map: the process IS the rank) ------------------
+    def _spmd(self, fn, out_specs=None):
+        del out_specs
+        return fn
+
+    def init(self) -> SWState:
+        fn = getattr(self, "_init_fn", None)
+        if fn is None:
+            fn = jax.jit(lambda: self._initial_local())
+            self._init_fn = fn
+        return fn()
+
+    def step_fn(self, n_steps: int, first: bool = False,
+                donate: bool = False, impl: str = "xla",
+                tile_rows: int = 120, fuse: int = 3):
+        if impl not in ("auto", "xla"):
+            raise ValueError(
+                "world-tier solver runs the XLA slice-stencil step "
+                "(the Pallas fused kernel is a single-chip mesh path)"
+            )
+
+        def steps(state):
+            if first:
+                state = self._step_local(state, first=True)
+                remaining = n_steps - 1
+            else:
+                remaining = n_steps
+            if remaining > 0:
+                state = jax.lax.scan(
+                    lambda s, _: (self._step_local(s, first=False), ()),
+                    state, None, length=remaining,
+                )[0]
+            return state
+
+        return jax.jit(steps, donate_argnums=0 if donate else ())
+
+    def interior(self, f):
+        return f[1:-1, 1:-1]
+
+    def gather_global(self, f):
+        """Full-domain field on rank 0 (the reference's solution gather,
+        its shallow_water.py:588): world gather + block reassembly."""
+        rows = ops.gather(self.interior(f), root=0, comm=self.comm)
+        if self.comm.rank() != 0:
+            return None
+        import numpy as np
+
+        blocks = np.asarray(rows).reshape(
+            self.gy, self.gx, self.ny_loc, self.nx_loc
+        )
+        return np.block(
+            [[blocks[iy, ix] for ix in range(self.gx)]
+             for iy in range(self.gy)]
+        )

@@ -61,14 +61,26 @@ def token_out(token, *results):
     return lax.optimization_barrier((token, *results))[0]
 
 
-def maybe_tokenized(fn, x, token):
+def maybe_tokenized(fn, x, token, token_fn=None):
     """Run op body ``fn(x)`` with optional token threading.
 
     Returns ``fn(x)`` when ``token is None`` (primary API), else
     ``(fn(x'), token')`` with the token tied through the op.
+
+    ``token_fn(x, token) -> (result, token')`` is the world tier's
+    token-OPERAND route, used in explicit-token (unordered-effect) mode:
+    XLA folds ``optimization_barrier`` value ties around opaque custom
+    calls, so there the token must ride through the call itself as a
+    real operand/result (the reference's L1 wire format,
+    allreduce.py:101-104 there).
     """
     if token is None:
         return fn(x)
+    if token_fn is not None:
+        from . import _world_impl
+
+        if not _world_impl._ordered_now():
+            return token_fn(x, token)
     x = token_in(token, x)
     result = fn(x)
     return result, token_out(token, result)

@@ -36,12 +36,61 @@ from jax._src.lib.mlir import ir
 from jax.interpreters import ad, batching
 
 from ..utils import tracing
-from ..utils.effects import comm_effect
+from ..utils.effects import comm_effect, unordered_comm_effect
 from .reduce_ops import ALL_OPS, ReduceOp
 
 _OP_CODE = {op.name: i for i, op in enumerate(ALL_OPS)}
 
 _STAGED_EAGER = None
+
+# ---------------- ordering mode ----------------
+#
+# JAX refuses ORDERED effects in computations spanning more than one
+# device ("ordered effects are not supported for more than 1 device"),
+# so a jit that mixes mesh-tier shard_map collectives with world-tier
+# ops — the TPU-pod composition shape, SURVEY §7 hard part 4 — cannot
+# carry the ordered CommEffect.  Inside ``explicit_token_ordering()``
+# world primitives bind with the UNORDERED effect instead, and ordering
+# becomes the caller's explicit token chain (the reference's primary L1
+# design: tokens as data dependencies, docs/sharp-bits.rst there).  Use
+# the ``compat.token_api`` signatures, threading every token.
+
+# A jax config state (not a bare global) so the mode participates in the
+# jit cache key and trace context: a function traced inside the context
+# must never be silently reused outside it (and vice versa).
+from jax._src import config as _jax_config  # noqa: E402
+
+_explicit_tokens_cfg = _jax_config.bool_state(
+    name="mpi4jax_tpu_explicit_tokens",
+    default=False,
+    help=(
+        "world-tier ops trace with the unordered effect; ordering is the "
+        "caller's explicit token chain (multi-device composition mode)"
+    ),
+    include_in_jit_key=True,
+    include_in_trace_context=True,
+)
+
+
+def _ordered_now() -> bool:
+    return not _explicit_tokens_cfg.value
+
+
+def explicit_token_ordering():
+    """Context manager: world ops trace with the unordered effect.
+
+    Required for jitted programs that span multiple local devices (e.g.
+    mesh-tier ``shard_map`` collectives composed with world-tier ops in
+    one step).  Ordering of world ops is then carried ONLY by explicit
+    token chains (``mpi4jax_tpu.compat.token_api``) or value dataflow —
+    exactly the reference's token contract: unthreaded tokens mean
+    undefined order.
+
+    Backed by a jax config state, so the mode is part of the jit cache
+    key — a function jitted under the context retraces (with ordered
+    effects) when later called outside it.
+    """
+    return _explicit_tokens_cfg(True)
 
 
 def _use_staged_eager() -> bool:
@@ -91,6 +140,37 @@ def _np(x, aval):
     return _contig(np.asarray(x, dtype=aval.dtype))
 
 
+def _emit_unordered_callback(ctx, callback, args):
+    """Side-effecting host callback with no compiler token (explicit-token
+    mode): the SPMD partitioner requires a sharding on side-effecting
+    custom calls; MAXIMAL-on-device-0 runs the transport once per process
+    (jax's own pure_callback convention).  Ordering is the caller's
+    token/dataflow chain."""
+    op_sharding = _jax_callback._callback_op_sharding(
+        ctx.module_context.axis_context, None, ctx.avals_out
+    )
+    results, _, _ = _jax_callback.emit_python_callback(
+        ctx, callback, None, list(args), ctx.avals_in, ctx.avals_out,
+        has_side_effect=True, returns_token=False, sharding=op_sharding,
+    )
+    return results
+
+
+def _staged_result_device(args):
+    """Device for a staged-eager result: the first argument's device,
+    else the default.  NB: `.device` raises ValueError (not
+    AttributeError) on a multi-device sharded Array — probe via
+    .devices()."""
+    for a in args:
+        devs = getattr(a, "devices", None)
+        if callable(devs):
+            try:
+                return next(iter(devs()))
+            except Exception:
+                continue
+    return jax.devices()[0]
+
+
 def _check_callback_support(ctx):
     """Fail at compile time where the ordered-callback path would HANG
     at run time (axon_pjrt implements no host send/recv callbacks).
@@ -123,25 +203,15 @@ def _staged_eager_impl(p, out_aval_fn, host_fn):
 
     def eager_impl(*args, **params):
         if _use_staged_eager():
+            host_params = {k: v for k, v in params.items() if k != "ordered"}
             avals = [core.get_aval(a) for a in args]
-            out_aval = out_aval_fn(*avals, **params)
+            out_aval = out_aval_fn(*avals, **host_params)
             host_args = [
                 _np(jax.device_get(a), av) for a, av in zip(args, avals)
             ]
-            result = host_fn(*host_args, **params)
+            result = host_fn(*host_args, **host_params)
             out = _contig(np.asarray(result, dtype=out_aval.dtype))
-            # NB: `.device` raises ValueError (not AttributeError) on a
-            # multi-device sharded Array — probe via .devices() instead
-            dev = jax.devices()[0]
-            for a in args:
-                devs = getattr(a, "devices", None)
-                if callable(devs):
-                    try:
-                        dev = next(iter(devs()))
-                        break
-                    except Exception:
-                        continue
-            return jax.device_put(out, dev)
+            return jax.device_put(out, _staged_result_device(args))
         return _jax_dispatch.apply_primitive(p, *args, **params)
 
     return eager_impl
@@ -157,12 +227,15 @@ def _make_primitive(name, out_aval_fn, host_fn):
     p.def_impl(_staged_eager_impl(p, out_aval_fn, host_fn))
 
     def abstract_eval(*avals, **params):
-        return out_aval_fn(*avals, **params), {comm_effect}
+        ordered = params.pop("ordered", True)
+        eff = comm_effect if ordered else unordered_comm_effect
+        return out_aval_fn(*avals, **params), {eff}
 
     p.def_effectful_abstract_eval(abstract_eval)
 
     def lowering(ctx, *args, **params):
         _check_callback_support(ctx)
+        ordered = params.pop("ordered", True)
         out_aval = ctx.avals_out[0]
 
         def _callback(*flat):
@@ -171,6 +244,8 @@ def _make_primitive(name, out_aval_fn, host_fn):
             )
             return (_contig(np.asarray(result, dtype=out_aval.dtype)),)
 
+        if not ordered:
+            return _emit_unordered_callback(ctx, _callback, args)
         token = ctx.tokens_in.get(comm_effect)
         results, token, _ = _jax_callback.emit_python_callback(
             ctx,
@@ -261,12 +336,101 @@ def _register_ffi_lowering(p, target, identity_param=None,
             return [args[0]]  # identity pass, no communication
         from ..runtime import bridge
 
-        if not bridge.ffi_available():
+        if not params.get("ordered", True) or not bridge.ffi_available():
+            # unordered (explicit-token) mode keeps the callback route:
+            # the FFI call's wire format carries the compiler token
             return p._callback_lowering(ctx, *args, **params)
+        params.pop("ordered", None)
         return _emit_ffi_call(ctx, target, args, _ffi_attrs(**params),
                               alias_in_out=alias_in_out)
 
     mlir.register_lowering(p, lowering, platform="cpu")
+
+
+# ---------------- token-operand variants (explicit-token mode) ----------
+#
+# In unordered mode, ordering must be a REAL data edge through the op:
+# XLA folds ``optimization_barrier`` value-token chains around opaque
+# custom calls (observed: a scanned send/recv pair compiled with the
+# recv's operand reduced to its zeros initializer — the send dropped out
+# of the dependency cone and the scheduler ran recv first).  These
+# variants are the reference's L1 wire format (tokens as real
+# custom-call operands/results, allreduce.py:101-104 there): each takes
+# ``(*data, token)`` and returns ``(out, token')``, with the token
+# passed through the host callback itself, so no XLA pass can separate
+# the chain from the call.  No AD rules: autodiff users should use the
+# ordered (single-device) mode.
+
+_TOKEN_AVAL = core.ShapedArray((), np.dtype(np.uint32))
+_token_variants = {}
+
+
+def _make_token_variant(name, out_aval_fn, host_fn, n_data=1):
+    p = core.Primitive(f"mpi4jax_tpu_{name}_t")
+    p.multiple_results = True
+
+    def impl(*args, **params):
+        if _use_staged_eager():
+            data, tok = args[:n_data], args[n_data]
+            avals = [core.get_aval(a) for a in data]
+            out_aval = out_aval_fn(*avals, **params)
+            host_args = [
+                _np(jax.device_get(a), av) for a, av in zip(data, avals)
+            ]
+            result = host_fn(*host_args, **params)
+            out = _contig(np.asarray(result, dtype=out_aval.dtype))
+            return jax.device_put(out, _staged_result_device(data)), tok
+        return _jax_dispatch.apply_primitive(p, *args, **params)
+
+    p.def_impl(impl)
+
+    def abstract_eval(*avals, **params):
+        out = out_aval_fn(*avals[:n_data], **params)
+        return (out, _TOKEN_AVAL), {unordered_comm_effect}
+
+    p.def_effectful_abstract_eval(abstract_eval)
+
+    def lowering(ctx, *args, **params):
+        _check_callback_support(ctx)
+        data_avals = ctx.avals_in[:n_data]
+        out_aval = ctx.avals_out[0]
+
+        def _callback(*flat):
+            data, tok = flat[:n_data], flat[n_data]
+            result = host_fn(
+                *[_np(a, av) for a, av in zip(data, data_avals)], **params
+            )
+            return (_contig(np.asarray(result, dtype=out_aval.dtype)),
+                    np.asarray(tok, np.uint32))
+
+        return _emit_unordered_callback(ctx, _callback, args)
+
+    mlir.register_lowering(p, lowering)
+    _token_variants[name] = p
+    return p
+
+
+def _bind_token_variant(name, x, token, **params):
+    """(result, token') through the token-operand primitive."""
+    p = _token_variants[name]
+    tok = jnp.asarray(token, jnp.uint32)
+    args = (tok,) if x is None else (jnp.asarray(x), tok)
+    out, tok2 = p.bind(*args, **params)
+    return out, tok2
+
+
+def token_variant_fn(name, validate=None, **params):
+    """A ``token_fn`` for :func:`.._dispatch.maybe_tokenized`: routes the
+    op through its token-operand variant in explicit-token mode.
+    ``validate(x)`` runs first — this route bypasses the value-path
+    entry functions, so their checks must be supplied here."""
+
+    def fn(x, token):
+        if validate is not None:
+            validate(x)
+        return _bind_token_variant(name, x, token, **params)
+
+    return fn
 
 
 def _same_aval(x_aval, **params):
@@ -432,24 +596,29 @@ _allreduce_staged = _staged_eager_impl(
 )
 
 
-def _allreduce_impl(x, *, comm, op, transpose=False):
+def _allreduce_impl(x, *, comm, op, transpose=False, ordered=True):
     if transpose:
         return x  # identity: skip the staging D2H/H2D round trip too
-    return _allreduce_staged(x, comm=comm, op=op, transpose=transpose)
+    return _allreduce_staged(x, comm=comm, op=op, transpose=transpose,
+                             ordered=ordered)
 
 
 allreduce_p.def_impl(_allreduce_impl)
 
 
-def _allreduce_abstract_eval(x_aval, *, comm, op, transpose=False):
-    effects = set() if transpose else {comm_effect}
+def _allreduce_abstract_eval(x_aval, *, comm, op, transpose=False,
+                             ordered=True):
+    if transpose:
+        effects = set()
+    else:
+        effects = {comm_effect if ordered else unordered_comm_effect}
     return core.ShapedArray(x_aval.shape, x_aval.dtype), effects
 
 
 allreduce_p.def_effectful_abstract_eval(_allreduce_abstract_eval)
 
 
-def _allreduce_lowering(ctx, x, *, comm, op, transpose=False):
+def _allreduce_lowering(ctx, x, *, comm, op, transpose=False, ordered=True):
     if transpose:
         return [x]  # identity pass, no communication
     _check_callback_support(ctx)
@@ -463,6 +632,8 @@ def _allreduce_lowering(ctx, x, *, comm, op, transpose=False):
         )
         return (_contig(np.asarray(result, dtype=out_aval.dtype)),)
 
+    if not ordered:
+        return _emit_unordered_callback(ctx, _callback, [x])
     token = ctx.tokens_in.get(comm_effect)
     results, token, _ = _jax_callback.emit_python_callback(
         ctx, _callback, token, [x], ctx.avals_in, ctx.avals_out,
@@ -492,7 +663,7 @@ def _stacked_aval(x_aval, *, comm, **params):
     return core.ShapedArray((comm.size(),) + x_aval.shape, x_aval.dtype)
 
 
-def _gather_aval(x_aval, *, comm, root):
+def _gather_aval(x_aval, *, comm, root, **_):
     # rank-dependent output, possible because each world process traces
     # its own program: root (size, *in), others the input back (exact
     # reference contract, gather.py:86-96,213-226 there)
@@ -529,9 +700,12 @@ for _p, _target, _alias in (
 def _recv_ffi_lowering(ctx, *args, **params):
     from ..runtime import bridge
 
-    if params.get("status") is not None or not bridge.ffi_available():
+    if (params.get("status") is not None
+            or not params.get("ordered", True)
+            or not bridge.ffi_available()):
         return recv_p._callback_lowering(ctx, *args, **params)
     params.pop("status", None)
+    params.pop("ordered", None)
     # the operand is only a shape carrier — its buffer is dead, safe to
     # write the received bytes straight into it
     return _emit_ffi_call(ctx, "tpucomm_recv", args, _ffi_attrs(**params),
@@ -544,10 +718,12 @@ def _sendrecv_ffi_lowering(ctx, *args, **params):
     if (
         params.get("status") is not None
         or params["sendtag"] != params["recvtag"]
+        or not params.get("ordered", True)
         or not bridge.ffi_available()
     ):
         return sendrecv_p._callback_lowering(ctx, *args, **params)
     params.pop("status", None)
+    params.pop("ordered", None)
     tag = params.pop("sendtag")
     params.pop("recvtag")
     return _emit_ffi_call(
@@ -558,11 +734,26 @@ def _sendrecv_ffi_lowering(ctx, *args, **params):
 mlir.register_lowering(recv_p, _recv_ffi_lowering, platform="cpu")
 mlir.register_lowering(sendrecv_p, _sendrecv_ffi_lowering, platform="cpu")
 
+# token-operand variants for every op (explicit-token mode wire format)
+_make_token_variant("allreduce", _same_aval, _host_allreduce)
+_make_token_variant("reduce", _same_aval, _host_reduce)
+_make_token_variant("scan", _same_aval, _host_scan)
+_make_token_variant("bcast", _same_aval, _host_bcast)
+_make_token_variant("alltoall", _same_aval, _host_alltoall)
+_make_token_variant("sendrecv", _same_aval, _host_sendrecv)
+_make_token_variant("recv", _same_aval, _host_recv)
+_make_token_variant("send", _scalar_aval, _host_send)
+_make_token_variant("barrier", _scalar_aval, _host_barrier, n_data=0)
+_make_token_variant("allgather", _stacked_aval, _host_allgather)
+_make_token_variant("gather", _gather_aval, _host_gather)
+_make_token_variant("scatter", _unstacked_aval, _host_scatter)
+
 
 # ---------------- AD rules (reference parity) ----------------
 
 
-def _allreduce_jvp(primals, tangents, *, comm, op, transpose=False):
+def _allreduce_jvp(primals, tangents, *, comm, op, transpose=False,
+                   ordered=True):
     # reference: JVP defined for SUM only (allreduce.py:192-195 there)
     (x,), (t,) = primals, tangents
     if op.name != "SUM":
@@ -570,21 +761,24 @@ def _allreduce_jvp(primals, tangents, *, comm, op, transpose=False):
             f"world-tier allreduce is differentiable for SUM only, got "
             f"{op.name}"
         )
-    primal_out = allreduce_p.bind(x, comm=comm, op=op, transpose=transpose)
+    primal_out = allreduce_p.bind(x, comm=comm, op=op, transpose=transpose,
+                                  ordered=ordered)
     if type(t) is ad.Zero:
         tangent_out = ad.Zero.from_primal_value(primal_out)
     else:
         tangent_out = allreduce_p.bind(
-            t, comm=comm, op=op, transpose=transpose
+            t, comm=comm, op=op, transpose=transpose, ordered=ordered
         )
     return primal_out, tangent_out
 
 
-def _allreduce_transpose(ct, x, *, comm, op, transpose=False):
+def _allreduce_transpose(ct, x, *, comm, op, transpose=False,
+                         ordered=True):
     # flip the flag: transpose(allreduce) is the identity pass, and
     # transpose of that is allreduce again (reference allreduce.py:206-218)
     return (
-        allreduce_p.bind(ct, comm=comm, op=op, transpose=not transpose),
+        allreduce_p.bind(ct, comm=comm, op=op, transpose=not transpose,
+                         ordered=ordered),
     )
 
 
@@ -593,26 +787,26 @@ ad.primitive_transposes[allreduce_p] = _allreduce_transpose
 
 
 def _sendrecv_jvp(primals, tangents, *, comm, source, dest, sendtag,
-                  recvtag, status=None):
+                  recvtag, status=None, ordered=True):
     # improvement over the reference (which raises for fwd mode,
     # sendrecv.py:150-155): tangents ride the same message edge.  Only the
     # primal pass fills a Status — one receive, one record.
     (x,), (t,) = primals, tangents
     primal_out = sendrecv_p.bind(x, comm=comm, source=source, dest=dest,
                                  sendtag=sendtag, recvtag=recvtag,
-                                 status=status)
+                                 status=status, ordered=ordered)
     if type(t) is ad.Zero:
         tangent_out = ad.Zero.from_primal_value(primal_out)
     else:
         tangent_out = sendrecv_p.bind(
             t, comm=comm, source=source, dest=dest, sendtag=sendtag,
-            recvtag=recvtag, status=None,
+            recvtag=recvtag, status=None, ordered=ordered,
         )
     return primal_out, tangent_out
 
 
 def _sendrecv_transpose(ct, x, *, comm, source, dest, sendtag, recvtag,
-                        status=None):
+                        status=None, ordered=True):
     # the cotangent flows backward along the message edge: swap source/dest
     # (reference sendrecv.py:390-409).  Tags swap with the direction: the
     # forward edge matched because sendtag(sender) == recvtag(receiver),
@@ -628,7 +822,8 @@ def _sendrecv_transpose(ct, x, *, comm, source, dest, sendtag, recvtag,
         t_send, t_recv = recvtag, sendtag
     return (
         sendrecv_p.bind(ct, comm=comm, source=dest, dest=source,
-                        sendtag=t_send, recvtag=t_recv, status=None),
+                        sendtag=t_send, recvtag=t_recv, status=None,
+                        ordered=ordered),
     )
 
 
@@ -672,11 +867,11 @@ def _leading_axis_batching(p, out_bd):
 _stacking_batching(allgather_p)
 
 
-def _gather_batching(batched_args, batch_dims, *, comm, root):
+def _gather_batching(batched_args, batch_dims, *, comm, root, **params):
     # root output gains the stacking axis in front (batch axis shifts
     # right); non-root output is the input unchanged
     (x,), (bd,) = batched_args, batch_dims
-    out = gather_p.bind(x, comm=comm, root=root)
+    out = gather_p.bind(x, comm=comm, root=root, **params)
     return out, (bd + 1 if comm.rank() == root else bd)
 
 
@@ -705,9 +900,10 @@ def allreduce(x, op: ReduceOp, comm):
         # user-defined op: the wire protocol carries no user code, so
         # compose from allgather + a local jax fold (the analog of the
         # reference handing a user MPI_Op to libmpi, utils.py:133-152)
-        rows = allgather_p.bind(x, comm=comm)
+        rows = allgather_p.bind(x, comm=comm, ordered=_ordered_now())
         return op.reduce(rows).astype(x.dtype)
-    return allreduce_p.bind(x, comm=comm, op=op, transpose=False)
+    return allreduce_p.bind(x, comm=comm, op=op, transpose=False,
+                            ordered=_ordered_now())
 
 
 def reduce(x, op: ReduceOp, root, comm):
@@ -717,32 +913,37 @@ def reduce(x, op: ReduceOp, root, comm):
         # rank-dependent result (root reduces, others pass through) is
         # fine here: world programs are per-rank (reference
         # reduce.py:71-80 has the same contract)
-        rows = gather_p.bind(x, comm=comm, root=root)
+        rows = gather_p.bind(x, comm=comm, root=root,
+                             ordered=_ordered_now())
         if comm.rank() == root:
             return op.reduce(rows).astype(x.dtype)
         return rows
-    return reduce_p.bind(x, comm=comm, op=op, root=root)
+    return reduce_p.bind(x, comm=comm, op=op, root=root,
+                         ordered=_ordered_now())
 
 
 def scan(x, op: ReduceOp, comm):
     op.check_dtype(jnp.result_type(x))
     x = jnp.asarray(x)
     if op.custom:
-        rows = allgather_p.bind(x, comm=comm)
+        rows = allgather_p.bind(x, comm=comm, ordered=_ordered_now())
         return op.reduce(rows[: comm.rank() + 1]).astype(x.dtype)
-    return scan_p.bind(x, comm=comm, op=op)
+    return scan_p.bind(x, comm=comm, op=op, ordered=_ordered_now())
 
 
 def bcast(x, root, comm):
-    return bcast_p.bind(jnp.asarray(x), comm=comm, root=root)
+    return bcast_p.bind(jnp.asarray(x), comm=comm, root=root,
+                        ordered=_ordered_now())
 
 
 def allgather(x, comm):
-    return allgather_p.bind(jnp.asarray(x), comm=comm)
+    return allgather_p.bind(jnp.asarray(x), comm=comm,
+                            ordered=_ordered_now())
 
 
 def gather(x, root, comm):
-    return gather_p.bind(jnp.asarray(x), comm=comm, root=root)
+    return gather_p.bind(jnp.asarray(x), comm=comm, root=root,
+                         ordered=_ordered_now())
 
 
 def scatter(x, root, comm):
@@ -752,7 +953,7 @@ def scatter(x, root, comm):
             f"scatter requires input shape (size, ...) = ({comm.size()}, "
             f"...), got {x.shape}"
         )
-    return scatter_p.bind(x, comm=comm, root=root)
+    return scatter_p.bind(x, comm=comm, root=root, ordered=_ordered_now())
 
 
 def alltoall(x, comm):
@@ -762,19 +963,28 @@ def alltoall(x, comm):
             f"alltoall requires leading axis == communicator size "
             f"({comm.size()}), got shape {x.shape}"
         )
-    return alltoall_p.bind(x, comm=comm)
+    return alltoall_p.bind(x, comm=comm, ordered=_ordered_now())
 
 
 def barrier(comm, token):
+    if token is not None and not _ordered_now():
+        _, tok = _bind_token_variant("barrier", None, token, comm=comm)
+        return tok
     del token  # ordering comes from the ordered effect
-    return barrier_p.bind(comm=comm)
+    return barrier_p.bind(comm=comm, ordered=_ordered_now())
 
 
 def send(x, dest, tag, comm, token):
-    done = send_p.bind(jnp.asarray(x), comm=comm, dest=dest, tag=tag)
-    if token is not None:
-        from . import _dispatch
+    from . import _dispatch
 
+    if token is not None and not _ordered_now():
+        _, tok = _bind_token_variant("send", x, token, comm=comm,
+                                     dest=dest, tag=tag)
+        return tok
+    x = _dispatch.token_in(token, jnp.asarray(x))  # token ties the input
+    done = send_p.bind(jnp.asarray(x), comm=comm, dest=dest, tag=tag,
+                       ordered=_ordered_now())
+    if token is not None:
         return _dispatch.token_out(token, done)
     return None
 
@@ -784,12 +994,16 @@ def recv(x, source, tag, comm, token, status=None):
 
     if isinstance(status, Status):
         status = HashableStatus(status)
-    result = recv_p.bind(jnp.asarray(x), comm=comm, source=source, tag=tag,
-                         status=status)
-    if token is not None:
-        from . import _dispatch
+    from . import _dispatch as _disp
 
-        return result, _dispatch.token_out(token, result)
+    if token is not None and not _ordered_now():
+        return _bind_token_variant("recv", x, token, comm=comm,
+                                   source=source, tag=tag, status=status)
+    x = _disp.token_in(token, jnp.asarray(x))  # token ties the dummy input
+    result = recv_p.bind(jnp.asarray(x), comm=comm, source=source, tag=tag,
+                         status=status, ordered=_ordered_now())
+    if token is not None:
+        return result, _disp.token_out(token, result)
     return result
 
 
@@ -830,12 +1044,18 @@ def sendrecv_dispatch(x, *, perm, shift, wrap, comm, token,
         else:
             raise ValueError("pass source/dest, perm=, or shift=")
 
+    from . import _dispatch as _disp
+
+    if token is not None and not _ordered_now():
+        return _bind_token_variant(
+            "sendrecv", x, token, comm=comm, source=source, dest=dest,
+            sendtag=sendtag, recvtag=recvtag, status=status)
+    x = _disp.token_in(token, jnp.asarray(x))
     result = sendrecv_p.bind(
         jnp.asarray(x), comm=comm, source=source, dest=dest,
         sendtag=sendtag, recvtag=recvtag, status=status,
+        ordered=_ordered_now(),
     )
     if token is not None:
-        from . import _dispatch
-
-        return result, _dispatch.token_out(token, result)
+        return result, _disp.token_out(token, result)
     return result

@@ -22,4 +22,7 @@ def allgather(x, *, comm=None, token=None):
         from . import _world_impl
 
         body = lambda v: _world_impl.allgather(v, comm)
+        return _dispatch.maybe_tokenized(
+            body, x, token,
+            token_fn=_world_impl.token_variant_fn("allgather", comm=comm))
     return _dispatch.maybe_tokenized(body, x, token)

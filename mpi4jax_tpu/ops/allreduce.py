@@ -11,6 +11,8 @@ by the double-transpose tests.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from ..utils import dtypes as _dtypes, validation as _validation
 from . import _dispatch, _mesh_impl
 from .reduce_ops import SUM, as_reduce_op
@@ -56,4 +58,10 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
         from . import _world_impl
 
         body = lambda v: _world_impl.allreduce(v, op, comm)
+        if not op.custom:  # custom ops use the allgather composite
+            return _dispatch.maybe_tokenized(
+                body, x, token,
+                token_fn=_world_impl.token_variant_fn(
+                    "allreduce", comm=comm, op=op,
+                    validate=lambda v: op.check_dtype(jnp.result_type(v))))
     return _dispatch.maybe_tokenized(body, x, token)

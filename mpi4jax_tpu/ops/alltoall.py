@@ -27,4 +27,15 @@ def alltoall(x, *, comm=None, token=None):
         from . import _world_impl
 
         body = lambda v: _world_impl.alltoall(v, comm)
+        def _check_alltoall(v):
+            if v.ndim < 1 or v.shape[0] != comm.size():
+                raise ValueError(
+                    f"alltoall requires leading axis == communicator "
+                    f"size ({comm.size()}), got shape {v.shape}"
+                )
+
+        return _dispatch.maybe_tokenized(
+            body, x, token,
+            token_fn=_world_impl.token_variant_fn(
+                "alltoall", comm=comm, validate=_check_alltoall))
     return _dispatch.maybe_tokenized(body, x, token)

@@ -26,4 +26,8 @@ def bcast(x, root=0, *, comm=None, token=None):
 
         _validation.check_in_range("root", root, comm.size())
         body = lambda v: _world_impl.bcast(v, root, comm)
+        return _dispatch.maybe_tokenized(
+            body, x, token,
+            token_fn=_world_impl.token_variant_fn("bcast", comm=comm,
+                                                  root=root))
     return _dispatch.maybe_tokenized(body, x, token)

@@ -31,4 +31,8 @@ def gather(x, root=0, *, comm=None, token=None):
 
         _validation.check_in_range("root", root, comm.size())
         body = lambda v: _world_impl.gather(v, root, comm)
+        return _dispatch.maybe_tokenized(
+            body, x, token,
+            token_fn=_world_impl.token_variant_fn("gather", comm=comm,
+                                                  root=root))
     return _dispatch.maybe_tokenized(body, x, token)

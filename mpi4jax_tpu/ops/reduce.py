@@ -9,6 +9,8 @@ same collective a rooted reduce would use on ICI anyway.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from ..utils import validation as _validation
 from . import _dispatch, _mesh_impl
 from .reduce_ops import SUM, as_reduce_op
@@ -28,4 +30,10 @@ def reduce(x, op=SUM, root=0, *, comm=None, token=None):
 
         _validation.check_in_range("root", root, comm.size())
         body = lambda v: _world_impl.reduce(v, op, root, comm)
+        if not op.custom:  # custom ops use the gather+local-fold composite
+            return _dispatch.maybe_tokenized(
+                body, x, token,
+                token_fn=_world_impl.token_variant_fn(
+                    "reduce", comm=comm, op=op, root=root,
+                    validate=lambda v: op.check_dtype(jnp.result_type(v))))
     return _dispatch.maybe_tokenized(body, x, token)

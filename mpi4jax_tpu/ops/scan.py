@@ -8,6 +8,8 @@ host round-trips.
 
 from __future__ import annotations
 
+import jax.numpy as jnp
+
 from ..utils import validation as _validation
 from . import _dispatch, _mesh_impl
 from .reduce_ops import SUM, as_reduce_op
@@ -25,4 +27,10 @@ def scan(x, op=SUM, *, comm=None, token=None):
         from . import _world_impl
 
         body = lambda v: _world_impl.scan(v, op, comm)
+        if not op.custom:  # custom ops use the allgather composite
+            return _dispatch.maybe_tokenized(
+                body, x, token,
+                token_fn=_world_impl.token_variant_fn(
+                    "scan", comm=comm, op=op,
+                    validate=lambda v: op.check_dtype(jnp.result_type(v))))
     return _dispatch.maybe_tokenized(body, x, token)

@@ -54,7 +54,7 @@ def test_gate_healthy_claim(bench, monkeypatch):
 
         class R:
             returncode = 0
-            stdout = "claim-ok\n"
+            stdout = "claim-ok tpu\n"
             stderr = ""
 
         return R()
@@ -85,9 +85,10 @@ def test_gate_wedged_claim_bounded(bench, monkeypatch):
     assert not ok
     assert rec["metric"] == "device_claim_before_world_on_tpu"
     assert rec["value"] == 0 and "wedged" in rec["error"]
-    # at most two probes: one upfront, one final — no rapid-fire retries
-    # livelocking against the re-wedge window
+    # sparse probes (>= ~7 min apart): rapid-fire retries would livelock
+    # against the re-wedge window a killed probe re-arms
     assert len(probes) == 2, probes
+    assert all(b - a >= 300 for a, b in zip(probes, probes[1:])), probes
     # bounded: within the budget plus one final probe timeout
     assert ft.now - t0 <= 900 + 160
     # the watchdog deadline covered the whole wait
@@ -106,7 +107,7 @@ def test_gate_recovers_on_final_probe(bench, monkeypatch):
 
         class R:
             returncode = 0
-            stdout = "claim-ok\n"
+            stdout = "claim-ok tpu\n"
             stderr = ""
 
         return R()
@@ -115,3 +116,22 @@ def test_gate_recovers_on_final_probe(bench, monkeypatch):
     ok, rec = mod._wait_for_claim(_flag(), 900, "x")
     assert ok and rec is None
     assert state["n"] == 2
+
+
+def test_gate_rejects_cpu_fallback(bench, monkeypatch):
+    # a probe whose jax silently fell back to the cpu platform must NOT
+    # count as a healthy device claim (ADVICE r3 #2)
+    mod, ft = bench
+
+    def fake_run(cmd, **kw):
+        class R:
+            returncode = 0
+            stdout = "claim-ok cpu\n"
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(mod.subprocess, "run", fake_run)
+    ok, rec = mod._wait_for_claim(_flag(), 500, "x")
+    assert not ok
+    assert "wedged" in rec["error"]

@@ -17,7 +17,8 @@ PROGRAMS = os.path.join(REPO, "tests", "world_programs")
 _port = [44100]
 
 
-def run_launcher(program, np_, timeout=180, env_extra=None, extra_args=()):
+def run_launcher(program, np_, timeout=180, env_extra=None, extra_args=(),
+                 prog_args=(), prog_dir=None):
     _port[0] += np_ + 3  # unique ports per invocation
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # ranks don't need virtual devices
@@ -28,7 +29,7 @@ def run_launcher(program, np_, timeout=180, env_extra=None, extra_args=()):
         [
             sys.executable, "-m", "mpi4jax_tpu.runtime.launch",
             "-n", str(np_), "--port", str(_port[0]), *extra_args,
-            os.path.join(PROGRAMS, program),
+            os.path.join(prog_dir or PROGRAMS, program), *prog_args,
         ],
         capture_output=True, text=True, timeout=timeout, env=env, cwd=REPO,
     )
@@ -138,6 +139,36 @@ def test_vmap_ops():
 def test_ordering():
     res = run_launcher("ordering.py", 2)
     assert res.returncode == 0, res.stderr + res.stdout
+
+
+@pytest.mark.parametrize("np_,grid", [(1, (1, 1)), (2, (1, 2)),
+                                      (4, (2, 2))])
+def test_sw_world_matches_mesh_solver(np_, grid):
+    # the world-tier per-rank solver (explicit sendrecv halos over the
+    # native transport — the reference's mpirun shape) must reproduce
+    # the mesh-tier SPMD solver bit-for-nearly-bit; covers the
+    # self-wrap (np=1), two-rank-ring (gx=2 periodic), and
+    # distinct-neighbor schedules
+    res = run_launcher(
+        "sw_world_rank.py", np_, timeout=300,
+        prog_dir=os.path.join(REPO, "benchmarks"),
+        prog_args=("--grid", str(grid[0]), str(grid[1]),
+                   "--size", "64", "128", "--days", "0.02", "--check"),
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert "sw_world CHECK OK" in res.stdout
+
+
+def test_mesh_world_composition():
+    # tier composition: np=2 world ranks, each owning a 4-virtual-device
+    # mesh — mesh psum inside shard_map + world ops in the same jitted
+    # step, plus the asymmetric-chain torture (SURVEY §7 hard part 4)
+    res = run_launcher(
+        "mesh_world.py", 2, timeout=300,
+        env_extra={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("mesh_world OK") == 2
 
 
 def test_subcomm_ops():

@@ -1,0 +1,131 @@
+"""Tier composition: world ranks that each own a multi-device mesh.
+
+This is the actual TPU-pod shape — ICI collectives inside ``shard_map``
+within a process's device slice, world-tier (DCN/host) ops across
+processes — composed in ONE jitted step (SURVEY.md §7 hard part 4:
+"mixing ICI collectives with host MPI without deadlock").
+
+Run as np=2 world ranks with a 4-virtual-device CPU mesh per rank:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+    python -m mpi4jax_tpu.runtime.launch -n 2 tests/world_programs/mesh_world.py
+
+Composition contract (documented in DESIGN.md):
+
+- JAX refuses ORDERED effects in a multi-device computation, so these
+  programs trace inside ``mpi4jax_tpu.explicit_token_ordering()`` —
+  world ops bind with the unordered effect and ordering is carried by
+  EXPLICIT token chains (the reference's primary L1 token design,
+  docs/sharp-bits.rst there).  Every world op must be threaded.
+- mesh-tier collectives live inside ``shard_map`` regions and order
+  freely within the rank's local device slice;
+- world-tier ops sit OUTSIDE ``shard_map`` at the jit level, in the
+  token-chain order, identical on every rank.
+
+Phase 2 is the torture variant: an asymmetric send/recv chain
+interleaved with mesh collectives inside a scanned jit — a broken token
+chain deadlocks or corrupts the potato (the composition analog of the
+reference's hot-potato, test_notoken.py:81-120 there).
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+import mpi4jax_tpu as m4j  # noqa: E402
+from mpi4jax_tpu.compat import token_api as tk  # noqa: E402
+
+comm = m4j.get_default_comm()
+rank, size = comm.rank(), comm.size()
+assert size == 2, "this program composes np=2 world ranks"
+ndev = len(jax.devices())
+assert ndev >= 4, f"need 4 local devices per rank, have {ndev}"
+mesh = Mesh(np.array(jax.devices()[:4]), ("d",))
+
+
+def local_psum(v):
+    return jax.lax.psum(v, "d")
+
+
+def shard_psum(v):
+    return jax.shard_map(
+        local_psum, mesh=mesh, in_specs=P("d"), out_specs=P()
+    )(v)
+
+
+with m4j.explicit_token_ordering():
+    # -- phase 1: mesh psum + world allreduce in one jitted step ------
+    @jax.jit
+    def step(x):
+        y = shard_psum(x)
+        out, _ = tk.allreduce(y, op=m4j.SUM, comm=comm)
+        return out
+
+    x = jnp.arange(8.0) + rank
+    out = np.asarray(step(x))
+    # psum over 4 shards of 2: [0+2+4+6, 1+3+5+7] + 4*rank; world-sum
+    # over the 2 ranks adds both rank offsets: [24+4, 32+4]
+    np.testing.assert_allclose(out, np.array([28.0, 36.0]))
+
+    # -- phase 2: torture — asymmetric world chain x mesh work --------
+    K = 6
+
+    @jax.jit
+    def torture(x):
+        def body(carry, _):
+            token = tk.create_token(carry)
+            if rank == 0:
+                token = tk.send(carry, dest=1, tag=101, comm=comm,
+                                token=token)
+                got, token = tk.recv(jnp.zeros_like(carry), source=1,
+                                     tag=202, comm=comm, token=token)
+            else:
+                got, token = tk.recv(jnp.zeros_like(carry), source=0,
+                                     tag=101, comm=comm, token=token)
+                # local mesh work ON the potato between the two world ops
+                got = jnp.tile(shard_psum(got) / 4.0 + 1.0, 4)
+                token = tk.send(got, dest=0, tag=202, comm=comm,
+                                token=token)
+            return got, ()
+
+        out, _ = jax.lax.scan(body, x, None, length=K)
+        return out
+
+    t = np.asarray(torture(jnp.ones((8,), jnp.float32)))
+    # host replay: each round rank 1 averages the psum back down
+    ref = np.ones(8, np.float32)
+    for _ in range(K):
+        s = ref.reshape(4, 2).sum(axis=0) / 4.0 + 1.0
+        ref = np.tile(s, 4)
+    np.testing.assert_allclose(t, ref, rtol=1e-6)
+
+    # -- phase 3: world collective chain around mesh regions ----------
+    @jax.jit
+    def mixed(x):
+        a, token = tk.bcast(x, root=0, comm=comm)
+        b = shard_psum(a)
+        c, token = tk.allgather(b, comm=comm, token=token)
+        out, _ = tk.allreduce(jnp.sum(c, axis=0), op=m4j.MAX, comm=comm,
+                              token=token)
+        return out
+
+    xr = (jnp.arange(8.0) if rank == 0 else jnp.zeros(8))
+    got = np.asarray(mixed(xr))
+    base = np.arange(8.0).reshape(4, 2).sum(axis=0)  # [12, 16]
+    np.testing.assert_allclose(got, 2 * base)
+
+print(f"mesh_world OK r{rank}", flush=True)
