@@ -581,6 +581,12 @@ def bench_gpt2_step():
                                       1),
         "params_M": round(n_params / 1e6, 1),
         "loss_finite": bool(loss == loss),
+        # BASELINE.md names "PP GPT-2 124M via point-to-point"; pipeline
+        # parallelism needs >1 device, so on this single chip the battery
+        # measures the same model dense (dp=tp=sp=1) and the PP path
+        # (models/pp_transformer.py, ppermute handoffs) executes in
+        # dryrun_multichip section 2 on the virtual mesh every round
+        "pp_note": "PP path exercised in dryrun_multichip (1 chip here)",
     }
 
 

@@ -42,6 +42,7 @@ from .ops import (  # noqa: E402
     create_token,
     custom_op,
     gather,
+    neighbor_exchange,
     permute,
     recv,
     reduce,
@@ -101,6 +102,7 @@ __all__ = [
     "create_token",
     "gather",
     "permute",
+    "neighbor_exchange",
     "recv",
     "reduce",
     "scan",

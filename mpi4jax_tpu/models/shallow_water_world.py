@@ -14,8 +14,9 @@ only the parallel substrate is swapped:
 
 - rank coordinates are static Python ints (per-rank programs may
   branch on rank — the reference's model);
-- the halo exchange is world-tier ``sendrecv`` per direction (interior
-  edges) and plain wall handling at physical boundaries;
+- the halo exchange is one world-tier ``neighbor_exchange`` per
+  direction-dim (both strips in one deadlock-free op) with plain wall
+  handling at physical boundaries;
 - the initial-condition collectives (`scan` along columns, global
   `allreduce`) dispatch to the world tier through the SAME ``ops``
   calls the mesh tier uses — the model code is tier-agnostic through
@@ -34,6 +35,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import ops
+from ..ops import _world_impl
 from ..runtime.transport import WorldComm
 from .shallow_water import ShallowWater, SWParams, SWState
 
@@ -111,14 +113,16 @@ class WorldShallowWater(ShallowWater):
         existing ghost values (the boundary condition) — same contract
         as the mesh tier's ``halo_exchange``.
 
-        Tags encode the travel DIRECTION (northward 10+dim, southward
-        20+dim) so a rank's send to its high neighbor matches that
-        neighbor's low-side receive.  Degenerate ring sizes get their
-        own schedules: a self-wrap (periodic extent 1) fills ghosts
-        locally, and a 2-rank ring bundles both strips into ONE
-        symmetric sendrecv (two crossing sendrecvs to the same peer
-        would meet each other's tags out of order — the ordered
-        transport would fail fast).
+        Both directions ride ONE ``neighbor_exchange`` op (the
+        MPI_Neighbor_alltoall analog): a single blocking point per dim.
+        Two earlier schedules failed here and are worth remembering —
+        pairing both directions with the SAME neighbor per op deadlocks
+        on any periodic ring of >= 3 ranks (each rank's first receive
+        matches its neighbor's SECOND send: a cycle ordered per-rank
+        execution cannot resolve — found as a silent np=6 hang), and
+        two sequential uniform shifts are correct but cost an extra
+        blocking wait per dim, i.e. a scheduler quantum per step on
+        core-sharing hosts (np=2 regressed 141 s -> 202 s).
         """
         me = self.iy * self.gx + self.ix
         extent = stack.shape[dim + 1]
@@ -126,35 +130,26 @@ class WorldShallowWater(ShallowWater):
         hi_int = jax.lax.slice_in_dim(stack, extent - 2, extent - 1,
                                       axis=dim + 1)
         from_above = from_below = None
+        if hi_neighbor is None and lo_neighbor is None:
+            return stack  # both walls (e.g. y on a (1, N) grid): no comm
         if hi_neighbor == me and lo_neighbor == me:
             # self-wrap: the high ghost wraps around to the LOW interior
             # strip and vice versa (mesh tier's n==1 periodic case)
             from_above, from_below = lo_int, hi_int
-        elif hi_neighbor is not None and hi_neighbor == lo_neighbor:
-            # 2-rank ring: both directions are one peer — one message
-            both = jnp.concatenate([lo_int, hi_int], axis=dim + 1)
-            got = ops.sendrecv(
-                both, source=hi_neighbor, dest=hi_neighbor,
-                sendtag=30 + dim, recvtag=30 + dim, comm=self.comm,
-            )
-            from_above = jax.lax.slice_in_dim(got, 0, 1, axis=dim + 1)
-            from_below = jax.lax.slice_in_dim(got, 1, 2, axis=dim + 1)
         else:
-            # exchange with the high-side neighbor: my high-interior
-            # travels northward; its low-interior arrives southward.
-            # One tag per grid dim suffices: with distinct neighbors the
-            # two directions ride different sockets (and equal
-            # send/recv tags keep the native FFI sendrecv fast path).
-            if hi_neighbor is not None:
-                from_above = ops.sendrecv(
-                    hi_int, source=hi_neighbor, dest=hi_neighbor,
-                    sendtag=40 + dim, recvtag=40 + dim, comm=self.comm,
-                )
-            if lo_neighbor is not None:
-                from_below = ops.sendrecv(
-                    lo_int, source=lo_neighbor, dest=lo_neighbor,
-                    sendtag=40 + dim, recvtag=40 + dim, comm=self.comm,
-                )
+            # one op for both directions: a single blocking point per
+            # dim — on core-sharing hosts every extra blocking wait
+            # costs a scheduler quantum, which dominated the two-shift
+            # schedule (and any per-neighbor pairing of both directions
+            # deadlocks on rings >= 3; see neighbor_exchange)
+            from_below, from_above = _world_impl.neighbor_exchange(
+                lo_int, hi_int, lo=lo_neighbor, hi=hi_neighbor,
+                comm=self.comm, tag=60 + 2 * dim,
+            )
+            if lo_neighbor is None:
+                from_below = None  # wall: keep existing ghost values
+            if hi_neighbor is None:
+                from_above = None
         if from_above is not None:
             start = [0] * stack.ndim
             start[dim + 1] = extent - 1

@@ -25,6 +25,7 @@ from .reduce_ops import (
 from .scan import scan
 from .scatter import scatter
 from .send import send
+from .neighbor import neighbor_exchange
 from .sendrecv import permute, sendrecv
 from ._dispatch import create_token
 
@@ -37,6 +38,7 @@ __all__ = [
     "create_token",
     "gather",
     "permute",
+    "neighbor_exchange",
     "recv",
     "reduce",
     "scan",

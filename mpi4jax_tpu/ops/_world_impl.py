@@ -419,16 +419,37 @@ def _bind_token_variant(name, x, token, **params):
     return out, tok2
 
 
-def token_variant_fn(name, validate=None, **params):
+def token_variant_fn(name, **params):
     """A ``token_fn`` for :func:`.._dispatch.maybe_tokenized`: routes the
     op through its token-operand variant in explicit-token mode.
-    ``validate(x)`` runs first — this route bypasses the value-path
-    entry functions, so their checks must be supplied here."""
+    Validation happens in the ops-layer entry before dispatch (both
+    routes share it)."""
 
     def fn(x, token):
-        if validate is not None:
-            validate(x)
         return _bind_token_variant(name, x, token, **params)
+
+    return fn
+
+
+def custom_fold_token_fn(op, comm, root=None, prefix=False):
+    """Token-chained composite for user-defined reduction operators:
+    the wire carries no user code, so the data moves via the token-
+    operand allgather/gather and the fold runs locally — the same
+    composite as the value path, but with the token riding the
+    communication op so explicit-token mode keeps its ordering."""
+
+    def fn(x, token):
+        x = jnp.asarray(x)
+        if root is not None:
+            rows, tok = _bind_token_variant("gather", x, token, comm=comm,
+                                            root=root)
+            if comm.rank() == root:
+                return op.reduce(rows).astype(x.dtype), tok
+            return rows, tok
+        rows, tok = _bind_token_variant("allgather", x, token, comm=comm)
+        if prefix:
+            return op.reduce(rows[: comm.rank() + 1]).astype(x.dtype), tok
+        return op.reduce(rows).astype(x.dtype), tok
 
     return fn
 
@@ -508,6 +529,13 @@ def _host_alltoall(x, *, comm):
 
     with tracing.CallTrace(comm.rank(), "Alltoall", ""):
         return bridge.alltoall(comm.handle, x)
+
+
+def _host_shift2(x, *, comm, lo, hi, tag):
+    from ..runtime import bridge
+
+    with tracing.CallTrace(comm.rank(), "Shift2", f"lo {lo} hi {hi}"):
+        return bridge.shift2(comm.handle, x, lo, hi, tag)
 
 
 def _host_barrier(*, comm):
@@ -676,11 +704,17 @@ def _unstacked_aval(x_aval, *, comm, **params):
     return core.ShapedArray(x_aval.shape[1:], x_aval.dtype)
 
 
+# one-op bidirectional neighbor exchange (MPI_Neighbor_alltoall on a
+# 1-D ring segment): the halo-exchange hot path — a single blocking
+# point per direction-dim instead of two sequential sendrecvs (each
+# blocking wait costs a scheduler quantum when ranks share cores)
+shift2_p = _make_primitive("shift2", _same_aval, _host_shift2)
 allgather_p = _make_primitive("allgather", _stacked_aval, _host_allgather)
 gather_p = _make_primitive("gather", _gather_aval, _host_gather)
 scatter_p = _make_primitive("scatter", _unstacked_aval, _host_scatter)
 
 for _p, _target, _alias in (
+    (shift2_p, "tpucomm_shift2", False),  # send half reads while recv writes
     (reduce_p, "tpucomm_reduce", True),
     (scan_p, "tpucomm_scan", True),
     (bcast_p, "tpucomm_bcast", True),
@@ -735,6 +769,7 @@ mlir.register_lowering(recv_p, _recv_ffi_lowering, platform="cpu")
 mlir.register_lowering(sendrecv_p, _sendrecv_ffi_lowering, platform="cpu")
 
 # token-operand variants for every op (explicit-token mode wire format)
+_make_token_variant("shift2", _same_aval, _host_shift2)
 _make_token_variant("allreduce", _same_aval, _host_allreduce)
 _make_token_variant("reduce", _same_aval, _host_reduce)
 _make_token_variant("scan", _same_aval, _host_scan)
@@ -964,6 +999,33 @@ def alltoall(x, comm):
             f"({comm.size()}), got shape {x.shape}"
         )
     return alltoall_p.bind(x, comm=comm, ordered=_ordered_now())
+
+
+def neighbor_exchange(to_lo, to_hi, *, lo, hi, comm, tag=60, token=None):
+    """(from_lo, from_hi) strips from the 1-D ring neighbors, one op.
+
+    ``lo``/``hi`` are neighbor ranks or None for a wall (the returned
+    strip on a wall side is the opposite input, passthrough — callers
+    treating walls specially just ignore it).  Self-wrap (both
+    neighbors == own rank) is a local rotation.  Deadlock-free for any
+    chain/ring when every member calls at the same program position —
+    the one-op replacement for the two-shift halo schedule.
+    """
+    lo_i = -1 if lo is None else int(lo)
+    hi_i = -1 if hi is None else int(hi)
+    x = jnp.stack([jnp.asarray(to_lo), jnp.asarray(to_hi)])
+    if token is not None and not _ordered_now():
+        out, tok = _bind_token_variant("shift2", x, token, comm=comm,
+                                       lo=lo_i, hi=hi_i, tag=int(tag))
+        return (out[0], out[1]), tok
+    from . import _dispatch as _disp
+
+    x = _disp.token_in(token, x)
+    out = shift2_p.bind(x, comm=comm, lo=lo_i, hi=hi_i, tag=int(tag),
+                        ordered=_ordered_now())
+    if token is not None:
+        return (out[0], out[1]), _disp.token_out(token, out)
+    return out[0], out[1]
 
 
 def barrier(comm, token):

@@ -27,15 +27,12 @@ def alltoall(x, *, comm=None, token=None):
         from . import _world_impl
 
         body = lambda v: _world_impl.alltoall(v, comm)
-        def _check_alltoall(v):
-            if v.ndim < 1 or v.shape[0] != comm.size():
-                raise ValueError(
-                    f"alltoall requires leading axis == communicator "
-                    f"size ({comm.size()}), got shape {v.shape}"
-                )
-
+        if x.ndim < 1 or x.shape[0] != comm.size():
+            raise ValueError(
+                f"alltoall requires leading axis == communicator size "
+                f"({comm.size()}), got shape {x.shape}"
+            )
         return _dispatch.maybe_tokenized(
             body, x, token,
-            token_fn=_world_impl.token_variant_fn(
-                "alltoall", comm=comm, validate=_check_alltoall))
+            token_fn=_world_impl.token_variant_fn("alltoall", comm=comm))
     return _dispatch.maybe_tokenized(body, x, token)

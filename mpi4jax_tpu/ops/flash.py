@@ -91,7 +91,9 @@ def _scores(q_ref, k_ref, q_start, k_start, scale, causal, block_q, block_k):
     # feed the MXU in the input dtype (bf16 x bf16 -> f32 runs at full
     # rate; upcasting first would force multi-pass f32 matmuls)
     s = lax.dot_general(q_ref[...], k_ref[...], _TRANS_B,
-                        preferred_element_type=jnp.float32) * scale
+                        preferred_element_type=jnp.float32)
+    if scale != 1.0:  # the public entry pre-scales q, making this a no-op
+        s = s * scale
     if causal:
         rows = q_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         cols = k_start + lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -208,7 +210,9 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
         p = jnp.exp(s - lse_ref[...])                        # (BQ, BK)
         dp = lax.dot_general(do_ref[...], v_ref[...], _TRANS_B,
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt_ref[...]) * scale
+        ds = p * (dp - dlt_ref[...])
+        if scale != 1.0:
+            ds = ds * scale
         dq_acc[...] += lax.dot(ds.astype(k_ref.dtype), k_ref[...],
                                preferred_element_type=jnp.float32)
 
@@ -242,7 +246,9 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dlt_ref,
                                        preferred_element_type=jnp.float32)
         dp = lax.dot_general(do_ref[...], v_ref[...], _TRANS_B,
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - dlt_ref[...]) * scale
+        ds = p * (dp - dlt_ref[...])
+        if scale != 1.0:
+            ds = ds * scale
         dk_acc[...] += lax.dot_general(ds.astype(q_ref.dtype), q_ref[...],
                                        _TRANS_A,
                                        preferred_element_type=jnp.float32)
@@ -424,5 +430,13 @@ def ring_flash_attention(q, k, v, *, axis, causal=False, scale=None,
     bk = pick_block(t, block_k)
     if interpret is None:
         interpret = _interpret_default()
-    return _ring_flash(q, k, v, axis, bool(causal), float(scale),
+    # pre-scale q OUTSIDE the kernels: the per-score-block `s * scale`
+    # was a full (block_q, block_k) VPU multiply per k block on a
+    # VPU-bound forward — folding it into q costs one (T, D) multiply
+    # total, and the custom_vjp boundary sees the scaled q so the
+    # dq = scale * dq' chain is handled by plain autodiff outside
+    scale = float(scale)
+    if scale != 1.0:
+        q = (q * jnp.asarray(scale, q.dtype)).astype(q.dtype)
+    return _ring_flash(q, k, v, axis, bool(causal), 1.0,
                        bq, bk, bool(interpret))

@@ -1,0 +1,61 @@
+"""neighbor_exchange — bidirectional 1-D ring exchange in one op.
+
+The MPI_Neighbor_alltoall analog on a ring segment, and the halo-
+exchange hot path of the world tier: both direction strips move in a
+single deadlock-free operation (async sends posted before either
+receive).  No reference counterpart — its shallow-water demo issues up
+to four token-ordered sendrecv/send/recv calls per boundary pass
+(/root/reference/examples/shallow_water.py:173-271); this op is the
+superset primitive those four calls become.
+
+World tier only: the mesh tier's halo path is
+:func:`mpi4jax_tpu.parallel.halo.halo_exchange` (batched
+``lax.ppermute`` over ICI), which already moves both directions of all
+fields per axis in compiler-scheduled collectives.
+"""
+
+from __future__ import annotations
+
+from ..utils import validation as _validation
+from . import _dispatch
+
+
+def neighbor_exchange(to_lo, to_hi, *, lo, hi, comm=None, tag=60,
+                      token=None):
+    """Exchange strips with the two 1-D ring neighbors, one op.
+
+    Args:
+        to_lo / to_hi: same-shape strips sent to the low / high
+            neighbor.
+        lo / hi: neighbor ranks, or ``None`` for a wall
+            (``MPI_PROC_NULL`` style: nothing is sent or received on
+            that side; the returned strip there is the opposite input,
+            passthrough — ignore it).
+        tag: base message tag (the high-direction frames use ``tag+1``).
+        token: optional explicit ordering token; with a token the
+            return is ``((from_lo, from_hi), token)``.
+
+    Returns:
+        ``(from_lo, from_hi)``: the strip received from the low / high
+        neighbor.  Self-wrap (both neighbors == own rank, a periodic
+        ring of one) is a local rotation.  Deadlock-free for any
+        chain/ring when every member calls at the same program
+        position.
+    """
+    to_lo = _validation.check_array("to_lo", to_lo)
+    to_hi = _validation.check_array("to_hi", to_hi)
+    comm = _dispatch.resolve_comm(comm)
+    if _dispatch.is_mesh(comm):
+        raise NotImplementedError(
+            "neighbor_exchange is a world-tier op; on the mesh tier use "
+            "mpi4jax_tpu.parallel.halo.halo_exchange (batched ppermute "
+            "over ICI) or sendrecv(shift=±1)"
+        )
+    from . import _world_impl
+
+    for name, r in (("lo", lo), ("hi", hi)):
+        if r is not None:
+            _validation.check_in_range(name, int(r), comm.size())
+    return _world_impl.neighbor_exchange(
+        to_lo, to_hi, lo=lo, hi=hi, comm=comm, tag=tag, token=token
+    )

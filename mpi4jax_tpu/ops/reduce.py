@@ -29,11 +29,15 @@ def reduce(x, op=SUM, root=0, *, comm=None, token=None):
         from . import _world_impl
 
         _validation.check_in_range("root", root, comm.size())
+        op.check_dtype(jnp.result_type(x))
         body = lambda v: _world_impl.reduce(v, op, root, comm)
-        if not op.custom:  # custom ops use the gather+local-fold composite
+        if op.custom:  # gather + local fold at root, token-chained
             return _dispatch.maybe_tokenized(
                 body, x, token,
-                token_fn=_world_impl.token_variant_fn(
-                    "reduce", comm=comm, op=op, root=root,
-                    validate=lambda v: op.check_dtype(jnp.result_type(v))))
+                token_fn=_world_impl.custom_fold_token_fn(op, comm,
+                                                          root=root))
+        return _dispatch.maybe_tokenized(
+            body, x, token,
+            token_fn=_world_impl.token_variant_fn(
+                "reduce", comm=comm, op=op, root=root))
     return _dispatch.maybe_tokenized(body, x, token)

@@ -26,11 +26,15 @@ def scan(x, op=SUM, *, comm=None, token=None):
     else:
         from . import _world_impl
 
+        op.check_dtype(jnp.result_type(x))
         body = lambda v: _world_impl.scan(v, op, comm)
-        if not op.custom:  # custom ops use the allgather composite
+        if op.custom:  # allgather + local prefix fold, token-chained
             return _dispatch.maybe_tokenized(
                 body, x, token,
-                token_fn=_world_impl.token_variant_fn(
-                    "scan", comm=comm, op=op,
-                    validate=lambda v: op.check_dtype(jnp.result_type(v))))
+                token_fn=_world_impl.custom_fold_token_fn(op, comm,
+                                                          prefix=True))
+        return _dispatch.maybe_tokenized(
+            body, x, token,
+            token_fn=_world_impl.token_variant_fn("scan", comm=comm,
+                                                  op=op))
     return _dispatch.maybe_tokenized(body, x, token)

@@ -27,16 +27,13 @@ def scatter(x, root=0, *, comm=None, token=None):
 
         _validation.check_in_range("root", root, comm.size())
         body = lambda v: _world_impl.scatter(v, root, comm)
-        def _check_scatter(v):
-            if v.ndim < 1 or v.shape[0] != comm.size():
-                raise ValueError(
-                    f"scatter requires input shape (size, ...) = "
-                    f"({comm.size()}, ...), got {v.shape}"
-                )
-
+        if x.ndim < 1 or x.shape[0] != comm.size():
+            raise ValueError(
+                f"scatter requires input shape (size, ...) = "
+                f"({comm.size()}, ...), got {x.shape}"
+            )
         return _dispatch.maybe_tokenized(
             body, x, token,
-            token_fn=_world_impl.token_variant_fn(
-                "scatter", comm=comm, root=root,
-                validate=_check_scatter))
+            token_fn=_world_impl.token_variant_fn("scatter", comm=comm,
+                                                  root=root))
     return _dispatch.maybe_tokenized(body, x, token)

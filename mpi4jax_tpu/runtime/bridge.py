@@ -149,6 +149,7 @@ def set_native_logging(enabled: bool) -> None:
 # primitives lower straight to these — no Python in the dispatch path.
 
 _FFI_TARGETS = {
+    "tpucomm_shift2": "TpucommShift2Ffi",
     "tpucomm_allreduce": "TpucommAllreduceFfi",
     "tpucomm_reduce": "TpucommReduceFfi",
     "tpucomm_scan": "TpucommScanFfi",
@@ -328,6 +329,19 @@ def sendrecv(handle, sendbuf, recv_shape, recv_dtype, source, dest, tag):
         _ptr(out), _i64(out.nbytes), source, tag,
     )
     _check("Sendrecv", rc)
+    return out
+
+
+def shift2(handle, buf, lo: int, hi: int, tag: int) -> np.ndarray:
+    """Bidirectional neighbor exchange: ``buf`` is the (2, ...) stack
+    [to_lo, to_hi]; returns [from_lo, from_hi] (walls = passthrough)."""
+    buf = _contig(buf)
+    out = np.empty_like(buf)
+    rc = get_lib().tpucomm_shift2(
+        _i64(handle), _ptr(buf), _ptr(out), _i64(buf.nbytes // 2),
+        int(lo), int(hi), int(tag),
+    )
+    _check("Shift2", rc)
     return out
 
 

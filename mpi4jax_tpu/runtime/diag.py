@@ -105,8 +105,19 @@ def check_device_claim():
     if rc is None:
         return False, ("claim HUNG (wedged by a dead claimer? wait "
                        "~15-40 min; see docs/developers.md)")
-    ok = rc == 0 and "claim-ok" in out
-    return ok, out.strip().splitlines()[-1] if out.strip() else "no output"
+    # require an explicit non-cpu platform: when the accelerator plugin
+    # fails fast, jax silently falls back to cpu and a bare "claim-ok"
+    # would report the wedged device healthy (ADVICE r3 #2)
+    platform = ""
+    for line in out.splitlines():
+        parts = line.split()
+        if parts[:1] == ["claim-ok"] and len(parts) == 2:
+            platform = parts[1]
+    ok = rc == 0 and bool(platform) and platform != "cpu"
+    detail = out.strip().splitlines()[-1] if out.strip() else "no output"
+    if rc == 0 and platform == "cpu":
+        detail = "claim fell back to cpu (accelerator plugin failed?)"
+    return ok, detail
 
 
 def check_device_compile():

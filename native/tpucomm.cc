@@ -1516,6 +1516,67 @@ int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
   return wait_send(c, &job) || recv_rc;
 }
 
+int tpucomm_shift2(int64_t h, const void* sendbuf, void* recvbuf,
+                   int64_t strip_nbytes, int lo, int hi, int tag) {
+  /* Bidirectional 1-D neighbor exchange in ONE op (the
+   * MPI_Neighbor_alltoall analog on a ring segment): sendbuf holds
+   * [to_lo | to_hi] strips, recvbuf receives [from_lo | from_hi].
+   * Both sends go out asynchronously before either receive, so any
+   * topology (chain, ring of any length, ring of 2, self-wrap) is
+   * deadlock-free within the op when every member calls it at the same
+   * program position.  A -1 neighbor is a wall (MPI_PROC_NULL): that
+   * side's output strip is the corresponding input passthrough.
+   * Frames to the LOW side use `tag`, to the HIGH side `tag+1` —
+   * unambiguous even when both neighbors are one peer (ring of 2). */
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  std::lock_guard<std::mutex> lock(comm_mu(c));
+  LogScope log(c->rank, "Shift2",
+               [&] { return std::to_string(strip_nbytes) + " bytes, lo " +
+                            std::to_string(lo) + " hi " +
+                            std::to_string(hi); });
+  const char* in = static_cast<const char*>(sendbuf);
+  char* out = static_cast<char*>(recvbuf);
+  const char* to_lo = in;
+  const char* to_hi = in + strip_nbytes;
+  char* from_lo = out;
+  char* from_hi = out + strip_nbytes;
+  if (lo == c->rank && hi == c->rank) {
+    /* self-wrap: my to_hi strip wraps to my low side and vice versa */
+    std::memcpy(from_lo, to_hi, strip_nbytes);
+    std::memcpy(from_hi, to_lo, strip_nbytes);
+    return 0;
+  }
+  SendJob jlo, jhi;
+  bool sent_lo = false, sent_hi = false;
+  if (lo >= 0) {
+    if (async_send(c, &jlo, lo, tag, to_lo, strip_nbytes)) return 1;
+    sent_lo = true;
+  } else {
+    std::memcpy(from_lo, to_hi, strip_nbytes);  // wall: passthrough
+  }
+  if (hi >= 0) {
+    if (async_send(c, &jhi, hi, tag + 1, to_hi, strip_nbytes)) {
+      // the first send may already be queued: it must complete before
+      // jlo (stack) and the caller's buffer go away
+      if (sent_lo) wait_send(c, &jlo);
+      return 1;
+    }
+    sent_hi = true;
+  } else {
+    std::memcpy(from_hi, to_lo, strip_nbytes);
+  }
+  int rc = 0;
+  /* from_hi carries the hi neighbor's to-LO frame (tag), from_lo the lo
+   * neighbor's to-HI frame (tag+1).  A mixed self/other neighbor pair
+   * cannot arise on a 1-D ring (self-wrap means size 1 = both). */
+  if (hi >= 0) rc |= recv_msg(c, hi, tag, from_hi, strip_nbytes);
+  if (lo >= 0) rc |= recv_msg(c, lo, tag + 1, from_lo, strip_nbytes);
+  if (sent_lo) rc |= wait_send(c, &jlo);
+  if (sent_hi) rc |= wait_send(c, &jhi);
+  return rc;
+}
+
 int tpucomm_barrier(int64_t h) {
   Comm* c = get_comm(h);
   if (!c) return 1;

@@ -75,6 +75,13 @@ int tpucomm_sendrecv_status(int64_t h, const void* sendbuf,
                             int64_t recv_nbytes, int source, int sendtag,
                             int recvtag, int32_t* out_src, int32_t* out_tag,
                             int64_t* out_count);
+/* Bidirectional 1-D neighbor exchange in one op (MPI_Neighbor_alltoall
+ * analog on a ring segment): sendbuf = [to_lo|to_hi] strips of
+ * strip_nbytes each, recvbuf = [from_lo|from_hi]; -1 neighbor = wall
+ * (output strip is the input passthrough).  Deadlock-free for any ring
+ * when all members call at the same program position. */
+int tpucomm_shift2(int64_t h, const void* sendbuf, void* recvbuf,
+                   int64_t strip_nbytes, int lo, int hi, int tag);
 int tpucomm_barrier(int64_t h);
 int tpucomm_bcast(int64_t h, void* buf, int64_t nbytes, int root);
 int tpucomm_gather(int64_t h, const void* sendbuf, int64_t nbytes,

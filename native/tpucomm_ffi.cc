@@ -199,6 +199,18 @@ ffi::Error RecvImpl(ffi::Token, ffi::AnyBuffer /* shape carrier */,
   return ffi::Error::Success();
 }
 
+ffi::Error Shift2Impl(ffi::Token, ffi::AnyBuffer x,
+                      ffi::Result<ffi::Token>,
+                      ffi::Result<ffi::AnyBuffer> out,
+                      int64_t comm, int32_t lo, int32_t hi, int32_t tag) {
+  /* x/out: (2, ...) stacked strips — [to_lo|to_hi] in, [from_lo|from_hi]
+   * out; see tpucomm_shift2 */
+  check_abort("Shift2",
+              tpucomm_shift2(comm, x.untyped_data(), out->untyped_data(),
+                             (int64_t)x.size_bytes() / 2, lo, hi, tag));
+  return ffi::Error::Success();
+}
+
 ffi::Error SendrecvImpl(ffi::Token, ffi::AnyBuffer x,
                         ffi::Result<ffi::Token>,
                         ffi::Result<ffi::AnyBuffer> out,
@@ -286,6 +298,13 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
     TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
         .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
         .Attr<int64_t>("comm").Attr<int32_t>("source").Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommShift2Ffi, Shift2Impl,
+    TPUCOMM_BIND().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm").Attr<int32_t>("lo").Attr<int32_t>("hi")
+        .Attr<int32_t>("tag"));
 
 XLA_FFI_DEFINE_HANDLER_SYMBOL(
     TpucommSendrecvFfi, SendrecvImpl,

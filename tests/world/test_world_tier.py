@@ -42,6 +42,25 @@ def test_basic_ops(np_):
     assert res.stdout.count("basic_ops OK") == np_
 
 
+def test_neighbor_exchange():
+    # one-op bidirectional ring/chain exchange at np=3 — the smallest
+    # ring where pairwise bidirectional scheduling deadlocks
+    res = run_launcher("neighbor_ops.py", 3)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("neighbor_ops OK") == 3
+
+
+def test_shm_chunked_pieces():
+    # 1 MB slots against 4-6 MB payloads: every collective exercises its
+    # multi-piece loop (incl. scatter/alltoall divided-slot budgets)
+    res = run_launcher(
+        "shm_chunked.py", 2, timeout=300,
+        env_extra={"MPI4JAX_TPU_SHM_MB": "1"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("shm_chunked OK") == 2
+
+
 def test_shm_disabled_tcp_path():
     # collectives fall back to the framed TCP schedules under the shm
     # kill switch — numerics must be identical (CI axis for the arena)
@@ -141,19 +160,23 @@ def test_ordering():
     assert res.returncode == 0, res.stderr + res.stdout
 
 
-@pytest.mark.parametrize("np_,grid", [(1, (1, 1)), (2, (1, 2)),
-                                      (4, (2, 2))])
-def test_sw_world_matches_mesh_solver(np_, grid):
+@pytest.mark.parametrize("np_,grid,size", [
+    (1, (1, 1), (64, 128)), (2, (1, 2), (64, 128)),
+    (4, (2, 2), (64, 128)), (6, (2, 3), (66, 126)),
+])
+def test_sw_world_matches_mesh_solver(np_, grid, size):
     # the world-tier per-rank solver (explicit sendrecv halos over the
     # native transport — the reference's mpirun shape) must reproduce
     # the mesh-tier SPMD solver bit-for-nearly-bit; covers the
-    # self-wrap (np=1), two-rank-ring (gx=2 periodic), and
-    # distinct-neighbor schedules
+    # self-wrap (np=1), two-rank-ring (gx=2 periodic), and the >= 3
+    # periodic ring whose naive pairwise schedule deadlocked (the
+    # uniform-shift fix)
     res = run_launcher(
         "sw_world_rank.py", np_, timeout=300,
         prog_dir=os.path.join(REPO, "benchmarks"),
         prog_args=("--grid", str(grid[0]), str(grid[1]),
-                   "--size", "64", "128", "--days", "0.02", "--check"),
+                   "--size", str(size[0]), str(size[1]),
+                   "--days", "0.02", "--check"),
     )
     assert res.returncode == 0, res.stderr + res.stdout
     assert "sw_world CHECK OK" in res.stdout
