@@ -128,15 +128,28 @@ def world_tier_rank(max_bytes, sizes=None):
             jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / (calls * K)
 
-        # transport-level latency (native call on a numpy buffer, no JAX
-        # in the loop, reused output buffer) — isolates the wire/arena
-        # cost
+        # transport-level latency: the native call with every argument
+        # pre-marshalled — no JAX, no numpy wrapper work in the loop —
+        # isolates the wire/arena cost itself
+        import ctypes
+
         a = np.ones(size // 4, np.float32)
         o = np.empty_like(a)
+        lib = bridge.get_lib()
+        fn_native = lib.tpucomm_allreduce
+        args_native = (
+            ctypes.c_int64(comm.handle),
+            a.ctypes.data_as(ctypes.c_void_p),
+            o.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int64(a.size), 11, 0,  # f32 wire code, SUM
+        )
+        rc = fn_native(*args_native)  # align ranks on the same op count
         t0 = time.perf_counter()
         for _ in range(calls * K):
-            bridge.allreduce(comm.handle, a, 0, out=o)
+            rc |= fn_native(*args_native)
         raw_dt = (time.perf_counter() - t0) / (calls * K)
+        if rc != 0:
+            raise RuntimeError(f"native allreduce failed (rc={rc})")
 
         if comm.rank() == 0:
             print(json.dumps({

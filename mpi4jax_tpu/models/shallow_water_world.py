@@ -35,7 +35,6 @@ import jax
 import jax.numpy as jnp
 
 from .. import ops
-from ..ops import _world_impl
 from ..runtime.transport import WorldComm
 from .shallow_water import ShallowWater, SWParams, SWState
 
@@ -142,7 +141,7 @@ class WorldShallowWater(ShallowWater):
             # costs a scheduler quantum, which dominated the two-shift
             # schedule (and any per-neighbor pairing of both directions
             # deadlocks on rings >= 3; see neighbor_exchange)
-            from_below, from_above = _world_impl.neighbor_exchange(
+            from_below, from_above = ops.neighbor_exchange(
                 lo_int, hi_int, lo=lo_neighbor, hi=hi_neighbor,
                 comm=self.comm, tag=60 + 2 * dim,
             )

@@ -929,8 +929,7 @@ batching.primitive_batchers[send_p] = _send_batching
 
 
 def allreduce(x, op: ReduceOp, comm):
-    op.check_dtype(jnp.result_type(x))
-    x = jnp.asarray(x)
+    x = jnp.asarray(x)  # dtype validated at the ops-layer entry
     if op.custom:
         # user-defined op: the wire protocol carries no user code, so
         # compose from allgather + a local jax fold (the analog of the
@@ -942,8 +941,7 @@ def allreduce(x, op: ReduceOp, comm):
 
 
 def reduce(x, op: ReduceOp, root, comm):
-    op.check_dtype(jnp.result_type(x))
-    x = jnp.asarray(x)
+    x = jnp.asarray(x)  # dtype validated at the ops-layer entry
     if op.custom:
         # rank-dependent result (root reduces, others pass through) is
         # fine here: world programs are per-rank (reference
@@ -958,8 +956,7 @@ def reduce(x, op: ReduceOp, root, comm):
 
 
 def scan(x, op: ReduceOp, comm):
-    op.check_dtype(jnp.result_type(x))
-    x = jnp.asarray(x)
+    x = jnp.asarray(x)  # dtype validated at the ops-layer entry
     if op.custom:
         rows = allgather_p.bind(x, comm=comm, ordered=_ordered_now())
         return op.reduce(rows[: comm.rank() + 1]).astype(x.dtype)

@@ -54,6 +54,12 @@ def check_native_build():
 
 def check_ffi():
     """XLA FFI handlers are exported (cpu fast path)."""
+    from ..utils import config
+
+    if config.ffi_disabled():
+        # a deliberate kill switch is a configuration, not a failure —
+        # report healthy with the reason (the callback path serves)
+        return True, "disabled by MPI4JAX_TPU_DISABLE_FFI (callback path)"
     from . import bridge
 
     return bridge.ffi_available(), "tpucomm_ffi handlers"

@@ -142,8 +142,11 @@ def test_staged_eager_dispatch():
 @pytest.mark.parametrize("ffi", ["on", "off"])
 def test_ffi_fast_path(ffi):
     # native custom calls used when available; callback fallback under the
-    # kill switch — identical numerics either way
-    env = {"MPI4JAX_TPU_DISABLE_FFI": "1"} if ffi == "off" else None
+    # kill switch — identical numerics either way.  The "on" case clears
+    # the var explicitly so the test holds under a CI job that forces
+    # callbacks mode globally ("" parses as false in utils/config.py).
+    env = ({"MPI4JAX_TPU_DISABLE_FFI": "1"} if ffi == "off"
+           else {"MPI4JAX_TPU_DISABLE_FFI": ""})
     res = run_launcher("ffi_path.py", 2, env_extra=env)
     assert res.returncode == 0, res.stderr + res.stdout
     assert res.stdout.count(f"ffi_path OK (ffi={ffi})") == 2
