@@ -55,7 +55,7 @@ def _watchdog(flag):
                 # headline metric key so the driver records a structured
                 # failure; 'phase' names what actually stalled
                 "metric": "shallow_water_1800x3600_0.1day_1chip",
-                "value": None, "unit": "s", "vs_baseline": 0.0,
+                "value": None, "unit": "s", "vs_baseline": None,
                 "phase": flag.get("phase", "init"),
                 "error": (f"init phase {flag.get('phase', 'init')!r} did "
                           f"not complete within its "
@@ -740,7 +740,7 @@ def main():
         (m for m in metrics if m["metric"].startswith("shallow_water")
          and m.get("value") is not None),
         {"metric": HEADLINE, "value": None, "unit": "s",
-         "vs_baseline": 0.0},
+         "vs_baseline": None},
     )
     final = dict(headline)
     final["metrics"] = metrics

@@ -133,15 +133,22 @@ def world_tier_rank(max_bytes, sizes=None):
         # isolates the wire/arena cost itself
         import ctypes
 
+        from mpi4jax_tpu.ops.reduce_ops import ALL_OPS
+        from mpi4jax_tpu.utils import dtypes as _dtypes
+
         a = np.ones(size // 4, np.float32)
         o = np.empty_like(a)
         lib = bridge.get_lib()
         fn_native = lib.tpucomm_allreduce
+        sum_code = next(i for i, op in enumerate(ALL_OPS)
+                        if op.name == "SUM")
         args_native = (
             ctypes.c_int64(comm.handle),
             a.ctypes.data_as(ctypes.c_void_p),
             o.ctypes.data_as(ctypes.c_void_p),
-            ctypes.c_int64(a.size), 11, 0,  # f32 wire code, SUM
+            ctypes.c_int64(a.size),
+            ctypes.c_int(_dtypes.wire_code(a.dtype)),
+            ctypes.c_int(sum_code),
         )
         rc = fn_native(*args_native)  # align ranks on the same op count
         t0 = time.perf_counter()

@@ -94,3 +94,47 @@ def test_mesh_comm_hashable():
     a, b = m4j.MeshComm("x"), m4j.MeshComm("x")
     assert a == b and hash(a) == hash(b)
     assert m4j.MeshComm(("x", "y")) != a
+
+
+def test_explicit_token_ordering_is_in_jit_cache_key():
+    # the ordering mode is a jax config state in the jit cache key: a
+    # function traced in one mode must retrace (not silently reuse the
+    # cached program) when called in the other
+    from mpi4jax_tpu.ops import _world_impl
+
+    traces = []
+
+    @jax.jit
+    def f(x):
+        traces.append(_world_impl._ordered_now())
+        return x + 1
+
+    f(jnp.zeros(2))
+    with m4j.explicit_token_ordering():
+        assert not _world_impl._ordered_now()
+        f(jnp.zeros(2))
+    assert _world_impl._ordered_now()
+    f(jnp.zeros(2))  # cached ordered trace — no third retrace
+    assert traces == [True, False]
+
+
+def test_explicit_token_ordering_effect_selection():
+    # primitives bind the unordered effect inside the context, ordered
+    # outside — checked at the jaxpr level, no transport needed
+    from mpi4jax_tpu.ops import _world_impl
+    from mpi4jax_tpu.runtime.transport import WorldComm
+    from mpi4jax_tpu.utils.effects import (
+        comm_effect, unordered_comm_effect,
+    )
+
+    comm = WorldComm(rank=0, size=2, coord="127.0.0.1:45999")
+
+    def prog(x):
+        return _world_impl.allreduce(x, m4j.SUM, comm)
+
+    ordered_jaxpr = jax.make_jaxpr(prog)(jnp.zeros(2))
+    assert comm_effect in ordered_jaxpr.effects
+    with m4j.explicit_token_ordering():
+        unordered_jaxpr = jax.make_jaxpr(prog)(jnp.zeros(2))
+    assert unordered_comm_effect in unordered_jaxpr.effects
+    assert comm_effect not in unordered_jaxpr.effects
