@@ -245,6 +245,19 @@ def test_abort_fail_fast():
     assert "returned error code" in res.stderr
 
 
+def test_shm_schedule_mismatch_aborts():
+    # the arena's per-op opword cross-check: ranks disagreeing on which
+    # collective comes next must abort with a diagnostic naming both
+    # ops, not hang in a barrier or corrupt slots (the shm analog of
+    # the TCP tier's frame order-violation fail-fast)
+    res = run_launcher("shm_schedule_mismatch.py", 2, timeout=120)
+    assert res.returncode != 0
+    assert res.stdout.count("warmup ok") == 2
+    assert "UNREACHABLE" not in res.stdout
+    assert ("schedule mismatch" in res.stderr
+            or "returned error code" in res.stderr), res.stderr[-800:]
+
+
 def test_tag_mismatch_aborts():
     res = run_launcher("tag_mismatch.py", 2, timeout=120)
     assert res.returncode != 0

@@ -1,0 +1,39 @@
+"""Shm-arena schedule cross-check: divergent collectives must fail fast.
+
+Rank 0 calls allreduce while rank 1 calls bcast at the same program
+position — on the TCP tier this surfaces as a frame mismatch; on the
+shm arena the per-rank opword check must turn it into an immediate
+"collective schedule mismatch" abort instead of silent corruption or a
+barrier-timeout hang.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import mpi4jax_tpu as m4j  # noqa: E402
+
+comm = m4j.get_default_comm()
+rank = comm.rank()
+
+x = jnp.arange(32.0)
+# a matched warm-up proves the arena works before the divergence
+out = m4j.allreduce(x, op=m4j.SUM, comm=comm)
+assert float(out[1]) == 2.0, out[1]
+print(f"warmup ok r{rank}", flush=True)
+
+if rank == 0:
+    m4j.allreduce(x, op=m4j.SUM, comm=comm)
+else:
+    m4j.bcast(x, root=1, comm=comm)
+print("UNREACHABLE", flush=True)
