@@ -76,8 +76,10 @@ def _watchdog(flag, battery):
 
 # children launched by battery sections, killed by Battery.final_exit so
 # an aborting battery never leaves a rank subprocess holding the device
-# claim or a rendezvous port
+# claim or a rendezvous port.  The lock covers spawn+register as one
+# step so an abort snapshot cannot miss a child mid-launch.
 _CHILDREN = set()
+_CHILDREN_LOCK = threading.Lock()
 
 
 def _run_tracked(cmd, timeout=None, **kwargs):
@@ -86,8 +88,9 @@ def _run_tracked(cmd, timeout=None, **kwargs):
     if kwargs.pop("capture_output", False):
         kwargs["stdout"] = subprocess.PIPE
         kwargs["stderr"] = subprocess.PIPE
-    proc = subprocess.Popen(cmd, **kwargs)
-    _CHILDREN.add(proc)
+    with _CHILDREN_LOCK:
+        proc = subprocess.Popen(cmd, **kwargs)
+        _CHILDREN.add(proc)
     try:
         out, err = proc.communicate(timeout=timeout)
     except subprocess.TimeoutExpired:
@@ -759,12 +762,18 @@ class Battery:
         self._lock.acquire()
         try:
             self.note = note
-            for proc in list(_CHILDREN):
+            with _CHILDREN_LOCK:
+                children = list(_CHILDREN)
+            for proc in children:
                 try:
                     proc.kill()
                 except Exception:
                     pass
-            print(json.dumps(self._summary_locked()), flush=True)
+            # leading newline: if the kill interrupted a half-written
+            # stdout line, the summary still starts a fresh line
+            sys.stdout.write("\n" + json.dumps(self._summary_locked())
+                             + "\n")
+            sys.stdout.flush()
         finally:
             os._exit(rc)
 

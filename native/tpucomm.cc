@@ -708,10 +708,20 @@ int64_t dtype_size(int dtype) {
  *   phase -> B2 -> (allreduce/reduce: copy result out, protected from
  *   overwrite by the *next* op's B1, which no rank can pass before
  *   every rank finished its copy-out and re-entered).
- * The opword (opcode | root | byte-count per rank, one cacheline
- * each) turns cross-rank collective-order divergence into a fail-fast
- * diagnostic instead of silent corruption — the shm analog of the TCP
- * frames' comm-id/tag order checking.
+ * Region discipline behind that protection: slot reads all happen
+ *   between B1 and B2, so a rank may write its OWN slot before B1; but
+ *   result() reads extend PAST B2 (the large-allreduce copy-out), so
+ *   nothing may write result() before B1 — every op that publishes
+ *   data pre-B1 (bcast, scatter) stages it through slot(root), and
+ *   result() is written only between B1 and B2 (the cooperative
+ *   reduce).  A pre-B1 result() write can silently corrupt a slower
+ *   rank's allreduce copy-out (ADVICE r4 high).
+ * The opword (opcode | root | dtype | reduce-op | byte-count per
+ * rank, one cacheline each) turns cross-rank collective-order — or
+ * type/op — divergence into a fail-fast diagnostic instead of silent
+ * corruption: the shm analog of the TCP frames' comm-id/tag order
+ * checking.  Equal byte counts with different dtypes (f32 vs i32) or
+ * different reduce ops (SUM vs MAX) are caught too.
  *
  * Large allreduce is cooperative: after B1 each rank reduces its
  * 64-byte-aligned chunk of the message across all slots (AVX2 8-wide
@@ -900,10 +910,14 @@ int shm_barrier(Comm* c) {
   return 0;
 }
 
-/* opword layout: opcode byte | root byte | 48 bits of per-rank bytes */
-uint64_t shm_opword(int opcode, int root, int64_t nbytes) {
+/* opword layout: opcode byte | root byte | dtype byte | reduce-op byte
+ * | 32 bits of per-rank piece bytes (pieces are <= slot_bytes, far
+ * below 4 GB).  dtype/op are 0 for ops they don't apply to. */
+uint64_t shm_opword(int opcode, int root, int dtype, int op,
+                    int64_t nbytes) {
   return ((uint64_t)(uint8_t)opcode << 56) | ((uint64_t)(uint8_t)root << 48) |
-         ((uint64_t)nbytes & 0xffffffffffffull);
+         ((uint64_t)(uint8_t)dtype << 40) | ((uint64_t)(uint8_t)op << 32) |
+         ((uint64_t)nbytes & 0xffffffffull);
 }
 
 enum ShmOpcode {
@@ -943,7 +957,8 @@ int shm_allreduce_like(Comm* c, const void* sendbuf, void* recvbuf,
     int64_t nb = std::min(total - off, a->slot_bytes);
     int64_t cnt = nb / esize;
     nt_memcpy(a->slot(c->rank), in + off, nb);
-    if (shm_publish_and_check(c, shm_opword(opcode, root, nb))) return 1;
+    if (shm_publish_and_check(c, shm_opword(opcode, root, dtype, op, nb)))
+      return 1;
     for (int r = 0; r < a->nranks; r++) srcs[r] = a->slot(r);
     if (nb <= kShmSmallBytes) {
       /* every interested rank reduces all slots straight into its out */
@@ -986,7 +1001,8 @@ int shm_scan(Comm* c, const void* sendbuf, void* recvbuf, int64_t count,
   do {
     int64_t nb = std::min(total - off, a->slot_bytes);
     nt_memcpy(a->slot(c->rank), in + off, nb);
-    if (shm_publish_and_check(c, shm_opword(SHM_SCAN, 0, nb))) return 1;
+    if (shm_publish_and_check(c, shm_opword(SHM_SCAN, 0, dtype, op, nb)))
+      return 1;
     for (int r = 0; r <= c->rank; r++) srcs[r] = a->slot(r);
     if (vertical_reduce(c, out + off, srcs.data(), c->rank + 1, nb / esize,
                         dtype, op))
@@ -1003,9 +1019,13 @@ int shm_bcast(Comm* c, void* buf, int64_t nbytes, int root) {
   int64_t off = 0;
   do {
     int64_t nb = std::min(nbytes - off, a->slot_bytes);
-    if (c->rank == root) nt_memcpy(a->result(), p + off, nb);
-    if (shm_publish_and_check(c, shm_opword(SHM_BCAST, root, nb))) return 1;
-    if (c->rank != root) std::memcpy(p + off, a->result(), nb);
+    /* pre-B1 writes must target the writer's own slot, never result()
+     * (a slow rank may still be copying a previous large allreduce out
+     * of result() after its B2 — ADVICE r4 high) */
+    if (c->rank == root) nt_memcpy(a->slot(root), p + off, nb);
+    if (shm_publish_and_check(c, shm_opword(SHM_BCAST, root, 0, 0, nb)))
+      return 1;
+    if (c->rank != root) std::memcpy(p + off, a->slot(root), nb);
     if (shm_barrier(c)) return 1;
     off += nb;
   } while (off < nbytes);
@@ -1022,7 +1042,8 @@ int shm_allgather(Comm* c, const void* sendbuf, int64_t nbytes,
   do {
     int64_t nb = std::min(nbytes - off, a->slot_bytes);
     nt_memcpy(a->slot(c->rank), in + off, nb);
-    if (shm_publish_and_check(c, shm_opword(opcode, root, nb))) return 1;
+    if (shm_publish_and_check(c, shm_opword(opcode, root, 0, 0, nb)))
+      return 1;
     if (all_ranks_out || c->rank == root)
       for (int r = 0; r < a->nranks; r++)
         std::memcpy(out + (int64_t)r * nbytes + off, a->slot(r), nb);
@@ -1037,18 +1058,20 @@ int shm_scatter(Comm* c, const void* sendbuf, void* recvbuf, int64_t nbytes,
   ShmArena* a = c->arena;
   const char* in = static_cast<const char*>(sendbuf);
   char* out = static_cast<char*>(recvbuf);
-  /* per-piece budget: all nranks pieces must fit the result region */
+  /* per-piece budget: all nranks pieces must fit one slot */
   int64_t piece = std::max<int64_t>(
       64, (a->slot_bytes / a->nranks) & ~int64_t(63));
   int64_t off = 0;
   do {
     int64_t nb = std::min(nbytes - off, piece);
+    /* staged through slot(root), not result(): see bcast note */
     if (c->rank == root)
       for (int r = 0; r < a->nranks; r++)
-        nt_memcpy(a->result() + (int64_t)r * nb,
+        nt_memcpy(a->slot(root) + (int64_t)r * nb,
                   in + (int64_t)r * nbytes + off, nb);
-    if (shm_publish_and_check(c, shm_opword(SHM_SCATTER, root, nb))) return 1;
-    std::memcpy(out + off, a->result() + (int64_t)c->rank * nb, nb);
+    if (shm_publish_and_check(c, shm_opword(SHM_SCATTER, root, 0, 0, nb)))
+      return 1;
+    std::memcpy(out + off, a->slot(root) + (int64_t)c->rank * nb, nb);
     if (shm_barrier(c)) return 1;
     off += nb;
   } while (off < nbytes);
@@ -1068,7 +1091,8 @@ int shm_alltoall(Comm* c, const void* sendbuf, void* recvbuf,
     for (int d = 0; d < a->nranks; d++)
       nt_memcpy(a->slot(c->rank) + (int64_t)d * nb,
                 in + (int64_t)d * chunk + off, nb);
-    if (shm_publish_and_check(c, shm_opword(SHM_ALLTOALL, 0, nb))) return 1;
+    if (shm_publish_and_check(c, shm_opword(SHM_ALLTOALL, 0, 0, 0, nb)))
+      return 1;
     for (int s = 0; s < a->nranks; s++)
       std::memcpy(out + (int64_t)s * chunk + off,
                   a->slot(s) + (int64_t)c->rank * nb, nb);
@@ -1079,7 +1103,7 @@ int shm_alltoall(Comm* c, const void* sendbuf, void* recvbuf,
 }
 
 int shm_barrier_op(Comm* c) {
-  if (shm_publish_and_check(c, shm_opword(SHM_BARRIER, 0, 0))) return 1;
+  if (shm_publish_and_check(c, shm_opword(SHM_BARRIER, 0, 0, 0, 0))) return 1;
   return shm_barrier(c);
 }
 

@@ -245,12 +245,14 @@ def test_abort_fail_fast():
     assert "returned error code" in res.stderr
 
 
-def test_shm_schedule_mismatch_aborts():
+@pytest.mark.parametrize("mode", ["opcode", "reduce_op", "dtype"])
+def test_shm_schedule_mismatch_aborts(mode):
     # the arena's per-op opword cross-check: ranks disagreeing on which
-    # collective comes next must abort with a diagnostic naming both
-    # ops, not hang in a barrier or corrupt slots (the shm analog of
-    # the TCP tier's frame order-violation fail-fast)
-    res = run_launcher("shm_schedule_mismatch.py", 2, timeout=120)
+    # collective comes next — or on its dtype or reduce op at equal byte
+    # counts (ADVICE r4 low) — must abort with a diagnostic naming both
+    # opwords, not hang in a barrier or reduce divergently in silence
+    res = run_launcher("shm_schedule_mismatch.py", 2, timeout=120,
+                       env_extra={"MISMATCH_MODE": mode})
     assert res.returncode != 0
     assert res.stdout.count("warmup ok") == 2
     assert "UNREACHABLE" not in res.stdout

@@ -69,6 +69,30 @@ np.testing.assert_allclose(
     mine, rank * 100 + np.arange(m, dtype=np.float32)
 )
 
+# race hunt (ADVICE r4 high): a >slot-size allreduce's copy-out from the
+# result region happens AFTER its second barrier; an immediately
+# following root!=0 bcast/scatter used to write result() BEFORE its
+# first barrier, corrupting a slower rank's copy-out.  Staging now goes
+# through slot(root); iterate the exact sequence so a regression shows
+# up as a wrong element with high probability rather than never.
+for trial in range(8):
+    red_out = np.asarray(m4j.allreduce(base + rank, op=m4j.SUM, comm=comm))
+    b = np.asarray(
+        m4j.bcast(base * (rank + 1) + trial, root=size - 1, comm=comm))
+    np.testing.assert_allclose(red_out, expect, rtol=1e-6,
+                               err_msg=f"allreduce trial {trial}")
+    np.testing.assert_allclose(
+        b, float(size) * np.arange(n, dtype=np.float32) + trial,
+        err_msg=f"bcast trial {trial}")
+    red_out2 = np.asarray(m4j.allreduce(base, op=m4j.SUM, comm=comm))
+    sc = np.asarray(m4j.scatter(table, root=size - 1, comm=comm))
+    np.testing.assert_allclose(red_out2,
+                               size * np.arange(n, dtype=np.float32),
+                               rtol=1e-6, err_msg=f"allreduce2 trial {trial}")
+    np.testing.assert_allclose(
+        sc, rank * 100 + np.arange(m, dtype=np.float32),
+        err_msg=f"scatter trial {trial}")
+
 # scan + reduce through the same chunked machinery
 pre = np.asarray(m4j.scan(base * 0 + (rank + 1), op=m4j.SUM, comm=comm))
 np.testing.assert_allclose(pre[:4], sum(range(1, rank + 2)))

@@ -32,8 +32,21 @@ out = m4j.allreduce(x, op=m4j.SUM, comm=comm)
 assert float(out[1]) == 2.0, out[1]
 print(f"warmup ok r{rank}", flush=True)
 
-if rank == 0:
-    m4j.allreduce(x, op=m4j.SUM, comm=comm)
-else:
-    m4j.bcast(x, root=1, comm=comm)
+mode = os.environ.get("MISMATCH_MODE", "opcode")
+if mode == "opcode":
+    # different collectives at the same program position
+    if rank == 0:
+        m4j.allreduce(x, op=m4j.SUM, comm=comm)
+    else:
+        m4j.bcast(x, root=1, comm=comm)
+elif mode == "reduce_op":
+    # same collective, same bytes, divergent reduce op (SUM vs MAX):
+    # caught only because the opword carries the op code (ADVICE r4 low)
+    m4j.allreduce(x, op=m4j.SUM if rank == 0 else m4j.MAX, comm=comm)
+else:  # dtype: equal byte counts, different element type
+    if rank == 0:
+        m4j.allreduce(x, op=m4j.SUM, comm=comm)
+    else:
+        m4j.allreduce(jnp.arange(32, dtype=jnp.int32), op=m4j.SUM,
+                      comm=comm)
 print("UNREACHABLE", flush=True)
