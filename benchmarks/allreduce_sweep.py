@@ -106,12 +106,24 @@ def world_tier_rank(max_bytes, sizes=None):
                 out, _ = jax.lax.scan(step, v, None, length=K)
                 return out
 
-            calls = 3
-            jax.block_until_ready(many(x))
-            t0 = time.perf_counter()
-            for _ in range(calls):
+            # steady state is the deployment shape (comm ops live inside
+            # a long-running training loop): the first few executions of
+            # a fresh executable run 2-7x slower (allocator warmup,
+            # branch/cache training, cross-rank convoy alignment —
+            # measured on this host), so warm up past them and report
+            # the median of per-call timings
+            calls = 8
+            for _ in range(4):
                 out = many(x)
             jax.block_until_ready(out)
+            times = []
+            for _ in range(calls):
+                t0 = time.perf_counter()
+                out = many(x)
+                jax.block_until_ready(out)
+                times.append(time.perf_counter() - t0)
+            times.sort()
+            dt = times[len(times) // 2] / K
         else:
             # donated input + operand/result aliasing = true in-place
             # allreduce (the steady-state shape of a training loop that
@@ -126,7 +138,7 @@ def world_tier_rank(max_bytes, sizes=None):
             for _ in range(calls):
                 out = fn(out)
             jax.block_until_ready(out)
-        dt = (time.perf_counter() - t0) / (calls * K)
+            dt = (time.perf_counter() - t0) / (calls * K)
 
         # transport-level latency: the native call with every argument
         # pre-marshalled — no JAX, no numpy wrapper work in the loop —

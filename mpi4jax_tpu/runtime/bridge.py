@@ -219,10 +219,11 @@ def _check(opname: str, rc: int):
         _abort(opname, rc)
 
 
-def comm_init(rank: int, size: int, coord: str) -> int:
+def comm_init(rank: int, size: int, coord: str, hosts=None) -> int:
     lib = get_lib()
     host, _, port = coord.partition(":")
-    hosts = os.environ.get("MPI4JAX_TPU_HOSTS", "")
+    if hosts is None:
+        hosts = os.environ.get("MPI4JAX_TPU_HOSTS", "")
     handle = lib.tpucomm_init(
         rank, size, int(port or 49817), hosts.encode()
     )

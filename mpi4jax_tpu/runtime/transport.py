@@ -79,9 +79,56 @@ def get_world_comm() -> "WorldComm":
         _world = WorldComm(
             rank=rs[0],
             size=rs[1],
-            coord=os.environ.get(ENV_COORD, "127.0.0.1:49817"),
+            coord=os.environ.get(ENV_COORD) or _default_coord(),
         )
     return _world
+
+
+def _free_port_block(size: int) -> int:
+    """A base port such that base..base+size-2 are bindable locally
+    (rank r listens on base+r; remote-host collisions surface as the
+    native init's fail-fast)."""
+    import random
+    import socket
+
+    for _ in range(50):
+        base = random.randrange(42000, 48000)
+        ok = True
+        for off in range(max(size - 1, 1)):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            try:
+                s.bind(("127.0.0.1", base + off))
+            except OSError:
+                ok = False
+            finally:
+                s.close()
+            if not ok:
+                break
+        if ok:
+            return base
+    raise RuntimeError("no free port block found for from_mpi bootstrap")
+
+
+def _default_coord() -> str:
+    """Rendezvous default when MPI4JAX_TPU_COORD is unset (foreign
+    launchers: mpirun/srun/PMI).  A fixed port would collide when two
+    jobs share a host (ADVICE r4), so derive it from a job-unique token
+    every rank of one job sees identically — no token means single-job
+    hosts, where the fixed default is fine.  Multi-job hosts without a
+    recognized token should set MPI4JAX_TPU_COORD explicitly
+    (docs/installation.md)."""
+    # PMIX_NAMESPACE covers Open MPI >= 5 (ORTE/ess removed; PMIx
+    # publishes the job namespace instead)
+    for var in ("OMPI_MCA_ess_base_jobid", "PMIX_NAMESPACE", "SLURM_JOB_ID",
+                "PMI_JOBID", "PBS_JOBID", "LSB_JOBID"):
+        tok = os.environ.get(var)
+        if tok:
+            # stable across ranks (no PYTHONHASHSEED dependence)
+            import zlib
+
+            port = 41000 + (zlib.crc32(tok.encode()) % 8000)
+            return f"127.0.0.1:{port}"
+    return "127.0.0.1:49817"
 
 
 class WorldComm:
@@ -94,10 +141,11 @@ class WorldComm:
     """
 
     def __init__(self, rank: int, size: int, coord: str, *, handle=None,
-                 lineage=(0,), parent=None):
+                 lineage=(0,), parent=None, hosts=None):
         self._rank = rank
         self._size = size
         self._coord = coord
+        self._hosts = hosts    # per-rank host table (else MPI4JAX_TPU_HOSTS)
         self._handle = handle  # native comm handle, created lazily
         # identity of this comm in the split tree: (0,) is the world;
         # children append (call seq, color).  Deterministic across ranks,
@@ -198,5 +246,42 @@ class WorldComm:
         if self._handle is None:
             from . import bridge
 
-            self._handle = bridge.comm_init(self._rank, self._size, self._coord)
+            self._handle = bridge.comm_init(self._rank, self._size,
+                                            self._coord, hosts=self._hosts)
         return self._handle
+
+    # -- adopting an existing mpi4py communicator ---------------------
+
+    _from_mpi_seq = 0
+
+    @classmethod
+    def from_mpi(cls, mpi_comm):
+        """Adopt an ``mpi4py`` communicator (any ``MPI.Comm``, including
+        ``Split``/``Create``-derived sub-communicators and Cartesian
+        topologies' base comms).
+
+        mpi4py is used ONLY for bootstrap — rank/size, per-rank host
+        exchange, and base-port agreement; all data then moves over this
+        framework's native transport (TCP mesh + same-host shm arena).
+        The reference passes ``MPI.Comm`` handles straight into libmpi
+        (utils.py:80-127 there); here the comm's *group* is mirrored
+        onto a fresh world, which composes with ``split``/``dup`` as
+        usual.  Every member of ``mpi_comm`` must call ``from_mpi`` at
+        the same program point (it is collective over ``mpi_comm``).
+
+        Per-rank reachable addresses default to 127.0.0.1 (same-host);
+        multi-host jobs set ``MPI4JAX_TPU_HOST`` per rank.
+        """
+        rank = mpi_comm.Get_rank()
+        size = mpi_comm.Get_size()
+        my_host = os.environ.get("MPI4JAX_TPU_HOST", "127.0.0.1")
+        hosts = mpi_comm.allgather(my_host)
+        base_port = mpi_comm.bcast(
+            _free_port_block(size) if rank == 0 else None, root=0)
+        cls._from_mpi_seq += 1  # same order on every member: collective
+        return cls(
+            rank=rank, size=size,
+            coord=f"{hosts[0]}:{base_port}",
+            lineage=(0, "mpi", cls._from_mpi_seq, size),
+            hosts=",".join(hosts),
+        )

@@ -19,6 +19,18 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 native/tpucomm.cc).
 - ``MPI4JAX_TPU_SHM_MB``      — shm arena slot size in MB (default 32; read
                                 natively).
+- ``MPI4JAX_TPU_SHM_RING_KB`` — per-directed-pair p2p ring size in KB
+                                (default 1024; read natively).  Messages
+                                <= ring/4 travel inline; larger ones
+                                leave an ordering stub and ride TCP.
+- ``MPI4JAX_TPU_DISABLE_SHM_P2P`` — keep point-to-point on TCP while
+                                collectives stay on the shm arena (CI
+                                axis; must agree across ranks, read
+                                natively).
+- ``MPI4JAX_TPU_STRICT_TOKENS`` — explicit-token chain guard: unset =
+                                warn on an unthreaded/forked world-op
+                                token chain at trace time, 1 = raise,
+                                0 = silent (ops/_world_impl.py).
 - ``MPI4JAX_TPU_SHM_TIMEOUT_S`` — shm barrier timeout seconds (default 180;
                                 read natively).
 - ``MPI4JAX_TPU_JOBID``       — unique token for /dev/shm segment names

@@ -143,6 +143,16 @@ struct SendJob {
 struct ShmArena;  // same-host shared-memory fast path, defined below
 void arena_destroy(ShmArena* a);
 
+struct Comm;
+/* shm p2p rings (defined in the arena section below) */
+bool ring_p2p_on(const Comm* c);
+int shm_try_send(Comm* c, int dest, int tag, const void* buf,
+                 int64_t nbytes, bool* inlined);
+int shm_recv_status(Comm* c, int source, int tag, void* buf,
+                    int64_t nbytes, int32_t* out_src, int32_t* out_tag,
+                    int64_t* out_count);
+int ring_poll_any(Comm* c, int tag, int* out_source);
+
 struct Comm {
   int rank = -1;
   int size = 0;
@@ -234,17 +244,22 @@ void self_deliver(Comm* c, int tag, const void* buf, int64_t nbytes) {
   c->self_q.emplace_back(h, std::vector<char>(p, p + nbytes));
 }
 
+int send_msg_tcp(Comm* c, int dest, int tag, const void* buf,
+                 int64_t nbytes) {
+  MsgHeader h{nbytes, tag, c->comm_id};
+  if (write_all(c->socks[dest], &h, sizeof(h)) ||
+      write_all(c->socks[dest], buf, nbytes))
+    FAIL(c, "send to %d failed: %s", dest, std::strerror(errno));
+  return 0;
+}
+
 int send_msg(Comm* c, int dest, int tag, const void* buf, int64_t nbytes) {
   if (dest < 0 || dest >= c->size) FAIL(c, "send to invalid rank %d", dest);
   if (dest == c->rank) {
     self_deliver(c, tag, buf, nbytes);
     return 0;
   }
-  MsgHeader h{nbytes, tag, c->comm_id};
-  if (write_all(c->socks[dest], &h, sizeof(h)) ||
-      write_all(c->socks[dest], buf, nbytes))
-    FAIL(c, "send to %d failed: %s", dest, std::strerror(errno));
-  return 0;
+  return send_msg_tcp(c, dest, tag, buf, nbytes);
 }
 
 /* ---------------- persistent writer (async send half) ---------------- */
@@ -293,6 +308,21 @@ int async_send(Comm* c, SendJob* job, int dest, int tag, const void* buf,
     job->rc = 0;
     job->done = true;
     return 0;
+  }
+  if (ring_p2p_on(c)) {
+    bool inlined = false;
+    if (shm_try_send(c, dest, tag, buf, nbytes, &inlined)) {
+      job->rc = 1;
+      job->done = true;
+      return 1;
+    }
+    if (inlined) {
+      job->rc = 0;
+      job->done = true;
+      return 0;
+    }
+    /* stub in the ring: the payload follows on TCP (eager inline below,
+     * or the writer thread for large frames) */
   }
   if (nbytes <= kEagerBytes) {
     job->rc = send_msg(c, dest, tag, buf, nbytes);
@@ -414,6 +444,8 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
     if (!c->self_q.empty() &&
         header_matches(c, c->self_q.front().first, tag)) {
       source = c->rank;
+    } else if (ring_p2p_on(c)) {
+      if (ring_poll_any(c, tag, &source)) return 1;
     } else if (poll_any_source(c, tag, &source)) {
       return 1;
     }
@@ -440,6 +472,9 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
     if (out_count) *out_count = h.nbytes;
     return 0;
   }
+  if (ring_p2p_on(c))
+    return shm_recv_status(c, source, tag, buf, nbytes, out_src, out_tag,
+                           out_count);
   if (out_src) *out_src = source;
   MsgHeader h{};
   if (read_all(c->socks[source], &h, sizeof(h)))
@@ -758,10 +793,57 @@ constexpr uint64_t kShmMagic = 0x6d34416a73686d31ull;
 constexpr int64_t kOpwordStride = 64;  // one cacheline per rank
 constexpr int64_t kShmSmallBytes = 64 * 1024;
 
+/* Per-directed-pair SPSC ring for same-host point-to-point (r5).  One
+ * producer (src rank) and one consumer (dst rank); head/tail are byte
+ * cursors that only ever grow.  The futex seq words let either side
+ * park when the ring is full/empty without burning the shared core. */
+struct RingHdr {
+  /* producer-written and consumer-written fields live on separate
+   * cachelines: both sides store on every op, and sharing a line would
+   * ping-pong it between cores on exactly the latency path the rings
+   * exist to shorten */
+  alignas(64) std::atomic<uint64_t> head;  // bytes produced (src writes)
+  std::atomic<int32_t> hseq;               // bumped per publish (futex)
+  alignas(64) std::atomic<uint64_t> tail;  // bytes consumed (dst writes)
+  std::atomic<int32_t> tseq;               // bumped per consume (futex)
+};
+static_assert(sizeof(RingHdr) <= 128, "RingHdr must fit kRingHdrBytes");
+
+/* Frame inside a ring: header then payload, padded to 16 bytes.  A
+ * kRingStub frame carries no ring payload — the message body follows on
+ * the TCP socket (large sends keep the writer-thread progress
+ * guarantee); the ring remains the (comm, src->dst) ordering spine. */
+struct RingFrame {
+  int32_t tag;
+  int32_t flags;    // kRingStub
+  int64_t nbytes;   // payload size (actual, even for stubs)
+};
+constexpr int32_t kRingStub = 1;
+constexpr int64_t kRingHdrBytes = 128;  // RingHdr, cacheline-padded
+
+int64_t ring_round(int64_t n) { return (n + 15) & ~int64_t(15); }
+
+/* Peer-death detection for the shm wait loops: the TCP recv path gets
+ * EOF for free when a peer dies; a futex wait on a shared ring does
+ * not.  The mesh socket to the peer doubles as a liveness probe (clean
+ * exit -> EOF, crash -> RST), checked only on the slow (parked) path.
+ * A socket holding undelivered data is alive, not dead. */
+bool peer_socket_dead(const std::vector<int>& socks, int r) {
+  int fd = r >= 0 && r < (int)socks.size() ? socks[r] : -1;
+  if (fd < 0) return false;  // self or never-connected: no evidence
+  char b;
+  ssize_t p = ::recv(fd, &b, 1, MSG_PEEK | MSG_DONTWAIT);
+  if (p == 0) return true;
+  if (p < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
+    return true;
+  return false;
+}
+
 struct ShmArena {
   char* base = nullptr;
   size_t map_len = 0;
   int64_t slot_bytes = 0;
+  int64_t ring_bytes = 0;
   int nranks = 0;
 
   ShmHdr* hdr() { return reinterpret_cast<ShmHdr*>(base); }
@@ -773,9 +855,24 @@ struct ShmArena {
   char* slot(int r) {
     return result() + slot_bytes + (int64_t)r * slot_bytes;
   }
-  static size_t total_bytes(int nranks, int64_t slot_bytes) {
+  /* ring region sits after the slots; one block per directed pair
+   * (src, dst), diagonal unused (self goes through self_q) */
+  char* ring_base() {
+    return result() + (int64_t)(nranks + 1) * slot_bytes;
+  }
+  RingHdr* ring_hdr(int src, int dst) {
+    return reinterpret_cast<RingHdr*>(
+        ring_base() +
+        ((int64_t)src * nranks + dst) * (kRingHdrBytes + ring_bytes));
+  }
+  char* ring_data(int src, int dst) {
+    return reinterpret_cast<char*>(ring_hdr(src, dst)) + kRingHdrBytes;
+  }
+  static size_t total_bytes(int nranks, int64_t slot_bytes,
+                            int64_t ring_bytes) {
     return 4096 + (size_t)nranks * kOpwordStride +
-           (size_t)(nranks + 1) * slot_bytes;
+           (size_t)(nranks + 1) * slot_bytes +
+           (size_t)nranks * nranks * (kRingHdrBytes + ring_bytes);
   }
 };
 
@@ -900,6 +997,11 @@ int shm_barrier(Comm* c) {
       continue;
     }
     shm_futex_wait(&h->bar_sense, sense, 100);
+    if (h->bar_sense.load(std::memory_order_acquire) != sense) break;
+    for (int r = 0; r < c->size; r++)
+      if (r != c->rank && peer_socket_dead(c->socks, r))
+        FAIL(c, "shm barrier: rank %d exited while this rank waits — "
+             "the ranks disagree on the collective schedule", r);
     if (now_s() > deadline)
       FAIL(c,
            "shm barrier timed out after %.0f s — a peer died or the ranks "
@@ -907,6 +1009,262 @@ int shm_barrier(Comm* c) {
            "MPI4JAX_TPU_SHM_TIMEOUT_S to adjust)",
            shm_timeout_s());
   }
+  return 0;
+}
+
+/* ================= shm point-to-point rings =================
+ *
+ * Same-host send/recv/sendrecv (and shift2, which rides them) go
+ * through per-directed-pair SPSC rings in the arena instead of the TCP
+ * loopback stack (VERDICT r4 #3: np2 sendrecv 1 KB was 27.5 us over
+ * TCP while the arena showed ~16 us two-barrier round trips).
+ *
+ * Contract preserved exactly:
+ * - ordered-stream matching per (comm, src->dst): the ring IS the
+ *   stream; the head frame must match the expected tag or fail fast
+ *   (same "message order violation" diagnostic as the TCP frames);
+ * - sends never block on a missing receiver: a frame that doesn't fit
+ *   the ring's free space degrades to a kRingStub in the ring (the
+ *   ordering spine) with the payload riding the existing TCP
+ *   eager/writer-thread path — the progress guarantee the writer
+ *   thread gives TCP large sends carries over unchanged;
+ * - ANY_SOURCE polls every inbound ring head (self-queue first), and a
+ *   head that cannot match is dropped from the candidate set, exactly
+ *   like the TCP poll;
+ * - collective-protocol traffic never enters the rings (arena comms
+ *   run collectives through the barrier protocol above).
+ *
+ * Knobs: MPI4JAX_TPU_SHM_RING_KB sizes each ring (default 1024;
+ * inline cutoff is ring/4), MPI4JAX_TPU_DISABLE_SHM_P2P=1 keeps p2p
+ * on TCP while collectives stay on the arena (CI axis; must agree
+ * across ranks, like the other shm knobs). */
+
+int ring_wait_space(Comm* c, int dest, RingHdr* rh, int64_t ring_bytes,
+                    int64_t need) {
+  double deadline = now_s() + shm_timeout_s();
+  int spins = 0;
+  for (;;) {
+    uint64_t used = rh->head.load(std::memory_order_relaxed) -
+                    rh->tail.load(std::memory_order_acquire);
+    if ((int64_t)(ring_bytes - used) >= need) return 0;
+    if (spins < 4) {
+      spins++;
+      ::sched_yield();
+      continue;
+    }
+    int32_t seq = rh->tseq.load(std::memory_order_acquire);
+    uint64_t used2 = rh->head.load(std::memory_order_relaxed) -
+                     rh->tail.load(std::memory_order_acquire);
+    if ((int64_t)(ring_bytes - used2) >= need) return 0;
+    shm_futex_wait(&rh->tseq, seq, 50);
+    if (peer_socket_dead(c->socks, dest))
+      FAIL(c, "send to rank %d failed: peer exited with its inbound "
+           "ring full", dest);
+    if (now_s() > deadline)
+      FAIL(c,
+           "shm p2p ring full for %.0f s — the peer stopped receiving "
+           "(died, or the ranks disagree on the message schedule)",
+           shm_timeout_s());
+  }
+}
+
+void ring_copy_in(char* data, int64_t ring_bytes, uint64_t at,
+                  const void* src, int64_t n) {
+  int64_t off = (int64_t)(at % (uint64_t)ring_bytes);
+  int64_t first = std::min(n, ring_bytes - off);
+  std::memcpy(data + off, src, first);
+  if (n > first) std::memcpy(data, (const char*)src + first, n - first);
+}
+
+void ring_copy_out(const char* data, int64_t ring_bytes, uint64_t at,
+                   void* dst, int64_t n) {
+  int64_t off = (int64_t)(at % (uint64_t)ring_bytes);
+  int64_t first = std::min(n, ring_bytes - off);
+  std::memcpy(dst, data + off, first);
+  if (n > first) std::memcpy((char*)dst + first, data, n - first);
+}
+
+/* Push one frame (inline payload or stub).  Space for the 16-byte
+ * header is always waited for (a full ring of stubs means 64Ki
+ * outstanding unreceived messages — schedule bug, surfaced by the
+ * timeout); inline callers check free space first and degrade to a
+ * stub instead of waiting. */
+int ring_push(Comm* c, int dst, int32_t tag, int32_t flags,
+              const void* buf, int64_t nbytes) {
+  ShmArena* a = c->arena;
+  RingHdr* rh = a->ring_hdr(c->rank, dst);
+  char* data = a->ring_data(c->rank, dst);
+  int64_t payload = (flags & kRingStub) ? 0 : ring_round(nbytes);
+  int64_t need = (int64_t)sizeof(RingFrame) + payload;
+  if (ring_wait_space(c, dst, rh, a->ring_bytes, need)) return 1;
+  uint64_t head = rh->head.load(std::memory_order_relaxed);
+  RingFrame f{tag, flags, nbytes};
+  ring_copy_in(data, a->ring_bytes, head, &f, sizeof(f));
+  if (payload)
+    ring_copy_in(data, a->ring_bytes, head + sizeof(RingFrame), buf, nbytes);
+  rh->head.store(head + need, std::memory_order_release);
+  rh->hseq.fetch_add(1, std::memory_order_release);
+  shm_futex_wake_all(&rh->hseq);
+  return 0;
+}
+
+/* Block until the (src -> me) ring holds a frame; peek it into *out. */
+int ring_wait_frame(Comm* c, int src, RingFrame* out) {
+  ShmArena* a = c->arena;
+  RingHdr* rh = a->ring_hdr(src, c->rank);
+  double deadline = now_s() + shm_timeout_s();
+  int spins = 0;
+  for (;;) {
+    uint64_t tail = rh->tail.load(std::memory_order_relaxed);
+    if (rh->head.load(std::memory_order_acquire) != tail) {
+      ring_copy_out(a->ring_data(src, c->rank), a->ring_bytes, tail, out,
+                    sizeof(*out));
+      return 0;
+    }
+    if (spins < 4) {
+      spins++;
+      ::sched_yield();
+      continue;
+    }
+    int32_t seq = rh->hseq.load(std::memory_order_acquire);
+    if (rh->head.load(std::memory_order_acquire) !=
+        rh->tail.load(std::memory_order_relaxed))
+      continue;
+    shm_futex_wait(&rh->hseq, seq, 50);
+    if (rh->head.load(std::memory_order_acquire) !=
+        rh->tail.load(std::memory_order_relaxed))
+      continue;  // drain whatever arrived, even from a now-dead peer
+    if (peer_socket_dead(c->socks, src))
+      FAIL(c, "recv from rank %d failed: peer exited with no matching "
+           "send pending", src);
+    if (now_s() > deadline)
+      FAIL(c,
+           "shm p2p recv from rank %d timed out after %.0f s — no "
+           "matching send arrived (peer died or schedule mismatch)",
+           src, shm_timeout_s());
+  }
+}
+
+/* Consume the head frame after its payload (if inline) is copied out. */
+void ring_consume(Comm* c, int src, const RingFrame& f) {
+  ShmArena* a = c->arena;
+  RingHdr* rh = a->ring_hdr(src, c->rank);
+  int64_t payload = (f.flags & kRingStub) ? 0 : ring_round(f.nbytes);
+  rh->tail.fetch_add((int64_t)sizeof(RingFrame) + payload,
+                     std::memory_order_release);
+  rh->tseq.fetch_add(1, std::memory_order_release);
+  shm_futex_wake_all(&rh->tseq);
+}
+
+bool ring_p2p_on(const Comm* c) {
+  return c->arena != nullptr && c->arena->ring_bytes > 0;
+}
+
+/* ANY_SOURCE over the rings: first peer whose HEAD frame matches the
+ * tag filter wins; a non-matching head disqualifies that peer (its
+ * stream can never satisfy this wildcard), mirroring poll_any_source. */
+int ring_poll_any(Comm* c, int tag, int* out_source) {
+  std::vector<int> cands;
+  for (int r = 0; r < c->size; r++)
+    if (r != c->rank) cands.push_back(r);
+  double deadline = now_s() + shm_timeout_s();
+  for (;;) {
+    for (size_t i = 0; i < cands.size();) {
+      int r = cands[i];
+      RingHdr* rh = c->arena->ring_hdr(r, c->rank);
+      uint64_t tail = rh->tail.load(std::memory_order_relaxed);
+      if (rh->head.load(std::memory_order_acquire) != tail) {
+        RingFrame f{};
+        ring_copy_out(c->arena->ring_data(r, c->rank), c->arena->ring_bytes,
+                      tail, &f, sizeof(f));
+        if (tag == kAnyTag || f.tag == tag) {
+          *out_source = r;
+          return 0;
+        }
+        cands.erase(cands.begin() + i);  // head can never match
+        continue;
+      }
+      i++;
+    }
+    if (cands.empty())
+      FAIL(c, "ANY_SOURCE recv: no peer can deliver a matching message "
+           "(all ring heads mismatched or peers exited)");
+    ::sched_yield();
+    for (size_t i = 0; i < cands.size();) {
+      RingHdr* rh = c->arena->ring_hdr(cands[i], c->rank);
+      if (rh->head.load(std::memory_order_acquire) ==
+              rh->tail.load(std::memory_order_relaxed) &&
+          peer_socket_dead(c->socks, cands[i]))
+        cands.erase(cands.begin() + i);
+      else
+        i++;
+    }
+    if (now_s() > deadline)
+      FAIL(c, "ANY_SOURCE recv timed out after %.0f s on the shm rings",
+           shm_timeout_s());
+  }
+}
+
+int shm_try_send(Comm* c, int dest, int tag, const void* buf,
+                 int64_t nbytes, bool* inlined) {
+  ShmArena* a = c->arena;
+  RingHdr* rh = a->ring_hdr(c->rank, dest);
+  int64_t need = (int64_t)sizeof(RingFrame) + ring_round(nbytes);
+  uint64_t used = rh->head.load(std::memory_order_relaxed) -
+                  rh->tail.load(std::memory_order_acquire);
+  if (nbytes <= a->ring_bytes / 4 &&
+      (int64_t)(a->ring_bytes - used) >= need) {
+    *inlined = true;
+    return ring_push(c, dest, tag, 0, buf, nbytes);
+  }
+  /* too big, or no room right now: order rides a stub; payload rides
+   * the TCP eager/writer path so the send still cannot block on a
+   * missing receiver */
+  *inlined = false;
+  return ring_push(c, dest, tag, kRingStub, nullptr, nbytes);
+}
+
+int shm_recv_status(Comm* c, int source, int tag, void* buf,
+                    int64_t nbytes, int32_t* out_src, int32_t* out_tag,
+                    int64_t* out_count) {
+  ShmArena* a = c->arena;
+  RingFrame f{};
+  if (ring_wait_frame(c, source, &f)) return 1;
+  if (tag != kAnyTag && f.tag != tag)
+    FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
+         tag, source, f.tag);
+  if (f.nbytes > nbytes)
+    FAIL(c, "message truncated: rank %d sent %lld bytes into a %lld-byte "
+         "buffer", source, (long long)f.nbytes, (long long)nbytes);
+  if (f.flags & kRingStub) {
+    /* payload is the next TCP frame from this peer; the usual header
+       checks keep cross-communicator socket order honest */
+    MsgHeader h{};
+    if (read_all(c->socks[source], &h, sizeof(h)))
+      FAIL(c, "recv header from %d failed: %s", source,
+           std::strerror(errno));
+    if (h.comm_id != c->comm_id)
+      FAIL(c, "communicator mismatch: rank %d's message is for comm %d, "
+           "this is comm %d — ops on sibling communicators must run in a "
+           "consistent order on both endpoints", source, h.comm_id,
+           c->comm_id);
+    if (h.tag != f.tag || h.nbytes != f.nbytes)
+      FAIL(c, "shm stub/TCP frame mismatch from rank %d (tag %d/%d, "
+           "bytes %lld/%lld)", source, f.tag, h.tag, (long long)f.nbytes,
+           (long long)h.nbytes);
+    if (read_all(c->socks[source], buf, h.nbytes))
+      FAIL(c, "recv payload from %d failed: %s", source,
+           std::strerror(errno));
+  } else {
+    RingHdr* rh = a->ring_hdr(source, c->rank);
+    uint64_t tail = rh->tail.load(std::memory_order_relaxed);
+    ring_copy_out(a->ring_data(source, c->rank), a->ring_bytes,
+                  tail + sizeof(RingFrame), buf, f.nbytes);
+  }
+  ring_consume(c, source, f);
+  if (out_src) *out_src = source;
+  if (out_tag) *out_tag = f.tag;
+  if (out_count) *out_count = f.nbytes;
   return 0;
 }
 
@@ -1120,13 +1478,20 @@ void arena_init(Comm* c) {
   if (const char* e = std::getenv("MPI4JAX_TPU_SHM_MB"))
     if (std::atoll(e) > 0) slot_mb = std::atoll(e);
   int64_t slot_bytes = ((slot_mb << 20) + 4095) & ~int64_t(4095);
-  size_t total = ShmArena::total_bytes(c->size, slot_bytes);
+  int64_t ring_kb = 1024;
+  if (const char* e = std::getenv("MPI4JAX_TPU_SHM_RING_KB"))
+    if (std::atoll(e) > 0) ring_kb = std::atoll(e);
+  const char* p2p_dis = std::getenv("MPI4JAX_TPU_DISABLE_SHM_P2P");
+  if (p2p_dis && p2p_dis[0] && p2p_dis[0] != '0') ring_kb = 0;
+  int64_t ring_bytes = ring_kb << 10;
+  size_t total = ShmArena::total_bytes(c->size, slot_bytes, ring_bytes);
   char name[128];
   std::snprintf(name, sizeof(name), "/%s_c%d", c->shm_prefix.c_str(),
                 (int)c->comm_id);
 
   ShmArena* a = new ShmArena;
   a->slot_bytes = slot_bytes;
+  a->ring_bytes = ring_bytes;
   a->nranks = c->size;
   uint64_t nonce = 0;
   if (c->rank == 0) {
@@ -1472,6 +1837,12 @@ int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
   LogScope log(c->rank, "Send",
                [&] { return "to " + std::to_string(dest) + " (" + std::to_string(nbytes) +
                    " bytes, tag " + std::to_string(tag) + ")"; });
+  if (ring_p2p_on(c) && dest != c->rank && dest >= 0 && dest < c->size) {
+    bool inlined = false;
+    if (shm_try_send(c, dest, tag, buf, nbytes, &inlined)) return 1;
+    if (inlined) return 0;
+    return send_msg_tcp(c, dest, tag, buf, nbytes);  // stub's payload
+  }
   return send_msg(c, dest, tag, buf, nbytes);
 }
 

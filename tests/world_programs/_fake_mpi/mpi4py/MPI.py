@@ -1,0 +1,63 @@
+"""File-rendezvous fake of the mpi4py.MPI surface from_mpi bootstraps on."""
+
+import json
+import os
+import pathlib
+import time
+
+_DIR = pathlib.Path(os.environ["FAKE_MPI_DIR"])
+_RANK = int(os.environ["FAKE_MPI_RANK"])
+_SIZE = int(os.environ["FAKE_MPI_SIZE"])
+_TIMEOUT_S = 60.0
+
+
+class Comm:
+    def __init__(self, members, my_index, tag):
+        self._members = members  # global ranks, in comm order
+        self._idx = my_index
+        self._tag = tag
+        self._seq = 0
+
+    def Get_rank(self):
+        return self._idx
+
+    def Get_size(self):
+        return len(self._members)
+
+    def _exchange(self, payload):
+        """Allgather ``payload`` (JSON-able) across the comm's members."""
+        self._seq += 1
+        base = f"{self._tag}_{self._seq}"
+        me = _DIR / f"{base}.r{self._members[self._idx]}"
+        tmp = me.with_suffix(me.suffix + ".tmp")
+        tmp.write_text(json.dumps(payload))
+        tmp.rename(me)  # atomic publish
+        out = []
+        deadline = time.time() + _TIMEOUT_S
+        for g in self._members:
+            f = _DIR / f"{base}.r{g}"
+            while not f.exists():
+                if time.time() > deadline:
+                    raise TimeoutError(f"fake MPI: waiting for {f}")
+                time.sleep(0.01)
+            # publish is atomic (rename), so a visible file is complete
+            out.append(json.loads(f.read_text()))
+        return out
+
+    def allgather(self, x):
+        return self._exchange(x)
+
+    def bcast(self, x, root=0):
+        return self._exchange(x if self._idx == root else None)[root]
+
+    def Split(self, color, key=0):
+        rows = self._exchange([color, key, self._members[self._idx]])
+        mine = sorted(
+            (k, g) for c, k, g in rows if c == color
+        )
+        members = [g for _, g in mine]
+        idx = members.index(self._members[self._idx])
+        return Comm(members, idx, f"{self._tag}s{self._seq}c{color}")
+
+
+COMM_WORLD = Comm(list(range(_SIZE)), _RANK, "w")

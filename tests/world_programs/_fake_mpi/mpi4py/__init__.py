@@ -1,0 +1,13 @@
+"""Simulated mpi4py package for testing WorldComm.from_mpi without an
+MPI installation (none ships in this environment).
+
+Implements the minimal bootstrap surface ``from_mpi`` touches —
+``Get_rank``/``Get_size``/``allgather``/``bcast``/``Split`` — with the
+collectives exchanged through a shared filesystem rendezvous directory
+(env ``FAKE_MPI_DIR``), the way a real harness would use PMI.  Data
+correctness of the framework's ops is NOT provided by this shim; it only
+lets separate OS processes agree on ranks/hosts/ports, which is all
+``from_mpi`` uses mpi4py for.
+"""
+
+from . import MPI  # noqa: F401

@@ -1,0 +1,64 @@
+"""Chain guard: a deliberately broken token chain fails fast at TRACE time.
+
+The composition mode's sharpest bit (inherited from the reference's token
+design, docs/sharp-bits.rst:6-34 there): a world op binding a fresh token
+while other ops chain theirs has UNDEFINED order and deadlocks at run
+time.  With MPI4JAX_TPU_STRICT_TOKENS=1 the trace-time chain guard turns
+that into an immediate error — the program must die BEFORE any
+communication happens (no deadlock, no timeout).
+
+Run under the launcher at np=2 with MPI4JAX_TPU_STRICT_TOKENS=1.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+)
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+import mpi4jax_tpu as m4j  # noqa: E402
+from mpi4jax_tpu.compat import token_api as tk  # noqa: E402
+
+comm = m4j.get_default_comm()
+rank, size = comm.rank(), comm.size()
+
+mode = os.environ.get("BROKEN_MODE", "fresh_token")
+
+with m4j.explicit_token_ordering():
+
+    @jax.jit
+    def bad(x):
+        token = tk.create_token(x)
+        if mode == "fresh_token":
+            # rank 0 threads its chain; both ranks then bind a SECOND op
+            # with a fresh UNROOTED token while the first chain is live
+            token = tk.send(x, dest=(rank + 1) % size, tag=7, comm=comm,
+                            token=token)
+            rogue = tk.create_token()          # <- the bug
+            got, _ = tk.recv(jnp.zeros_like(x), source=(rank - 1) % size,
+                             tag=7, comm=comm, token=rogue)
+        else:  # "no_token": a primary-API (tokenless) op amid a chain
+            token = tk.send(x, dest=(rank + 1) % size, tag=7, comm=comm,
+                            token=token)
+            got = m4j.recv(jnp.zeros_like(x), source=(rank - 1) % size,
+                           tag=7, comm=comm)   # <- the bug
+        return got
+
+    try:
+        bad(jnp.arange(4.0))
+    except RuntimeError as err:
+        assert "UNDEFINED" in str(err), err
+        print(f"broken_chain CAUGHT AT TRACE TIME r{rank}", flush=True)
+        sys.exit(0)
+
+print("UNREACHABLE", flush=True)

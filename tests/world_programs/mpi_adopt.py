@@ -1,0 +1,66 @@
+"""Adopting an mpi4py communicator: WorldComm.from_mpi end to end.
+
+Launched as N plain processes (no framework launcher, no MPI4JAX_TPU_*
+env) with the simulated mpi4py harness on sys.path — the drop-in shape
+for users who hold mpi4py comms (reference: any ``MPI.Comm`` as an op
+param, utils.py:80-127 there).  Exercises:
+
+1. ``from_mpi(COMM_WORLD)`` — bootstrap via mpi4py only, data over the
+   native transport (eager + jitted ops).
+2. ``from_mpi(COMM_WORLD.Split(...))`` — a Split-derived subgroup
+   becomes its own world; collectives stay inside the group.
+3. The adopted world composes with the framework's own ``split``.
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _REPO)
+sys.path.insert(0, os.path.join(_REPO, "tests", "world_programs", "_fake_mpi"))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from mpi4py import MPI  # noqa: E402  (the simulated harness)
+
+import mpi4jax_tpu as m4j  # noqa: E402
+from mpi4jax_tpu.runtime.transport import WorldComm  # noqa: E402
+
+world = WorldComm.from_mpi(MPI.COMM_WORLD)
+rank, size = world.rank(), world.size()
+assert rank == MPI.COMM_WORLD.Get_rank()
+assert size == MPI.COMM_WORLD.Get_size()
+
+# eager op over the adopted comm
+out = np.asarray(m4j.allreduce(jnp.arange(4.0) + rank, op=m4j.SUM,
+                               comm=world))
+np.testing.assert_allclose(
+    out, size * np.arange(4.0) + sum(range(size)))
+
+# jitted chain (FFI fast path) with the adopted comm as ambient default
+with world:
+    @jax.jit
+    def step(x):
+        y = m4j.allreduce(x, op=m4j.SUM)
+        return m4j.bcast(y * 2.0, root=size - 1)
+
+    got = np.asarray(step(jnp.ones(8) * (rank + 1)))
+    np.testing.assert_allclose(got, 2.0 * sum(range(1, size + 1)))
+
+# a Split-derived mpi4py subgroup becomes its own world
+sub_mpi = MPI.COMM_WORLD.Split(color=rank % 2, key=rank)
+sub = WorldComm.from_mpi(sub_mpi)
+assert sub.size() == sub_mpi.Get_size()
+vals = np.asarray(m4j.allgather(jnp.float32(rank), comm=sub))
+np.testing.assert_allclose(vals, np.arange(rank % 2, size, 2, np.float32))
+
+# the adopted world composes with the framework's own split
+own_sub = world.split(color=rank // 2, key=rank)
+s = np.asarray(m4j.allreduce(jnp.float32(1.0), op=m4j.SUM, comm=own_sub))
+np.testing.assert_allclose(s, own_sub.size())
+
+print(f"mpi_adopt OK r{rank}", flush=True)
