@@ -1,0 +1,36 @@
+"""Docs build check: every relative link/path mentioned in docs/*.md and
+README.md must exist, and every MPI4JAX_TPU_* knob mentioned anywhere in
+the docs must be declared in utils/config.py's registry docstring (the
+single-source-of-truth rule the registry exists for)."""
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+errors = []
+
+md_files = sorted(REPO.glob("docs/*.md")) + [REPO / "README.md"]
+
+link_re = re.compile(r"\]\((?!https?://|#)([^)#]+)")
+for md in md_files:
+    text = md.read_text()
+    for target in link_re.findall(text):
+        p = (md.parent / target).resolve()
+        if not p.exists():
+            errors.append(f"{md.relative_to(REPO)}: broken link -> {target}")
+
+registry = (REPO / "mpi4jax_tpu/utils/config.py").read_text()
+knob_re = re.compile(r"MPI4JAX_TPU_[A-Z0-9_]+")
+for md in md_files:
+    for knob in set(knob_re.findall(md.read_text())):
+        if knob not in registry:
+            errors.append(
+                f"{md.relative_to(REPO)}: knob {knob} not in "
+                "utils/config.py registry"
+            )
+
+if errors:
+    print("\n".join(errors))
+    sys.exit(1)
+print(f"docs check OK ({len(md_files)} files)")

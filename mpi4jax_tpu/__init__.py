@@ -59,6 +59,7 @@ from .parallel import (  # noqa: E402
     make_mesh,
     spmd,
 )
+from .runtime.transport import WorldComm  # noqa: E402
 from .utils.status import ANY_SOURCE, ANY_TAG, Status  # noqa: E402
 from .utils.tracing import set_logging  # noqa: E402
 
@@ -126,6 +127,7 @@ __all__ = [
     "MeshComm",
     "current_comm",
     "get_default_comm",
+    "WorldComm",
     "make_mesh",
     "spmd",
     "set_logging",

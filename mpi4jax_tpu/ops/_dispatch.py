@@ -40,15 +40,20 @@ def is_mesh(comm) -> bool:
 
 def create_token(x=None):
     """A fresh ordering token (a zero scalar; tied to ``x`` if given)."""
+    from . import _world_impl
+
     token = jnp.zeros((), jnp.uint32)
     if x is not None:
         token, _ = lax.optimization_barrier((token, x))
         # a data-tied token legitimately roots a NEW chain (ordering
         # rides the dataflow) — exempt it from the explicit-mode
         # unthreaded-chain guard
-        from . import _world_impl
-
         _world_impl._chain_guard.note_rooted(token)
+    else:
+        # a BARE fresh token mid-chain is the classic footgun — the
+        # guard flags exactly these (known-fresh), never tokens it
+        # merely hasn't seen
+        _world_impl._chain_guard.note_fresh(token)
     return token
 
 

@@ -120,9 +120,15 @@ class _TokenChainGuard:
 
     ``create_token(x)`` with a data tie registers a *rooted* token —
     starting a new chain from one is legitimate (ordering rides the
-    dataflow, e.g. a scan carry).  An UNROOTED fresh token binding while
-    the same comm has a live head in the same trace is the footgun the
-    reference can only document (docs/sharp-bits.rst:6-34 there).
+    dataflow, e.g. a scan carry); a bare ``create_token()`` registers a
+    *fresh* token.  Binding a KNOWN-fresh token while the same comm has
+    a live head in the same trace is the footgun the reference can only
+    document (docs/sharp-bits.rst:6-34 there).  Tokens the guard has
+    never seen (e.g. a chained token that passed through ``lax.cond`` or
+    a remat boundary and re-emerged as a new tracer) are NOT flagged —
+    zero false positives on correct programs beats flagging every
+    transform boundary — and the bind-side side chain orders them
+    safely regardless.
     """
 
     def __init__(self):
@@ -135,6 +141,7 @@ class _TokenChainGuard:
         # does not accumulate state across retraces.
         self._heads = {}
         self._rooted = {}   # id(trace) -> [weakref(trace), set of id(tok)]
+        self._fresh = {}    # id(trace) -> [weakref(trace), set of id(tok)]
 
     def enter(self):
         self._depth += 1
@@ -146,13 +153,14 @@ class _TokenChainGuard:
             self._depth = 0
             self._heads.clear()
             self._rooted.clear()
+            self._fresh.clear()
 
     @property
     def active(self):
         return self._depth > 0
 
     def _prune(self):
-        for store in (self._heads, self._rooted):
+        for store in (self._heads, self._rooted, self._fresh):
             dead = [k for k, v in store.items() if v[0]() is None]
             for k in dead:
                 del store[k]
@@ -181,8 +189,19 @@ class _TokenChainGuard:
         ent = self._rooted.setdefault(id(trace), [self._wref(trace), set()])
         ent[1].add(id(tok))
 
+    def note_fresh(self, tok):
+        trace = self._trace_of(tok) if self.active else None
+        if trace is None:
+            return
+        ent = self._fresh.setdefault(id(trace), [self._wref(trace), set()])
+        ent[1].add(id(tok))
+
     def _is_rooted(self, trace, tok):
         ent = self._rooted.get(id(trace))
+        return ent is not None and id(tok) in ent[1]
+
+    def _is_fresh(self, trace, tok):
+        ent = self._fresh.get(id(trace))
         return ent is not None and id(tok) in ent[1]
 
     def note_op(self, comm, tok_in, tok_out):
@@ -198,7 +217,7 @@ class _TokenChainGuard:
         heads = ent[1]
         if id(tok_in) in heads:
             heads.discard(id(tok_in))       # chain continues
-        elif heads and not self._is_rooted(trace, tok_in):
+        elif heads and self._is_fresh(trace, tok_in):
             self._warn(comm, len(heads), "binding a fresh (unrooted) token")
         heads.add(id(tok_out))
 
@@ -583,15 +602,22 @@ def _make_token_variant(name, out_aval_fn, host_fn, n_data=1,
 
 
 def _bind_token_variant(name, x, token, **params):
-    """(result, token') through the token-operand primitive."""
+    """(result, token') through the token-operand primitive.
+
+    The wire token is the per-trace side chain's head when one exists
+    (falling back to the caller's token): every world op in a trace —
+    user-chained, tangent, or transposed — then sits on ONE token chain,
+    so AD-introduced ops and later user ops can never be mutually
+    unordered (the side chain only ever ADDS ordering edges: its head is
+    always downstream of the user's chain).  The chain guard still sees
+    the caller's ORIGINAL token for footgun detection."""
     p = _token_variants[name]
-    tok = jnp.asarray(token, jnp.uint32)
+    wire_tok = _ad_chain_token(token)
+    tok = jnp.asarray(wire_tok, jnp.uint32)
     args = (tok,) if x is None else (jnp.asarray(x), tok)
     out, tok2 = p.bind(*args, **params)
-    # chain guard sees the ORIGINAL token object (asarray is a no-op on
-    # a matching-dtype tracer, but don't rely on it) and the returned
-    # head the caller will thread next
     _chain_guard.note_op(params.get("comm"), token, tok2)
+    _ad_chain_set(tok2)
     return out, tok2
 
 
@@ -998,12 +1024,22 @@ def _token_or_fresh(token):
 _ad_side_chain = {}  # id(trace) -> [weakref(trace), token]
 
 
-def _ad_chain_token(hint):
+def _ad_current_trace():
     trace = getattr(core.trace_ctx, "trace", None)
+    if trace is None or type(trace).__name__ == "EvalTrace":
+        return None  # eager: Python order IS execution order
+    return trace
+
+
+def _ad_chain_token(hint):
+    trace = _ad_current_trace()
     if trace is None:
         return hint
     ent = _ad_side_chain.get(id(trace))
-    if ent is not None and ent[0]() is not None:
+    # identity check, not liveness: a dict key is id(trace), which a
+    # LATER trace can reuse after the first is collected — a stale
+    # entry's token must never leak into a different trace
+    if ent is not None and ent[0]() is trace:
         return ent[1]
     return hint
 
@@ -1011,7 +1047,7 @@ def _ad_chain_token(hint):
 def _ad_chain_set(tok):
     import weakref
 
-    trace = getattr(core.trace_ctx, "trace", None)
+    trace = _ad_current_trace()
     if trace is None:
         return
     if len(_ad_side_chain) > 64:

@@ -46,7 +46,7 @@ _TRANS_B = (((1,), (1,)), ((), ()))  # contract last dims: x @ y.T
 _TRANS_A = (((0,), (0,)), ((), ()))  # contract first dims: x.T @ y
 
 # Exp used by the forward online softmax.  Module-level so the roofline
-# experiment (benchmarks/flash_sweep.py --cheap-exp) can swap in a
+# experiment (bench.py::bench_flash_experiments) can swap in a
 # linear stand-in of the same shape/cost-class-minus-transcendental and
 # measure whether fwd MFU is bound by the VPU's exp throughput (the
 # r3/r4 40%-vs-14% dispute, VERDICT r4 weak #2).  Production path is

@@ -31,6 +31,20 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 warn on an unthreaded/forked world-op
                                 token chain at trace time, 1 = raise,
                                 0 = silent (ops/_world_impl.py).
+- ``MPI4JAX_TPU_STAGED_EAGER`` — force (1) or forbid (0) staged-eager
+                                dispatch for eager world ops on
+                                callback-less backends; default
+                                auto-detects the axon tunnel
+                                (ops/_world_impl.py).
+- ``MPI4JAX_TPU_RANK`` / ``MPI4JAX_TPU_SIZE`` / ``MPI4JAX_TPU_COORD`` /
+  ``MPI4JAX_TPU_HOSTS`` — world job description (rank, world size,
+                                rendezvous host:base-port, per-rank
+                                host table); set by the launcher,
+                                adopted from mpirun/srun/PMI env when
+                                absent (runtime/transport.py).
+- ``MPI4JAX_TPU_HOST``        — this rank's reachable address for
+                                ``WorldComm.from_mpi`` bootstrap
+                                (default 127.0.0.1).
 - ``MPI4JAX_TPU_SHM_TIMEOUT_S`` — shm barrier timeout seconds (default 180;
                                 read natively).
 - ``MPI4JAX_TPU_JOBID``       — unique token for /dev/shm segment names

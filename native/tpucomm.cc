@@ -999,9 +999,13 @@ int shm_barrier(Comm* c) {
     shm_futex_wait(&h->bar_sense, sense, 100);
     if (h->bar_sense.load(std::memory_order_acquire) != sense) break;
     for (int r = 0; r < c->size; r++)
-      if (r != c->rank && peer_socket_dead(c->socks, r))
+      if (r != c->rank && peer_socket_dead(c->socks, r)) {
+        /* TOCTOU: the last arriver may have flipped the sense and
+         * exited between our sense load and the death probe */
+        if (h->bar_sense.load(std::memory_order_acquire) != sense) break;
         FAIL(c, "shm barrier: rank %d exited while this rank waits — "
              "the ranks disagree on the collective schedule", r);
+      }
     if (now_s() > deadline)
       FAIL(c,
            "shm barrier timed out after %.0f s — a peer died or the ranks "
@@ -1134,9 +1138,15 @@ int ring_wait_frame(Comm* c, int src, RingFrame* out) {
     if (rh->head.load(std::memory_order_acquire) !=
         rh->tail.load(std::memory_order_relaxed))
       continue;  // drain whatever arrived, even from a now-dead peer
-    if (peer_socket_dead(c->socks, src))
+    if (peer_socket_dead(c->socks, src)) {
+      /* TOCTOU: the peer's last act may have been push-then-exit
+       * between our emptiness load and the death probe — recheck */
+      if (rh->head.load(std::memory_order_acquire) !=
+          rh->tail.load(std::memory_order_relaxed))
+        continue;
       FAIL(c, "recv from rank %d failed: peer exited with no matching "
            "send pending", src);
+    }
     if (now_s() > deadline)
       FAIL(c,
            "shm p2p recv from rank %d timed out after %.0f s — no "
@@ -1192,9 +1202,12 @@ int ring_poll_any(Comm* c, int tag, int* out_source) {
     ::sched_yield();
     for (size_t i = 0; i < cands.size();) {
       RingHdr* rh = c->arena->ring_hdr(cands[i], c->rank);
-      if (rh->head.load(std::memory_order_acquire) ==
-              rh->tail.load(std::memory_order_relaxed) &&
-          peer_socket_dead(c->socks, cands[i]))
+      bool empty = rh->head.load(std::memory_order_acquire) ==
+                   rh->tail.load(std::memory_order_relaxed);
+      if (empty && peer_socket_dead(c->socks, cands[i]) &&
+          /* TOCTOU: push-then-exit between the loads — recheck */
+          rh->head.load(std::memory_order_acquire) ==
+              rh->tail.load(std::memory_order_relaxed))
         cands.erase(cands.begin() + i);
       else
         i++;
