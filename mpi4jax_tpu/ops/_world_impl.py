@@ -534,6 +534,71 @@ _TOKEN_AVAL = core.ShapedArray((), np.dtype(np.uint32))
 _token_variants = {}
 
 
+def _token_ffi_attrs(name, params):
+    """FFI attrs for a token-variant bind, or None when this bind cannot
+    take the native wire (Status fill is a Python-side effect; split
+    send/recv tags and custom reduction ops have no native encoding)."""
+    params = dict(params)
+    if params.pop("status", None) is not None:
+        return None
+    op = params.get("op")
+    if op is not None and op.name not in _OP_CODE:
+        return None  # custom ReduceOp: the fold runs in Python
+    if name == "sendrecv":
+        if params["sendtag"] != params["recvtag"]:
+            return None
+        params["tag"] = params.pop("sendtag")
+        params.pop("recvtag")
+    return _ffi_attrs(**params)
+
+
+def _single_partition(ctx) -> bool:
+    """True when this lowering targets ONE device, where the SPMD
+    partitioner (which strips sharding annotations from custom-call
+    targets it doesn't special-case — ours included, measured) never
+    runs.  Multi-device (composition) lowerings keep the host-callback
+    wire, whose targets the Shardy bridge does preserve shardings for.
+    """
+    platforms = tuple(getattr(ctx.module_context, "platforms", ()) or ())
+    if not platforms or any(p != "cpu" for p in platforms):
+        return False  # FFI targets are registered for cpu only
+    ac = ctx.module_context.axis_context
+    n = getattr(ac, "num_devices", None)
+    if n is not None:
+        return n == 1
+    mesh = getattr(ac, "mesh", None)
+    if mesh is not None:
+        return getattr(mesh, "size", 2) == 1
+    # unknown axis context (e.g. pmap replicas): the callback route's
+    # MAXIMAL pinning is the only safe once-per-process guarantee
+    return False
+
+
+def _emit_token_ffi(ctx, target, args, attrs, n_data, alias_data=False):
+    """Native custom call carrying the u32 ordering token as a REAL
+    operand/result (the reference L1 wire format) — the explicit-token
+    mode analog of _emit_ffi_call, replacing the per-op Python callback
+    (~150 us) with the ~1 us native path.  The token operand aliases the
+    token result: the chain costs no copies."""
+    result_types = [mlir.aval_to_ir_type(a) for a in ctx.avals_out]
+    aliases = {n_data: 1}  # token operand -> token result: chain is free
+    if alias_data:
+        # in-place-safe handlers (sendbuf == recvbuf tolerated): alias
+        # the payload too — the value path measured ~9 ms/op at 16 MB
+        # without it (_emit_ffi_call)
+        aliases[0] = 0
+    call = mlir.custom_call(
+        target,
+        result_types=result_types,
+        operands=list(args),
+        backend_config=attrs,
+        has_side_effect=True,
+        api_version=4,
+        operand_output_aliases=aliases,
+    )
+    return list(call.results)
+
+
 def _make_token_variant(name, out_aval_fn, host_fn, n_data=1,
                         identity_param=None):
     """``identity_param`` names a bool param that short-circuits the op
@@ -580,10 +645,20 @@ def _make_token_variant(name, out_aval_fn, host_fn, n_data=1,
     def lowering(ctx, *args, **params):
         if _is_identity(params):
             return list(args)
+        from ..runtime import bridge
+
+        host_params = _host_params(params)
+        attrs = (_token_ffi_attrs(name, host_params)
+                 if bridge.ffi_available() and _single_partition(ctx)
+                 else None)
+        if attrs is not None:
+            return _emit_token_ffi(
+                ctx, f"tpucomm_{name}_t", args, attrs, n_data,
+                alias_data=name in ("allreduce", "reduce", "scan",
+                                    "bcast", "recv"))
         _check_callback_support(ctx)
         data_avals = ctx.avals_in[:n_data]
         out_aval = ctx.avals_out[0]
-        host_params = _host_params(params)
 
         def _callback(*flat):
             data, tok = flat[:n_data], flat[n_data]

@@ -162,6 +162,20 @@ _FFI_TARGETS = {
     "tpucomm_send": "TpucommSendFfi",
     "tpucomm_recv": "TpucommRecvFfi",
     "tpucomm_sendrecv": "TpucommSendrecvFfi",
+    # token-operand variants (explicit-token mode wire format)
+    "tpucomm_allreduce_t": "TpucommAllreduceTokFfi",
+    "tpucomm_reduce_t": "TpucommReduceTokFfi",
+    "tpucomm_scan_t": "TpucommScanTokFfi",
+    "tpucomm_bcast_t": "TpucommBcastTokFfi",
+    "tpucomm_allgather_t": "TpucommAllgatherTokFfi",
+    "tpucomm_gather_t": "TpucommGatherTokFfi",
+    "tpucomm_scatter_t": "TpucommScatterTokFfi",
+    "tpucomm_alltoall_t": "TpucommAlltoallTokFfi",
+    "tpucomm_barrier_t": "TpucommBarrierTokFfi",
+    "tpucomm_send_t": "TpucommSendTokFfi",
+    "tpucomm_recv_t": "TpucommRecvTokFfi",
+    "tpucomm_shift2_t": "TpucommShift2TokFfi",
+    "tpucomm_sendrecv_t": "TpucommSendrecvTokFfi",
 }
 
 _ffi_status: Optional[bool] = None

@@ -224,6 +224,179 @@ ffi::Error SendrecvImpl(ffi::Token, ffi::AnyBuffer x,
   return ffi::Error::Success();
 }
 
+/* ---------------- token-operand variants (explicit-token mode) ------
+ *
+ * Same transport calls, but the ordering token is a real uint32 scalar
+ * OPERAND and RESULT (the reference's L1 wire format, allreduce.py:
+ * 101-104 there) instead of an XLA token: in explicit-token mode the
+ * data edge THROUGH the call is the ordering contract, and it must
+ * survive every XLA pass — these replace the ~150 us/op Python host
+ * callback with the ~1 us native path (docs/benchmarks.md, dispatch
+ * profile). */
+
+void relay_token(const ffi::AnyBuffer& tok,
+                 ffi::Result<ffi::AnyBuffer>& tok_out) {
+  if (tok_out->untyped_data() != tok.untyped_data())
+    std::memcpy(tok_out->untyped_data(), tok.untyped_data(),
+                (size_t)tok.size_bytes());
+}
+
+ffi::Error AllreduceTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                            ffi::Result<ffi::AnyBuffer> out,
+                            ffi::Result<ffi::AnyBuffer> tok_out,
+                            int64_t comm, int32_t op) {
+  relay_token(tok, tok_out);
+  int dt = wire_dtype(x.element_type());
+  if (dt < 0) return bad_dtype();
+  check_abort("Allreduce",
+              tpucomm_allreduce(comm, x.untyped_data(), out->untyped_data(),
+                                (int64_t)x.element_count(), dt, op));
+  return ffi::Error::Success();
+}
+
+ffi::Error ReduceTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                         ffi::Result<ffi::AnyBuffer> out,
+                         ffi::Result<ffi::AnyBuffer> tok_out,
+                         int64_t comm, int32_t op, int32_t root) {
+  relay_token(tok, tok_out);
+  int dt = wire_dtype(x.element_type());
+  if (dt < 0) return bad_dtype();
+  check_abort("Reduce",
+              tpucomm_reduce(comm, x.untyped_data(), out->untyped_data(),
+                             (int64_t)x.element_count(), dt, op, root));
+  return ffi::Error::Success();
+}
+
+ffi::Error ScanTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                       ffi::Result<ffi::AnyBuffer> out,
+                       ffi::Result<ffi::AnyBuffer> tok_out,
+                       int64_t comm, int32_t op) {
+  relay_token(tok, tok_out);
+  int dt = wire_dtype(x.element_type());
+  if (dt < 0) return bad_dtype();
+  check_abort("Scan",
+              tpucomm_scan(comm, x.untyped_data(), out->untyped_data(),
+                           (int64_t)x.element_count(), dt, op));
+  return ffi::Error::Success();
+}
+
+ffi::Error BcastTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                        ffi::Result<ffi::AnyBuffer> out,
+                        ffi::Result<ffi::AnyBuffer> tok_out,
+                        int64_t comm, int32_t root) {
+  relay_token(tok, tok_out);
+  if (out->untyped_data() != x.untyped_data())
+    std::memcpy(out->untyped_data(), x.untyped_data(), x.size_bytes());
+  check_abort("Bcast", tpucomm_bcast(comm, out->untyped_data(),
+                                     (int64_t)out->size_bytes(), root));
+  return ffi::Error::Success();
+}
+
+ffi::Error AllgatherTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                            ffi::Result<ffi::AnyBuffer> out,
+                            ffi::Result<ffi::AnyBuffer> tok_out,
+                            int64_t comm) {
+  relay_token(tok, tok_out);
+  check_abort("Allgather",
+              tpucomm_allgather(comm, x.untyped_data(),
+                                (int64_t)x.size_bytes(),
+                                out->untyped_data()));
+  return ffi::Error::Success();
+}
+
+ffi::Error GatherTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                         ffi::Result<ffi::AnyBuffer> out,
+                         ffi::Result<ffi::AnyBuffer> tok_out,
+                         int64_t comm, int32_t root) {
+  relay_token(tok, tok_out);
+  if (tpucomm_rank(comm) != root && out->untyped_data() != x.untyped_data())
+    std::memcpy(out->untyped_data(), x.untyped_data(),
+                (size_t)x.size_bytes());
+  check_abort("Gather",
+              tpucomm_gather(comm, x.untyped_data(), (int64_t)x.size_bytes(),
+                             out->untyped_data(), root));
+  return ffi::Error::Success();
+}
+
+ffi::Error ScatterTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                          ffi::Result<ffi::AnyBuffer> out,
+                          ffi::Result<ffi::AnyBuffer> tok_out,
+                          int64_t comm, int32_t root) {
+  relay_token(tok, tok_out);
+  check_abort("Scatter",
+              tpucomm_scatter(comm, x.untyped_data(), out->untyped_data(),
+                              (int64_t)out->size_bytes(), root));
+  return ffi::Error::Success();
+}
+
+ffi::Error AlltoallTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                           ffi::Result<ffi::AnyBuffer> out,
+                           ffi::Result<ffi::AnyBuffer> tok_out,
+                           int64_t comm) {
+  relay_token(tok, tok_out);
+  int64_t rows = x.dimensions()[0];
+  int64_t chunk = rows ? (int64_t)x.size_bytes() / rows : 0;
+  check_abort("Alltoall", tpucomm_alltoall(comm, x.untyped_data(),
+                                           out->untyped_data(), chunk));
+  return ffi::Error::Success();
+}
+
+ffi::Error BarrierTokImpl(ffi::AnyBuffer tok,
+                          ffi::Result<ffi::AnyBuffer> out,
+                          ffi::Result<ffi::AnyBuffer> tok_out,
+                          int64_t comm) {
+  relay_token(tok, tok_out);
+  check_abort("Barrier", tpucomm_barrier(comm));
+  std::memset(out->untyped_data(), 0, out->size_bytes());
+  return ffi::Error::Success();
+}
+
+ffi::Error SendTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                       ffi::Result<ffi::AnyBuffer> out,
+                       ffi::Result<ffi::AnyBuffer> tok_out,
+                       int64_t comm, int32_t dest, int32_t tag) {
+  relay_token(tok, tok_out);
+  check_abort("Send", tpucomm_send(comm, x.untyped_data(),
+                                   (int64_t)x.size_bytes(), dest, tag));
+  std::memset(out->untyped_data(), 0, out->size_bytes());
+  return ffi::Error::Success();
+}
+
+ffi::Error RecvTokImpl(ffi::AnyBuffer /* shape carrier */, ffi::AnyBuffer tok,
+                       ffi::Result<ffi::AnyBuffer> out,
+                       ffi::Result<ffi::AnyBuffer> tok_out,
+                       int64_t comm, int32_t source, int32_t tag) {
+  relay_token(tok, tok_out);
+  check_abort("Recv", tpucomm_recv(comm, out->untyped_data(),
+                                   (int64_t)out->size_bytes(), source, tag));
+  return ffi::Error::Success();
+}
+
+ffi::Error Shift2TokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                         ffi::Result<ffi::AnyBuffer> out,
+                         ffi::Result<ffi::AnyBuffer> tok_out,
+                         int64_t comm, int32_t lo, int32_t hi, int32_t tag) {
+  relay_token(tok, tok_out);
+  check_abort("Shift2",
+              tpucomm_shift2(comm, x.untyped_data(), out->untyped_data(),
+                             (int64_t)x.size_bytes() / 2, lo, hi, tag));
+  return ffi::Error::Success();
+}
+
+ffi::Error SendrecvTokImpl(ffi::AnyBuffer x, ffi::AnyBuffer tok,
+                           ffi::Result<ffi::AnyBuffer> out,
+                           ffi::Result<ffi::AnyBuffer> tok_out,
+                           int64_t comm, int32_t source, int32_t dest,
+                           int32_t tag) {
+  relay_token(tok, tok_out);
+  check_abort("Sendrecv",
+              tpucomm_sendrecv(comm, x.untyped_data(),
+                               (int64_t)x.size_bytes(), dest,
+                               out->untyped_data(),
+                               (int64_t)out->size_bytes(), source, tag));
+  return ffi::Error::Success();
+}
+
 }  // namespace
 
 /* Handler symbols, loaded by runtime/bridge.py via ctypes and registered
@@ -312,3 +485,67 @@ XLA_FFI_DEFINE_HANDLER_SYMBOL(
         .Ret<ffi::Token>().Ret<ffi::AnyBuffer>()
         .Attr<int64_t>("comm").Attr<int32_t>("source").Attr<int32_t>("dest")
         .Attr<int32_t>("tag"));
+
+/* token-operand variants: (data..., u32 token) -> (out, u32 token') */
+#define TPUCOMM_TOK_BIND() \
+  ffi::Ffi::Bind().Arg<ffi::AnyBuffer>().Arg<ffi::AnyBuffer>() \
+      .Ret<ffi::AnyBuffer>().Ret<ffi::AnyBuffer>()
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommAllreduceTokFfi, AllreduceTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("op"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommReduceTokFfi, ReduceTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("op")
+        .Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommScanTokFfi, ScanTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("op"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommBcastTokFfi, BcastTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommAllgatherTokFfi, AllgatherTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommGatherTokFfi, GatherTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommScatterTokFfi, ScatterTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("root"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommAlltoallTokFfi, AlltoallTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommBarrierTokFfi, BarrierTokImpl,
+    ffi::Ffi::Bind().Arg<ffi::AnyBuffer>()
+        .Ret<ffi::AnyBuffer>().Ret<ffi::AnyBuffer>()
+        .Attr<int64_t>("comm"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommSendTokFfi, SendTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("dest")
+        .Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommRecvTokFfi, RecvTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("source")
+        .Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommShift2TokFfi, Shift2TokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("lo")
+        .Attr<int32_t>("hi").Attr<int32_t>("tag"));
+
+XLA_FFI_DEFINE_HANDLER_SYMBOL(
+    TpucommSendrecvTokFfi, SendrecvTokImpl,
+    TPUCOMM_TOK_BIND().Attr<int64_t>("comm").Attr<int32_t>("source")
+        .Attr<int32_t>("dest").Attr<int32_t>("tag"));
