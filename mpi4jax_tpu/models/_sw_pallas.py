@@ -40,7 +40,6 @@ VERDICT.md weak #1; this layout is the fix.)
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

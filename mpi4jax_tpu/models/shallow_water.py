@@ -23,7 +23,6 @@ structure with it:
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Optional
 
 import numpy as np

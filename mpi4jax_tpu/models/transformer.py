@@ -23,7 +23,7 @@ compile times); matmuls are kept large for the MXU and can run in bfloat16.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Optional
+from typing import NamedTuple
 
 import numpy as np
 

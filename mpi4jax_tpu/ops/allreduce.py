@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..utils import dtypes as _dtypes, validation as _validation
+from ..utils import validation as _validation
 from . import _dispatch, _mesh_impl
 from .reduce_ops import SUM, as_reduce_op
 

@@ -9,7 +9,6 @@ outside-the-loss helpers.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 from .. import ops
 

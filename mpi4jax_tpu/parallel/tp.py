@@ -10,7 +10,6 @@ per pair, riding ICI.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 from .. import ops
 

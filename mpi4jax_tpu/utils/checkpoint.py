@@ -14,7 +14,6 @@ give each rank its own path.
 
 from __future__ import annotations
 
-import json
 import os
 from typing import Any
 
