@@ -338,6 +338,19 @@ def test_fuzz_ops(seed):
     assert res.stdout.count("fuzz_ops OK") == 2
 
 
+@pytest.mark.parametrize("seed", [3, 11])
+def test_fuzz_ops_ring_boundary(seed):
+    # same generative program against 4 KB p2p rings: payloads flip
+    # between inline frames and stub+TCP constantly (inline cutoff
+    # ring/4 = 1 KB sits inside the fuzz size range), and ring wrap
+    # happens every few messages — the r5 rings' nastiest regime
+    res = run_launcher("fuzz_ops.py", 2,
+                       env_extra={"FUZZ_SEED": str(seed), "FUZZ_OPS": "80",
+                                  "MPI4JAX_TPU_SHM_RING_KB": "4"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("fuzz_ops OK") == 2
+
+
 def test_wildcard_recv():
     # ANY_SOURCE receives at np=4, incl. mixed wildcard/directed ordering
     # (the reference's default recv source, recv.py:45 there)
