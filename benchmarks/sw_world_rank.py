@@ -33,7 +33,8 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-        import numpy as np
+
+    import numpy as np
 
     import mpi4jax_tpu as m4j
     from mpi4jax_tpu.models.shallow_water import SWParams
