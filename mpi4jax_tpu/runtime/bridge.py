@@ -207,6 +207,25 @@ def ffi_available() -> bool:
     return _ffi_status
 
 
+def shm_info(handle: int):
+    """(active, slot_bytes, ring_bytes) for a comm's same-host fast
+    paths — 'active' False means the comm runs on TCP only (cross-host
+    members, MPI4JAX_TPU_DISABLE_SHM, or arena creation failed soft)."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_shm_info"):
+        # stale prebuilt .so from before the symbol existed (get_lib
+        # keeps it when a rebuild isn't possible) — report inactive
+        # rather than failing a healthy transport
+        return False, 0, 0
+    slot = ctypes.c_int64(0)
+    ring = ctypes.c_int64(0)
+    rc = lib.tpucomm_shm_info(ctypes.c_int64(handle), ctypes.byref(slot),
+                              ctypes.byref(ring))
+    if rc < 0:
+        raise ValueError(f"bad comm handle {handle}")
+    return bool(rc), slot.value, ring.value
+
+
 def _abort(opname: str, rc: int):
     # include the native layer's human-readable reason, the analog of the
     # reference's ierr -> MPI_Error_string conversion before MPI_Abort

@@ -79,7 +79,12 @@ def check_transport_loopback(port):
         "c = m.get_default_comm()\n"
         "out = m.allreduce(jnp.arange(4.0), op=m.SUM, comm=c)\n"
         "assert np.allclose(np.asarray(out), np.arange(4.0) * 2), out\n"
-        "print('loopback-ok')\n" % REPO
+        "got = m.sendrecv(jnp.arange(3.0) + c.rank(), shift=1, comm=c)\n"
+        "assert np.allclose(np.asarray(got), np.arange(3.0) + 1 - c.rank())\n"
+        "from mpi4jax_tpu.runtime import bridge\n"
+        "act, slot, ring = bridge.shm_info(c.handle)\n"
+        "print('loopback-ok shm=%%d ring_kb=%%d' %% (act, ring // 1024))\n"
+        % REPO
     )
     with tempfile.NamedTemporaryFile(
         "w", suffix="_m4j_diag.py", delete=False
@@ -99,7 +104,14 @@ def check_transport_loopback(port):
     finally:
         os.unlink(prog)
     ok = rc == 0 and out.count("loopback-ok") == 2
-    return ok, "2-rank allreduce" if ok else (err.strip() or out)[-200:]
+    if not ok:
+        return False, (err.strip() or out)[-200:]
+    detail = "2-rank allreduce+sendrecv"
+    for line in out.splitlines():
+        if line.startswith("loopback-ok"):
+            detail += " [" + line[len("loopback-ok "):] + "]"
+            break
+    return True, detail
 
 
 def check_device_claim():

@@ -1842,6 +1842,22 @@ int tpucomm_size(int64_t h) {
   return c ? c->size : -1;
 }
 
+/* Observability: did the same-host fast paths engage for this comm?
+ * Returns 1 with the arena's sizes, 0 when the comm runs on TCP only,
+ * -1 for a bad handle.  (diag CLI / docs §5.5.) */
+int tpucomm_shm_info(int64_t h, int64_t* slot_bytes, int64_t* ring_bytes) {
+  Comm* c = get_comm(h);
+  if (!c) return -1;
+  if (!c->arena) {
+    *slot_bytes = 0;
+    *ring_bytes = 0;
+    return 0;
+  }
+  *slot_bytes = c->arena->slot_bytes;
+  *ring_bytes = c->arena->ring_bytes;
+  return 1;
+}
+
 int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
                  int tag) {
   Comm* c = get_comm(h);

@@ -40,6 +40,7 @@ void tpucomm_finalize(int64_t h);
 
 int tpucomm_rank(int64_t h);
 int tpucomm_size(int64_t h);
+int tpucomm_shm_info(int64_t h, int64_t* slot_bytes, int64_t* ring_bytes);
 void tpucomm_set_logging(int enabled);
 
 /* Collective sub-communicator creation (MPI_Comm_split / MPI_Comm_dup

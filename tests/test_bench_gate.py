@@ -175,20 +175,32 @@ def test_artifact_contract_sigterm():
     env = dict(os.environ)
     env["BENCH_TOTAL_BUDGET_S"] = "3000"
     env["JAX_PLATFORMS"] = "cpu"
+    import threading
+
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "bench.py")],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         env=env, cwd=REPO,
     )
-    # wait for the startup summary (the contract: it exists from second
-    # zero) so the signal lands after the handler is installed even on a
-    # loaded host
-    first = proc.stdout.readline()
-    assert first.strip(), "no startup summary"
-    _time.sleep(1)
-    proc.send_signal(_signal.SIGTERM)
-    out, _ = proc.communicate(timeout=60)
-    lines = [first] + [ln for ln in out.splitlines() if ln.strip()]
+    try:
+        # wait (bounded) for the startup summary — the contract says it
+        # exists from second zero — so the signal lands after the
+        # handler is installed even on a loaded host
+        first_box = []
+        reader = threading.Thread(
+            target=lambda: first_box.append(proc.stdout.readline()),
+            daemon=True)
+        reader.start()
+        reader.join(timeout=60)
+        assert first_box and first_box[0].strip(), "no startup summary"
+        _time.sleep(1)
+        proc.send_signal(_signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+    lines = [first_box[0]] + [ln for ln in out.splitlines() if ln.strip()]
     final = json.loads(lines[-1])
     assert final["metric"].startswith("shallow_water")
     assert "signal" in final.get("battery_note", "")
