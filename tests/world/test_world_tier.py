@@ -55,7 +55,9 @@ def test_shm_chunked_pieces():
     # multi-piece loop (incl. scatter/alltoall divided-slot budgets)
     res = run_launcher(
         "shm_chunked.py", 2, timeout=300,
-        env_extra={"MPI4JAX_TPU_SHM_MB": "1"},
+        # pin the arena ON: the whole-suite tcp axis (DISABLE_SHM=1 in
+        # CI env) must not turn the shm tests into trivial TCP reruns
+        env_extra={"MPI4JAX_TPU_SHM_MB": "1", "MPI4JAX_TPU_DISABLE_SHM": ""},
     )
     assert res.returncode == 0, res.stderr + res.stdout
     assert res.stdout.count("shm_chunked OK") == 2
@@ -67,7 +69,8 @@ def test_shm_ring_stub_path():
     # degradation both exercised by the full op battery
     res = run_launcher(
         "full_ops.py", 2, timeout=300,
-        env_extra={"MPI4JAX_TPU_SHM_RING_KB": "4"},
+        env_extra={"MPI4JAX_TPU_SHM_RING_KB": "4",
+                   "MPI4JAX_TPU_DISABLE_SHM": ""},
     )
     assert res.returncode == 0, res.stderr + res.stdout
     assert res.stdout.count("full_ops OK") == 2
@@ -78,7 +81,8 @@ def test_shm_p2p_disabled_axis():
     # falls back to TCP — numerics identical
     res = run_launcher(
         "full_ops.py", 2, timeout=300,
-        env_extra={"MPI4JAX_TPU_DISABLE_SHM_P2P": "1"},
+        env_extra={"MPI4JAX_TPU_DISABLE_SHM_P2P": "1",
+                   "MPI4JAX_TPU_DISABLE_SHM": ""},
     )
     assert res.returncode == 0, res.stderr + res.stdout
     assert res.stdout.count("full_ops OK") == 2
@@ -346,7 +350,8 @@ def test_fuzz_ops_ring_boundary(seed):
     # happens every few messages — the r5 rings' nastiest regime
     res = run_launcher("fuzz_ops.py", 2,
                        env_extra={"FUZZ_SEED": str(seed), "FUZZ_OPS": "80",
-                                  "MPI4JAX_TPU_SHM_RING_KB": "4"})
+                                  "MPI4JAX_TPU_SHM_RING_KB": "4",
+                                  "MPI4JAX_TPU_DISABLE_SHM": ""})
     assert res.returncode == 0, res.stderr + res.stdout
     assert res.stdout.count("fuzz_ops OK") == 2
 
@@ -378,7 +383,8 @@ def test_shm_schedule_mismatch_aborts(mode):
     # counts (ADVICE r4 low) — must abort with a diagnostic naming both
     # opwords, not hang in a barrier or reduce divergently in silence
     res = run_launcher("shm_schedule_mismatch.py", 2, timeout=120,
-                       env_extra={"MISMATCH_MODE": mode})
+                       env_extra={"MISMATCH_MODE": mode,
+                                  "MPI4JAX_TPU_DISABLE_SHM": ""})
     assert res.returncode != 0
     assert res.stdout.count("warmup ok") == 2
     assert "UNREACHABLE" not in res.stdout
