@@ -7,10 +7,13 @@ CPU mesh); one JSON line per message size.
     python benchmarks/allreduce_sweep.py [--max-mb 256] [--world] [--pallas]
 
 ``--world`` benchmarks the world tier (native transport) instead, under
-the launcher.  ``--pallas`` benchmarks the Pallas RDMA ring collectives
-(``ops/pallas_collectives.py``) — on TPU meshes this times the real
-inter-chip DMA kernels; off-TPU they run interpreted and the numbers only
-establish correctness-path overhead.
+the launcher.  ``--algos ring,rd,tree`` (world tier) additionally sweeps
+each FORCED collective algorithm and emits one GB/s curve per algorithm
+(``"algo"`` field in every record) — the per-algorithm evidence the BENCH
+artifact and the tune package's defaults rest on.  ``--pallas`` benchmarks
+the Pallas RDMA ring collectives (``ops/pallas_collectives.py``) — on TPU
+meshes this times the real inter-chip DMA kernels; off-TPU they run
+interpreted and the numbers only establish correctness-path overhead.
 """
 
 import argparse
@@ -67,19 +70,31 @@ def mesh_tier_sweep(max_bytes, pallas=False):
     return results
 
 
-def world_tier_rank(max_bytes, sizes=None):
+def world_tier_rank(max_bytes, sizes=None, algos=None):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
 
     import mpi4jax_tpu as m4j
+    from mpi4jax_tpu import tune
     from mpi4jax_tpu.runtime import bridge
 
     comm = m4j.get_default_comm()
     import numpy as np
 
     n = comm.size()
+    # normalize up front ("recursive_doubling" -> "rd"): the names key
+    # into ALGO_CODES below
+    algo_list = [a if a == "auto" else tune._check_algo(a)
+                 for a in (algos or ["auto"])]
+    if any(a != "auto" for a in algo_list):
+        active, _, _ = bridge.shm_info(comm.handle)
+        if active and comm.rank() == 0:
+            print("# WARNING: the shm arena is active — forced algorithms "
+                  "are no-ops there (every curve measures the arena); set "
+                  "MPI4JAX_TPU_DISABLE_SHM=1 to sweep the TCP algorithms",
+                  flush=True)
     size_list = sizes or []
     if not size_list:
         size = 1024
@@ -87,7 +102,6 @@ def world_tier_rank(max_bytes, sizes=None):
             size_list.append(size)
             size *= 4
     for size in size_list:
-        x = jnp.ones((size // 4,), jnp.float32)
         # Small sizes: K ops inside ONE jit call — a per-call dispatch of
         # an ordered-effects computation goes through JAX's Python path
         # (~300 us, and 8-ranks-on-one-core hosts serialize it rank by
@@ -95,7 +109,9 @@ def world_tier_rank(max_bytes, sizes=None):
         # programs amortize it the same way: comm ops live inside jitted
         # step functions.  Large sizes: direct calls (dispatch is noise
         # there, and carrying a multi-MB array through lax.scan makes
-        # XLA copy the carry every iteration).
+        # XLA copy the carry every iteration).  The executables carry
+        # nothing algorithm-dependent (the native layer re-reads the
+        # decision table per call), so one compile serves every algo.
         if size < 1 << 20:
             K = max(4, min(50, int(2e7 / max(size, 1))))
 
@@ -105,25 +121,6 @@ def world_tier_rank(max_bytes, sizes=None):
                     return m4j.allreduce(c, op=m4j.SUM, comm=comm), ()
                 out, _ = jax.lax.scan(step, v, None, length=K)
                 return out
-
-            # steady state is the deployment shape (comm ops live inside
-            # a long-running training loop): the first few executions of
-            # a fresh executable run 2-7x slower (allocator warmup,
-            # branch/cache training, cross-rank convoy alignment —
-            # measured on this host), so warm up past them and report
-            # the median of per-call timings
-            calls = 8
-            for _ in range(4):
-                out = many(x)
-            jax.block_until_ready(out)
-            times = []
-            for _ in range(calls):
-                t0 = time.perf_counter()
-                out = many(x)
-                jax.block_until_ready(out)
-                times.append(time.perf_counter() - t0)
-            times.sort()
-            dt = times[len(times) // 2] / K
         else:
             # donated input + operand/result aliasing = true in-place
             # allreduce (the steady-state shape of a training loop that
@@ -131,58 +128,109 @@ def world_tier_rank(max_bytes, sizes=None):
             # 16 MB operand every call to protect the caller's buffer
             fn = jax.jit(lambda v: m4j.allreduce(v, op=m4j.SUM, comm=comm),
                          donate_argnums=0)
-            K, calls = 1, max(3, min(12, int(2e8 / size)))
-            out = fn(x)
-            jax.block_until_ready(out)
+            K = 1
+
+        for algo in algo_list:
+            # forced algorithm: an engine override steers the jitted path
+            # (no retrace — see above); the raw loop below forces per call
+            if algo != "auto":
+                tune.set_algorithm("allreduce", algo)
+            else:
+                tune.clear_overrides()
+            x = jnp.ones((size // 4,), jnp.float32)
+            if size < 1 << 20:
+                # steady state is the deployment shape (comm ops live
+                # inside a long-running training loop): the first few
+                # executions of a fresh executable run 2-7x slower
+                # (allocator warmup, branch/cache training, cross-rank
+                # convoy alignment — measured on this host), so warm up
+                # past them and report the median of per-call timings
+                calls = 8
+                for _ in range(4):
+                    out = many(x)
+                jax.block_until_ready(out)
+                times = []
+                for _ in range(calls):
+                    t0 = time.perf_counter()
+                    out = many(x)
+                    jax.block_until_ready(out)
+                    times.append(time.perf_counter() - t0)
+                times.sort()
+                dt = times[len(times) // 2] / K
+            else:
+                calls = max(3, min(12, int(2e8 / size)))
+                out = fn(x)  # donates x: re-created per algo above
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                for _ in range(calls):
+                    out = fn(out)
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / (calls * K)
+
+            # transport-level latency: the native call with every argument
+            # pre-marshalled — no JAX, no numpy wrapper work in the loop —
+            # isolates the wire/arena cost itself
+            import ctypes
+
+            from mpi4jax_tpu.ops.reduce_ops import ALL_OPS
+            from mpi4jax_tpu.utils import dtypes as _dtypes
+
+            a = np.ones(size // 4, np.float32)
+            o = np.empty_like(a)
+            lib = bridge.get_lib()
+            sum_code = next(i for i, op in enumerate(ALL_OPS)
+                            if op.name == "SUM")
+            args_native = [
+                ctypes.c_int64(comm.handle),
+                a.ctypes.data_as(ctypes.c_void_p),
+                o.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_int64(a.size),
+                ctypes.c_int(_dtypes.wire_code(a.dtype)),
+                ctypes.c_int(sum_code),
+            ]
+            if algo != "auto":
+                if not hasattr(lib, "tpucomm_allreduce_algo"):
+                    # silently timing the default schedule under a forced
+                    # label would fabricate the per-algorithm curves
+                    raise RuntimeError(
+                        "--algos needs a native library with the algorithm "
+                        "engine (tpucomm_allreduce_algo); rebuild native/"
+                    )
+                # forced per call — independent of the table override above
+                fn_native = lib.tpucomm_allreduce_algo
+                args_native.append(ctypes.c_int(tune.ALGO_CODES[algo]))
+            else:
+                fn_native = lib.tpucomm_allreduce
+            args_native = tuple(args_native)
+            rc = fn_native(*args_native)  # align ranks on the same op count
             t0 = time.perf_counter()
-            for _ in range(calls):
-                out = fn(out)
-            jax.block_until_ready(out)
-            dt = (time.perf_counter() - t0) / (calls * K)
+            for _ in range(calls * K):
+                rc |= fn_native(*args_native)
+            raw_dt = (time.perf_counter() - t0) / (calls * K)
+            if rc != 0:
+                raise RuntimeError(f"native allreduce failed (rc={rc})")
 
-        # transport-level latency: the native call with every argument
-        # pre-marshalled — no JAX, no numpy wrapper work in the loop —
-        # isolates the wire/arena cost itself
-        import ctypes
-
-        from mpi4jax_tpu.ops.reduce_ops import ALL_OPS
-        from mpi4jax_tpu.utils import dtypes as _dtypes
-
-        a = np.ones(size // 4, np.float32)
-        o = np.empty_like(a)
-        lib = bridge.get_lib()
-        fn_native = lib.tpucomm_allreduce
-        sum_code = next(i for i, op in enumerate(ALL_OPS)
-                        if op.name == "SUM")
-        args_native = (
-            ctypes.c_int64(comm.handle),
-            a.ctypes.data_as(ctypes.c_void_p),
-            o.ctypes.data_as(ctypes.c_void_p),
-            ctypes.c_int64(a.size),
-            ctypes.c_int(_dtypes.wire_code(a.dtype)),
-            ctypes.c_int(sum_code),
-        )
-        rc = fn_native(*args_native)  # align ranks on the same op count
-        t0 = time.perf_counter()
-        for _ in range(calls * K):
-            rc |= fn_native(*args_native)
-        raw_dt = (time.perf_counter() - t0) / (calls * K)
-        if rc != 0:
-            raise RuntimeError(f"native allreduce failed (rc={rc})")
-
-        if comm.rank() == 0:
-            print(json.dumps({
-                "op": "allreduce", "tier": "world", "ranks": n,
-                "bytes": size, "seconds": round(dt, 9),
-                "raw_seconds": round(raw_dt, 9),
-                "ops_per_jit": K,
-                "eff_GBps_per_chip": round(
-                    2 * (n - 1) / n * size / dt / 1e9, 3
-                ),
-                "raw_eff_GBps_per_chip": round(
-                    2 * (n - 1) / n * size / raw_dt / 1e9, 3
-                ),
-            }), flush=True)
+            if comm.rank() == 0:
+                # what actually served the call: "shm" on an arena comm
+                # (forced algorithms are no-ops there), else the engine's
+                # pick / the forced algorithm
+                probed = comm.coll_algo("allreduce", size)
+                print(json.dumps({
+                    "op": "allreduce", "tier": "world", "ranks": n,
+                    "bytes": size, "algo": algo,
+                    "resolved_algo": probed if (probed == "shm" or algo == "auto")
+                                     else algo,
+                    "seconds": round(dt, 9),
+                    "raw_seconds": round(raw_dt, 9),
+                    "ops_per_jit": K,
+                    "eff_GBps_per_chip": round(
+                        2 * (n - 1) / n * size / dt / 1e9, 3
+                    ),
+                    "raw_eff_GBps_per_chip": round(
+                        2 * (n - 1) / n * size / raw_dt / 1e9, 3
+                    ),
+                }), flush=True)
+    tune.clear_overrides()
 
 
 if __name__ == "__main__":
@@ -193,13 +241,21 @@ if __name__ == "__main__":
     ap.add_argument("--sizes", default=None,
                     help="comma-separated byte sizes (world tier only; "
                          "overrides the x4 ladder)")
+    ap.add_argument("--algos", default=None,
+                    help="comma-separated forced collective algorithms to "
+                         "sweep (world tier only; e.g. auto,ring,rd,tree — "
+                         "one GB/s curve per algorithm)")
     args = ap.parse_args()
     if args.world and args.pallas:
         ap.error("--pallas applies to the mesh tier; drop --world")
+    if args.algos and not args.world:
+        ap.error("--algos applies to the world tier; add --world")
     max_bytes = int(args.max_mb * 1e6)
     if args.world:
         sizes = ([int(s) for s in args.sizes.split(",")]
                  if args.sizes else None)
-        world_tier_rank(max_bytes, sizes=sizes)
+        algos = ([a.strip() for a in args.algos.split(",") if a.strip()]
+                 if args.algos else None)
+        world_tier_rank(max_bytes, sizes=sizes, algos=algos)
     else:
         mesh_tier_sweep(max_bytes, pallas=args.pallas)

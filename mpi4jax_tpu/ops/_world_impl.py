@@ -752,10 +752,23 @@ def _elementwise_batching(p):
 # ---------------- host-side executors ----------------
 
 
+def _coll_algo_detail(comm, opname, nbytes):
+    """Algorithm name for a trace line; never let the observability
+    probe take down the op itself."""
+    try:
+        return comm.coll_algo(opname, nbytes)
+    except Exception:
+        return "?"
+
+
 def _host_allreduce(x, *, comm, op):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Allreduce", f"op {op.name}"):
+    with tracing.CallTrace(
+        comm.rank(), "Allreduce",
+        lambda: f"op {op.name} algo "
+                f"{_coll_algo_detail(comm, 'allreduce', x.nbytes)}",
+    ):
         return bridge.allreduce(comm.handle, x, _OP_CODE[op.name])
 
 
@@ -783,7 +796,10 @@ def _host_bcast(x, *, comm, root):
 def _host_allgather(x, *, comm):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Allgather", ""):
+    with tracing.CallTrace(
+        comm.rank(), "Allgather",
+        lambda: f"algo {_coll_algo_detail(comm, 'allgather', x.nbytes)}",
+    ):
         return bridge.allgather(comm.handle, x, comm.size())
 
 

@@ -48,6 +48,9 @@ def _build() -> None:
         "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread", "-shared",
         "-o", tmp,
     ]
+    # glibc < 2.34 keeps shm_open in librt (an empty stub after): without
+    # it the link succeeds but dlopen fails with an undefined symbol
+    tail = ["-lrt"]
     # preferred: transport + XLA FFI handlers (needs jaxlib's bundled
     # headers); fall back to transport-only — the op layer then routes
     # through host callbacks instead of native custom calls
@@ -60,7 +63,7 @@ def _build() -> None:
                 subprocess.run(
                     base
                     + [f"-I{native_dir}", f"-I{_jffi.include_dir()}",
-                       _SRC, _FFI_SRC],
+                       _SRC, _FFI_SRC] + tail,
                     check=True, capture_output=True, text=True,
                 )
                 os.replace(tmp, _SO_PATH)
@@ -74,7 +77,7 @@ def _build() -> None:
                 )
             except ImportError:
                 pass
-        subprocess.run(base + [_SRC], check=True)
+        subprocess.run(base + [_SRC] + tail, check=True)
         os.replace(tmp, _SO_PATH)
     finally:
         if os.path.exists(tmp):
@@ -131,6 +134,19 @@ def get_lib() -> ctypes.CDLL:
     if hasattr(lib, "tpucomm_dup"):
         lib.tpucomm_dup.restype = ctypes.c_int64
         lib.tpucomm_dup.argtypes = [ctypes.c_int64]
+    # collective algorithm engine (guarded like split/dup: a stale
+    # prebuilt .so keeps serving the fixed schedules)
+    if hasattr(lib, "tpucomm_set_coll_table"):
+        lib.tpucomm_set_coll_table.restype = None
+        lib.tpucomm_set_coll_table.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ]
+    if hasattr(lib, "tpucomm_coll_algo_for"):
+        lib.tpucomm_coll_algo_for.restype = ctypes.c_int
+        lib.tpucomm_coll_algo_for.argtypes = [
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int64,
+        ]
     if config.debug_enabled():
         lib.tpucomm_set_logging(1)
     _lib = lib
@@ -207,6 +223,34 @@ def ffi_available() -> bool:
     return _ffi_status
 
 
+def set_coll_table(coded_table) -> bool:
+    """Push the tune package's merged decision table into the native
+    layer: ``{op_kind: [(min_bytes, algo_code), ...]}``.  Returns False
+    when the loaded .so predates the engine (fixed schedules serve)."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_set_coll_table"):
+        return False
+    for op_kind, entries in coded_table.items():
+        n = len(entries)
+        mins = (ctypes.c_int64 * n)(*[int(e[0]) for e in entries])
+        algos = (ctypes.c_int32 * n)(*[int(e[1]) for e in entries])
+        lib.tpucomm_set_coll_table(int(op_kind), mins, algos, n)
+    return True
+
+
+def coll_algo_for(handle, op_kind: int, nbytes: int):
+    """The TpuCollAlgo code that would serve (comm, op kind, payload) —
+    including the shm code when the arena path wins.  None when the
+    loaded .so predates the engine."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_coll_algo_for"):
+        return None
+    code = lib.tpucomm_coll_algo_for(_i64(handle), int(op_kind), _i64(nbytes))
+    if code < 0:
+        raise ValueError(f"bad comm handle {handle}")
+    return code
+
+
 def shm_info(handle: int):
     """(active, slot_bytes, ring_bytes) for a comm's same-host fast
     paths — 'active' False means the comm runs on TCP only (cross-host
@@ -262,6 +306,24 @@ def comm_init(rank: int, size: int, coord: str, hosts=None) -> int:
     )
     if handle == 0:
         _abort("init", 1)
+    # collective algorithm engine: load the persistent autotune cache and
+    # push the merged decision table natively — every dispatch path
+    # (eager / callback / FFI) then resolves the algorithm per call.
+    # Soft for infrastructure problems (a selection-layer hiccup must
+    # never take down a healthy transport; the built-in heuristics
+    # serve), but a malformed MPI4JAX_TPU_COLL_ALGO stays fail-fast —
+    # silently ignoring the operator's force is worse than stopping
+    # (same contract as the boolean knob parser).
+    try:
+        from .. import tune
+
+        tune.install(size)
+    except ValueError:
+        raise
+    except Exception as e:  # pragma: no cover - defensive
+        import warnings
+
+        warnings.warn(f"collective algorithm table install failed: {e}")
     return handle
 
 
@@ -390,8 +452,34 @@ def bcast(handle, buf, root) -> np.ndarray:
     return out
 
 
-def allreduce(handle, buf, op_code: int, out: Optional[np.ndarray] = None
-              ) -> np.ndarray:
+def allreduce_raw(handle, buf: np.ndarray, out: np.ndarray, dtype_code: int,
+                  op_code: int, algo: Optional[int] = None):
+    """Zero-marshalling allreduce over pre-shaped contiguous buffers —
+    the tuner/benchmark inner loop.  ``algo`` is a TpuCollAlgo code
+    forced for this call (None/0 = engine selection); forcing against a
+    pre-engine .so raises — silently running the default schedule under
+    a forced label would poison equivalence tests and tuning data."""
+    lib = get_lib()
+    if algo and not hasattr(lib, "tpucomm_allreduce_algo"):
+        raise RuntimeError(
+            "forced collective algorithms need a native library with the "
+            "algorithm engine (tpucomm_allreduce_algo); rebuild native/"
+        )
+    if algo:
+        rc = lib.tpucomm_allreduce_algo(
+            _i64(handle), _ptr(buf), _ptr(out), _i64(buf.size),
+            dtype_code, op_code, int(algo),
+        )
+    else:
+        rc = lib.tpucomm_allreduce(
+            _i64(handle), _ptr(buf), _ptr(out), _i64(buf.size),
+            dtype_code, op_code,
+        )
+    _check("Allreduce", rc)
+
+
+def allreduce(handle, buf, op_code: int, out: Optional[np.ndarray] = None,
+              algo: Optional[int] = None) -> np.ndarray:
     """``out`` lets hot loops reuse the result buffer: a fresh multi-MB
     allocation per call costs page faults that dominate large-message
     timings (glibc returns big frees to the kernel immediately)."""
@@ -399,11 +487,8 @@ def allreduce(handle, buf, op_code: int, out: Optional[np.ndarray] = None
     if (out is None or out.shape != buf.shape or out.dtype != buf.dtype
             or not out.flags.c_contiguous):
         out = np.empty_like(buf)
-    rc = get_lib().tpucomm_allreduce(
-        _i64(handle), _ptr(buf), _ptr(out), _i64(buf.size),
-        _dtypes.wire_code(buf.dtype), op_code,
-    )
-    _check("Allreduce", rc)
+    allreduce_raw(handle, buf, out, _dtypes.wire_code(buf.dtype), op_code,
+                  algo=algo)
     return out
 
 
@@ -429,13 +514,32 @@ def scan(handle, buf, op_code: int) -> np.ndarray:
     return out
 
 
-def allgather(handle, buf, size: int) -> np.ndarray:
+def allgather_raw(handle, buf: np.ndarray, out: np.ndarray,
+                  algo: Optional[int] = None):
+    """Zero-marshalling allgather (tuner/benchmark inner loop); ``algo``
+    as in :func:`allreduce_raw` (raises on a pre-engine .so)."""
+    lib = get_lib()
+    if algo and not hasattr(lib, "tpucomm_allgather_algo"):
+        raise RuntimeError(
+            "forced collective algorithms need a native library with the "
+            "algorithm engine (tpucomm_allgather_algo); rebuild native/"
+        )
+    if algo:
+        rc = lib.tpucomm_allgather_algo(
+            _i64(handle), _ptr(buf), _i64(buf.nbytes), _ptr(out), int(algo)
+        )
+    else:
+        rc = lib.tpucomm_allgather(
+            _i64(handle), _ptr(buf), _i64(buf.nbytes), _ptr(out)
+        )
+    _check("Allgather", rc)
+
+
+def allgather(handle, buf, size: int, algo: Optional[int] = None
+              ) -> np.ndarray:
     buf = _contig(buf)
     out = np.empty((size,) + buf.shape, buf.dtype)
-    rc = get_lib().tpucomm_allgather(
-        _i64(handle), _ptr(buf), _i64(buf.nbytes), _ptr(out)
-    )
-    _check("Allgather", rc)
+    allgather_raw(handle, buf, out, algo=algo)
     return out
 
 

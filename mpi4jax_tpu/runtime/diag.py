@@ -65,6 +65,25 @@ def check_ffi():
     return bridge.ffi_available(), "tpucomm_ffi handlers"
 
 
+def check_coll_algo_engine():
+    """The collective algorithm engine resolves a decision table."""
+    from .. import tune
+
+    info = tune.describe()
+    picks = info["picks"]
+    detail = " ".join(
+        f"{op}@1KB={picks[op]['1KB']} @16MB={picks[op]['16MB']}"
+        for op in ("allreduce", "allgather")
+    )
+    detail += " [" + "+".join(info["sources"]) + "]"
+    # the engine must agree with itself: every pick is a real algorithm
+    ok = all(
+        picks[op][k] in ("ring", "rd", "tree")
+        for op in picks for k in picks[op]
+    )
+    return ok, detail
+
+
 def check_transport_loopback(port):
     """2-rank world job over the real launcher + TCP transport."""
     import tempfile
@@ -83,7 +102,8 @@ def check_transport_loopback(port):
         "assert np.allclose(np.asarray(got), np.arange(3.0) + 1 - c.rank())\n"
         "from mpi4jax_tpu.runtime import bridge\n"
         "act, slot, ring = bridge.shm_info(c.handle)\n"
-        "print('loopback-ok shm=%%d ring_kb=%%d' %% (act, ring // 1024))\n"
+        "print('loopback-ok shm=%%d ring_kb=%%d algo16mb=%%s' %% "
+        "(act, ring // 1024, c.coll_algo('allreduce', 16 << 20)))\n"
         % REPO
     )
     with tempfile.NamedTemporaryFile(
@@ -186,6 +206,7 @@ def main(argv=None):
     checks = [
         ("native_build", check_native_build),
         ("ffi_fast_path", check_ffi),
+        ("coll_algo_engine", check_coll_algo_engine),
         ("transport_loopback", lambda: check_transport_loopback(args.port)),
     ]
     if args.device:

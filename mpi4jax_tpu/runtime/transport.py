@@ -213,6 +213,25 @@ class WorldComm:
     Clone = dup
     Split = split
 
+    def coll_algo(self, op: str, nbytes: int) -> str:
+        """Name of the collective algorithm the engine would run for
+        ``op`` ("allreduce"/"allgather") at ``nbytes`` on this comm —
+        "shm" when the same-host arena fast path serves it, else the
+        tune package's table pick (see ``mpi4jax_tpu.tune``)."""
+        from .. import tune
+        from . import bridge
+
+        code = bridge.coll_algo_for(self.handle, tune.OP_KIND[op],
+                                    int(nbytes))
+        if code is None:
+            # pre-engine .so: no table was installed and no forcing is
+            # possible, so what actually runs is the arena (when active)
+            # or the built-in heuristic — NOT the tune package's merged
+            # table; report honestly
+            active, _, _ = bridge.shm_info(self.handle)
+            return "shm" if active else tune.default_algorithm(op, nbytes)
+        return tune.ALGO_NAMES.get(code, "auto")
+
     def __repr__(self):
         kind = "WorldComm" if self._parent is None else "SubComm"
         return f"{kind}(rank={self._rank}, size={self._size})"

@@ -1,0 +1,335 @@
+"""Collective algorithm engine: selection, overrides, persistent autotuning.
+
+The world tier's TCP collectives carry selectable schedules (ring /
+recursive doubling / binomial tree — ``native/tpucomm.cc``); this package
+owns WHICH one runs.  Selection is a per-(op, payload-size-bucket)
+decision table resolved in layers, strongest last:
+
+1. static defaults (``_DEFAULT_TABLE`` — the pre-engine heuristics),
+2. the persistent autotune cache (``~/.cache/mpi4jax_tpu/tune_<size>.json``,
+   written by ``python -m mpi4jax_tpu.tune`` and loaded at communicator
+   creation),
+3. API overrides (:func:`set_algorithm`),
+4. the ``MPI4JAX_TPU_COLL_ALGO`` env var (operator kill-switch; formats
+   ``ring`` or ``allreduce=ring,allgather=tree``).
+
+The merged table is pushed into the native layer
+(``tpucomm_set_coll_table``) so every dispatch path — eager, host
+callback, and the XLA FFI fast path — resolves the algorithm per call
+from the actual payload size, with zero wire-format changes.
+
+Consistency contract: selection must be identical on every rank of a
+communicator (same cache file, same env, same override calls).  A
+divergent choice cannot corrupt data — the algorithms exchange different
+framed message schedules, so the ordered transport's tag/size/comm-id
+checks abort the job at the first mismatched frame — but it is a
+program error.  The same-host shm arena always wins over the selector
+(the engine governs the TCP/multi-host path); forced algorithms are
+no-ops on arena communicators.
+
+This module is importable without jax or the native library (pure
+stdlib) so the decision table can be inspected anywhere; only
+:func:`install` touches the native layer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# keep in sync with native/tpucomm.h (TpuCollAlgo / TpuCollOpKind)
+ALGO_CODES = {"auto": 0, "ring": 1, "rd": 2, "tree": 3, "shm": 4}
+ALGO_NAMES = {v: k for k, v in ALGO_CODES.items()}
+OPS = ("allreduce", "allgather")
+OP_KIND = {"allreduce": 0, "allgather": 1}
+
+CACHE_VERSION = 1
+
+# bucket table entries: (min_bytes ascending, algo name).  The defaults
+# mirror the pre-engine built-in heuristics in native/tpucomm.cc.
+Entry = Tuple[int, str]
+Table = Dict[str, List[Entry]]
+
+_DEFAULT_TABLE: Table = {
+    "allreduce": [(0, "tree"), (64 * 1024, "ring")],
+    "allgather": [(0, "ring")],
+}
+
+_overrides: Dict[str, Dict[int, str]] = {op: {} for op in OPS}
+_cache_table: Optional[Table] = None
+_cache_origin: Optional[str] = None  # path the cache table came from
+
+
+def _check_op(op: str) -> str:
+    if op not in OPS:
+        raise ValueError(f"unknown collective op {op!r} (expected one of {OPS})")
+    return op
+
+
+def _check_algo(algo: str) -> str:
+    name = str(algo).strip().lower()
+    if name in ("recursive_doubling", "recursive-doubling"):
+        name = "rd"
+    if name not in ALGO_CODES or name == "shm":
+        raise ValueError(
+            f"unknown collective algorithm {algo!r} "
+            "(expected auto, ring, rd, or tree)"
+        )
+    return name
+
+
+def cache_path(world_size: int) -> str:
+    """Path of the persistent autotune cache for a world size.
+
+    ``MPI4JAX_TPU_TUNE_CACHE`` overrides the full path (tests, shared
+    clusters); otherwise ``$XDG_CACHE_HOME``-aware
+    ``~/.cache/mpi4jax_tpu/tune_<size>.json``.  The file records the
+    world size it was measured at; loading it for a different size is
+    rejected (install() then warns and runs on defaults).
+    """
+    forced = os.environ.get("MPI4JAX_TPU_TUNE_CACHE")
+    if forced:
+        return forced
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return os.path.join(base, "mpi4jax_tpu", f"tune_{world_size}.json")
+
+
+def _validate_table(raw) -> Table:
+    if not isinstance(raw, dict):
+        raise ValueError("tune table must be a dict of op -> entries")
+    table: Table = {}
+    for op, entries in raw.items():
+        _check_op(op)
+        out: List[Entry] = []
+        for e in entries:
+            if not isinstance(e, (list, tuple)) or len(e) != 2:
+                raise ValueError(f"malformed tune entry for {op}: {e!r}")
+            min_bytes = int(e[0])
+            if min_bytes < 0:
+                raise ValueError(f"negative min_bytes in tune entry: {e!r}")
+            out.append((min_bytes, _check_algo(e[1])))
+        table[op] = sorted(out)
+    return table
+
+
+def load_cache(world_size: int, path: Optional[str] = None) -> Table:
+    """Parse + validate a persistent cache file; raises ``ValueError`` on
+    malformed content (a missing file raises ``FileNotFoundError``).
+    On success the table becomes the process's cache layer."""
+    global _cache_table, _cache_origin
+    p = path or cache_path(world_size)
+    with open(p) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or "table" not in data:
+        raise ValueError(f"tune cache {p} has no 'table' key")
+    if int(data.get("version", -1)) != CACHE_VERSION:
+        raise ValueError(
+            f"tune cache {p} has version {data.get('version')!r}, "
+            f"expected {CACHE_VERSION}"
+        )
+    if int(data.get("world_size", -1)) != int(world_size):
+        # a table measured at one world size must not govern another
+        # (install() downgrades this to a warning and runs on defaults)
+        raise ValueError(
+            f"tune cache {p} was measured at world size "
+            f"{data.get('world_size')!r}, this job has {world_size}"
+        )
+    table = _validate_table(data["table"])
+    _cache_table = table
+    _cache_origin = p
+    return table
+
+
+def save_cache(world_size: int, table: Table, measurements=(),
+               path: Optional[str] = None, transport: str = "tcp") -> str:
+    """Atomically write the cache file; returns its path."""
+    p = path or cache_path(world_size)
+    table = _validate_table(table)
+    os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
+    payload = {
+        "version": CACHE_VERSION,
+        "world_size": int(world_size),
+        "transport": transport,
+        "table": {op: [list(e) for e in entries]
+                  for op, entries in table.items()},
+        "measurements": list(measurements),
+    }
+    tmp = f"{p}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+    os.replace(tmp, p)
+    return p
+
+
+def _env_table() -> Table:
+    """Parse ``MPI4JAX_TPU_COLL_ALGO``: a bare algorithm name forces every
+    op; ``op=algo[,op=algo...]`` forces per op.  Raises ``ValueError`` on
+    anything else (fail-fast, like the boolean knob parser)."""
+    raw = os.environ.get("MPI4JAX_TPU_COLL_ALGO", "").strip()
+    if not raw:
+        return {}
+    table: Table = {}
+    if "=" not in raw:
+        algo = _check_algo(raw)
+        return {op: [(0, algo)] for op in OPS}
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        op, _, algo = part.partition("=")
+        table[_check_op(op.strip())] = [(0, _check_algo(algo))]
+    return table
+
+
+def set_algorithm(op: str, algo: str, min_bytes: int = 0) -> None:
+    """Force ``algo`` for ``op`` payloads >= ``min_bytes`` (the API twin
+    of ``MPI4JAX_TPU_COLL_ALGO``, which still wins when set).  Takes
+    effect immediately on live communicators — the native layer re-reads
+    the table per call."""
+    op = _check_op(op)
+    _overrides[op][int(min_bytes)] = _check_algo(algo)
+    _reinstall()
+
+
+def clear_overrides() -> None:
+    """Drop every :func:`set_algorithm` override (cache/env/defaults
+    remain in force)."""
+    for op in OPS:
+        _overrides[op].clear()
+    _reinstall()
+
+
+def decision_table() -> Table:
+    """The merged (defaults <- cache <- API overrides <- env) table."""
+    table: Table = {op: list(_DEFAULT_TABLE[op]) for op in OPS}
+    if _cache_table:
+        for op, entries in _cache_table.items():
+            table[op] = list(entries)
+    for op in OPS:
+        if _overrides[op]:
+            merged = dict(table[op])
+            # an override at min_bytes B governs [B, inf): drop inherited
+            # entries above it so e.g. set_algorithm("allreduce", "rd")
+            # at 0 really forces rd everywhere
+            lo = min(_overrides[op])
+            merged = {mb: a for mb, a in merged.items() if mb < lo}
+            merged.update(_overrides[op])
+            table[op] = sorted(merged.items())
+    for op, entries in _env_table().items():
+        table[op] = list(entries)
+    return table
+
+
+def get_algorithm(op: str, nbytes: int) -> str:
+    """The algorithm name selected for ``op`` at ``nbytes`` (TCP path;
+    the shm arena, when active, overrides this — see
+    ``WorldComm.coll_algo`` for the arena-aware probe)."""
+    op = _check_op(op)
+    entries = decision_table()[op]
+    algo = "auto"
+    for min_bytes, name in entries:
+        if int(nbytes) >= min_bytes:
+            algo = name
+    if algo == "auto":
+        # mirror the native built-in heuristic
+        if op == "allreduce":
+            algo = "ring" if int(nbytes) >= 64 * 1024 else "tree"
+        else:
+            algo = "ring"
+    return algo
+
+
+def default_algorithm(op: str, nbytes: int) -> str:
+    """The static default (pre-engine built-in heuristic) pick, ignoring
+    cache/API/env — what a pre-engine native library actually runs (it
+    has no table to install into)."""
+    op = _check_op(op)
+    algo = _DEFAULT_TABLE[op][0][1]
+    for min_bytes, name in _DEFAULT_TABLE[op]:
+        if int(nbytes) >= min_bytes:
+            algo = name
+    return algo
+
+
+def sources() -> List[str]:
+    """Which layers contribute to the current decision table."""
+    out = ["defaults"]
+    if _cache_table is not None:
+        out.append(f"cache:{_cache_origin}")
+    if any(_overrides[op] for op in OPS):
+        out.append("api")
+    if os.environ.get("MPI4JAX_TPU_COLL_ALGO", "").strip():
+        out.append("env:MPI4JAX_TPU_COLL_ALGO")
+    return out
+
+
+def describe() -> dict:
+    """Diag-friendly summary: table, sources, representative picks."""
+    table = decision_table()
+    return {
+        "sources": sources(),
+        "table": {op: [list(e) for e in entries]
+                  for op, entries in table.items()},
+        "picks": {
+            op: {"1KB": get_algorithm(op, 1024),
+                 "16MB": get_algorithm(op, 16 << 20)}
+            for op in OPS
+        },
+    }
+
+
+def entries_from_measurements(best: Dict[int, str]) -> List[Entry]:
+    """Collapse per-size winners ``{bytes: algo}`` into bucket entries:
+    the winner at size s governs [s, next measured size); the smallest
+    size's winner extends down to 0."""
+    if not best:
+        return []
+    sizes = sorted(best)
+    entries: List[Entry] = [(0, best[sizes[0]])]
+    for s in sizes[1:]:
+        if best[s] != entries[-1][1]:
+            entries.append((s, best[s]))
+    return entries
+
+
+def install(world_size: Optional[int] = None) -> bool:
+    """Load the persistent cache (if present) and push the merged
+    decision table into the native layer.  Called by
+    ``runtime.bridge.comm_init`` at communicator creation; safe to call
+    again after overrides.  Returns True when the native table was
+    pushed (False: native lib unavailable or too old)."""
+    if world_size is not None and _cache_table is None:
+        try:
+            load_cache(world_size)
+        except FileNotFoundError:
+            pass
+        except ValueError as e:
+            import warnings
+
+            warnings.warn(f"ignoring unusable tune cache: {e}")
+    return _push_native()
+
+
+def _reinstall() -> None:
+    """Re-push after an override change, but only into an already-loaded
+    native lib (never force a build from a pure-Python code path)."""
+    try:
+        from ..runtime import bridge
+    except ImportError:  # standalone import (no runtime stack around)
+        return
+    if bridge._lib is not None:
+        _push_native()
+
+
+def _push_native() -> bool:
+    from ..runtime import bridge
+
+    table = decision_table()
+    coded = {
+        OP_KIND[op]: [(mb, ALGO_CODES[name]) for mb, name in entries]
+        for op, entries in table.items()
+    }
+    return bridge.set_coll_table(coded)

@@ -1,0 +1,190 @@
+"""Offline collective autotuner CLI.
+
+    python -m mpi4jax_tpu.tune [--np 4] [--sizes 1024,...,16777216]
+                               [--repeats N] [--ops allreduce,allgather]
+                               [--cache PATH] [--port P]
+
+Sweeps every selectable algorithm (ring / recursive doubling / tree) for
+each (op, payload size) on a live job and writes the winners to the
+persistent cache (``tune.cache_path(world_size)``), which is loaded at
+communicator creation on every subsequent run — see ``tune.install``.
+
+Two modes:
+
+- **driver** (the normal invocation, outside a world job): re-executes
+  itself under the bundled launcher at ``--np`` ranks with the shm arena
+  disabled — the selector governs the TCP/multi-host path, and tuning
+  through the arena would measure the wrong transport.
+- **rank** (inside a world job): runs the sweep over the native
+  transport directly (no jit in the loop: the tuner measures the
+  wire/algorithm cost itself), agrees on per-size winners via a MAX
+  allreduce of the timings, and rank 0 writes the cache atomically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+if __package__ in (None, ""):  # executed as a file by the launcher
+    sys.path.insert(
+        0, os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    )
+
+from mpi4jax_tpu import tune
+
+# native wire codes (tpucomm.h): dtype f32 = 11, ops SUM = 0 / MAX = 2
+_F32, _F64 = 11, 12
+_SUM, _MAX = 0, 2
+
+DEFAULT_SIZES = [1 << 10, 4 << 10, 16 << 10, 64 << 10, 256 << 10,
+                 1 << 20, 4 << 20, 16 << 20]
+CANDIDATES = ("ring", "rd", "tree")
+
+
+def _parse_args(argv=None):
+    ap = argparse.ArgumentParser(prog="python -m mpi4jax_tpu.tune")
+    ap.add_argument("--np", type=int, default=4, dest="np_",
+                    help="ranks to tune for (driver mode; default 4)")
+    ap.add_argument("--sizes", default=None,
+                    help="comma-separated payload byte sizes "
+                         "(default: 1KB..16MB x4 ladder)")
+    ap.add_argument("--repeats", type=int, default=0,
+                    help="timed iterations per point (0 = auto-scale)")
+    ap.add_argument("--ops", default="allreduce,allgather")
+    ap.add_argument("--cache", default=None,
+                    help="cache file path (default: tune.cache_path(np))")
+    ap.add_argument("--port", type=int, default=None,
+                    help="launcher base port (driver mode)")
+    return ap.parse_args(argv)
+
+
+def _driver(args) -> int:
+    """Re-exec under the launcher, then report the written cache."""
+    cache = args.cache or tune.cache_path(args.np_)
+    cmd = [sys.executable, "-m", "mpi4jax_tpu.runtime.launch",
+           "-n", str(args.np_)]
+    if args.port:
+        cmd += ["--port", str(args.port)]
+    cmd += [os.path.abspath(__file__)]
+    for flag, val in (("--sizes", args.sizes),
+                      ("--repeats", args.repeats or None),
+                      ("--ops", args.ops)):
+        if val:
+            cmd += [flag, str(val)]
+    cmd += ["--cache", cache]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # tune the TCP path: the arena would hide every algorithm behind the
+    # same-host fast path (the selector governs TCP/multi-host)
+    env["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    # a forced algorithm would make every sweep point measure one
+    # schedule — the sweep must be free to force its own
+    env.pop("MPI4JAX_TPU_COLL_ALGO", None)
+    rc = subprocess.run(cmd, env=env).returncode
+    if rc == 0:
+        print(f"tune: cache written to {cache}")
+    return rc
+
+
+def _time_point(comm, bridge, np, op, nbytes, algo, repeats):
+    """Median wall time of `repeats` forced-algorithm collectives,
+    maxed across ranks (a collective is as slow as its slowest rank)."""
+    code = tune.ALGO_CODES[algo]
+    h = comm.handle
+    if op == "allreduce":
+        x = np.ones(max(nbytes // 4, 1), np.float32)
+        out = np.empty_like(x)
+
+        def run():
+            bridge.allreduce_raw(h, x, out, _F32, _SUM, algo=code)
+    else:
+        x = np.ones(max(nbytes // 4, 1), np.float32)
+        out = np.empty((comm.size(),) + x.shape, np.float32)
+
+        def run():
+            bridge.allgather_raw(h, x, out, algo=code)
+
+    run()  # warmup + cross-rank alignment on the same op count
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(repeats):
+            run()
+        times.append((time.perf_counter() - t0) / repeats)
+    dt = sorted(times)[1]
+    agreed = np.empty(1, np.float64)
+    bridge.allreduce_raw(h, np.array([dt], np.float64), agreed, _F64, _MAX)
+    return float(agreed[0])
+
+
+def _rank(args) -> int:
+    import numpy as np
+
+    from mpi4jax_tpu.runtime import bridge, transport
+
+    comm = transport.get_world_comm()
+    n = comm.size()
+    if not hasattr(bridge.get_lib(), "tpucomm_allreduce_algo"):
+        # a stale prebuilt .so without per-call forcing would make every
+        # candidate time the same default schedule — the written cache
+        # would be noise dressed up as measurements.  Fail instead.
+        print("tune: ERROR — the loaded native library predates the "
+              "algorithm engine (no tpucomm_allreduce_algo); rebuild "
+              "native/ before tuning", file=sys.stderr, flush=True)
+        return 1
+    active, _, _ = bridge.shm_info(comm.handle)
+    if active and comm.rank() == 0:
+        print("tune: WARNING — the shm arena is active; collectives take "
+              "the same-host fast path and every algorithm will measure "
+              "alike (run via the driver, which disables the arena)",
+              file=sys.stderr, flush=True)
+
+    sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
+             else DEFAULT_SIZES)
+    ops = [tune._check_op(o.strip()) for o in args.ops.split(",") if o.strip()]
+    measurements = []
+    best = {op: {} for op in ops}
+    for op in ops:
+        for nbytes in sizes:
+            repeats = args.repeats or max(3, min(30, int(3e6 / max(nbytes, 1))))
+            per_algo = {}
+            for algo in CANDIDATES:
+                dt = _time_point(comm, bridge, np, op, nbytes, algo, repeats)
+                per_algo[algo] = dt
+                measurements.append({
+                    "op": op, "bytes": nbytes, "algo": algo,
+                    "seconds": round(dt, 9), "ranks": n,
+                })
+            winner = min(per_algo, key=per_algo.get)
+            best[op][nbytes] = winner
+            if comm.rank() == 0:
+                print(json.dumps({
+                    "op": op, "bytes": nbytes, "winner": winner,
+                    "seconds": {a: round(t, 9) for a, t in per_algo.items()},
+                }), flush=True)
+
+    if comm.rank() == 0:
+        table = {op: tune.entries_from_measurements(best[op]) for op in ops}
+        path = tune.save_cache(n, table, measurements, path=args.cache)
+        print(f"tune: wrote {path}", flush=True)
+    bridge.barrier(comm.handle)  # cache is on disk before any rank exits
+    return 0
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    from mpi4jax_tpu.runtime import transport
+
+    if transport.in_world():
+        return _rank(args)
+    return _driver(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

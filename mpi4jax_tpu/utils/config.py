@@ -50,6 +50,19 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
 - ``MPI4JAX_TPU_JOBID``       — unique token for /dev/shm segment names
                                 (the launcher sets a uuid per job; read
                                 natively).
+- ``MPI4JAX_TPU_COLL_ALGO``   — force world-tier TCP collective algorithms:
+                                a bare name (``ring``/``rd``/``tree``)
+                                forces every op, ``allreduce=ring,
+                                allgather=tree`` forces per op.  Strongest
+                                layer of the selection engine
+                                (``mpi4jax_tpu/tune``); must agree across
+                                ranks.  The same-host shm arena still wins
+                                when active.
+- ``MPI4JAX_TPU_TUNE_CACHE``  — full path of the persistent autotune cache
+                                (default ``~/.cache/mpi4jax_tpu/
+                                tune_<world_size>.json``), written by
+                                ``python -m mpi4jax_tpu.tune`` and loaded
+                                at communicator creation.
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels

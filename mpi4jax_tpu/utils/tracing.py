@@ -44,9 +44,14 @@ def log_line(rank, call_id: str, message: str) -> None:
 
 
 class CallTrace:
-    """Context manager for host-side op tracing (world tier)."""
+    """Context manager for host-side op tracing (world tier).
 
-    def __init__(self, rank: int, opname: str, details: str = ""):
+    ``details`` may be a zero-arg callable, evaluated only when logging
+    is enabled — hot-path callers (e.g. the collective-algorithm name
+    lookup, a native call per op) pay nothing when tracing is off.
+    """
+
+    def __init__(self, rank: int, opname: str, details=""):
         self.rank = rank
         self.opname = opname
         self.details = details
@@ -55,8 +60,9 @@ class CallTrace:
 
     def __enter__(self):
         if logging_enabled():
+            details = self.details() if callable(self.details) else self.details
             log_line(
-                self.rank, self.call_id, f"{self.opname} {self.details}".rstrip()
+                self.rank, self.call_id, f"{self.opname} {details}".rstrip()
             )
             self._t0 = time.perf_counter()
         return self

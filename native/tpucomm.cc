@@ -8,9 +8,20 @@
  *   effects, so out-of-order arrival on one socket is a program error
  *   (matching the reference's token-ordering contract, not a message
  *   re-ordering layer).
- * - Collectives are deterministic schedules over the point-to-point layer
- *   (ring allreduce for large payloads would be a later optimization; the
- *   present schedules favor obviousness: see each function).
+ * - Collectives are deterministic schedules over the point-to-point layer.
+ *   allreduce/allgather carry SELECTABLE algorithms (ring / recursive
+ *   doubling / binomial tree — the collective algorithm engine, owned by
+ *   mpi4jax_tpu/tune): AUTO consults the decision table installed via
+ *   tpucomm_set_coll_table, per-call forcing goes through the *_algo
+ *   entry points.
+ * - Algorithm wire-protocol invariant: every algorithm is built from the
+ *   same framed point-to-point messages (tag kCollectiveTag, comm_id in
+ *   every header), so the transport's divergence checks fire identically
+ *   under every algorithm — ranks that disagree on the schedule (or on
+ *   the algorithm itself) hit the tag/size/comm-id mismatch diagnostics
+ *   and abort instead of corrupting data.  The same-host shm arena keeps
+ *   its own opword cross-check, and always wins over the selector when a
+ *   communicator has an arena (the engine governs the TCP path).
  * - Debug tracing mirrors the reference bridge's format
  *   ("r<rank> | <id> | Op ..."): entry + exit line with wall time.
  * - Fail-fast: any socket/protocol error prints to stderr and returns
@@ -1627,6 +1638,298 @@ int bcast_internal(Comm* c, void* buf, int64_t nbytes, int root) {
   return 0;
 }
 
+/* ================= collective algorithm engine (TCP path) =================
+ *
+ * allreduce/allgather carry selectable schedules; selection is owned by
+ * the Python tune package (mpi4jax_tpu/tune), which installs a per-op
+ * (min_bytes -> algorithm) decision table here at communicator creation.
+ * Per-call forcing rides the *_algo entry points.  All algorithms use
+ * the same kCollectiveTag frames as the fixed schedules they replace,
+ * so the ordered transport's divergence checks (tag/size/comm-id) keep
+ * firing identically under every algorithm — a cross-rank disagreement
+ * on the algorithm aborts at the first mismatched frame. */
+
+struct CollTable {
+  /* (min_bytes ascending, TpuCollAlgo); empty = built-in heuristic */
+  std::vector<std::pair<int64_t, int32_t>> entries;
+};
+CollTable g_coll_table[2];  // indexed by TpuCollOpKind
+std::mutex g_coll_table_mu;
+
+int coll_table_lookup(int op_kind, int64_t nbytes) {
+  std::lock_guard<std::mutex> lock(g_coll_table_mu);
+  int algo = TPU_COLL_AUTO;
+  for (const auto& e : g_coll_table[op_kind].entries) {
+    if (nbytes >= e.first) algo = e.second;
+  }
+  return algo;
+}
+
+const char* coll_algo_name(int algo) {
+  switch (algo) {
+    case TPU_COLL_RING: return "ring";
+    case TPU_COLL_RD: return "rd";
+    case TPU_COLL_TREE: return "tree";
+    case TPU_COLL_SHM: return "shm";
+    default: return "auto";
+  }
+}
+
+/* The algorithm that will serve (op_kind, nbytes, count) on comm `c`.
+ * `requested` = per-call force (AUTO -> table -> built-in heuristic).
+ * Also applies legality fixups (allgather has no recursive-doubling
+ * schedule for non-power-of-two sizes: falls back to ring), so callers
+ * log the algorithm that actually runs. */
+int resolve_coll_algo(Comm* c, int op_kind, int64_t nbytes, int64_t count,
+                      int requested) {
+  if (c->arena && c->size > 1) return TPU_COLL_SHM;
+  int algo = requested;
+  if (algo == TPU_COLL_AUTO) algo = coll_table_lookup(op_kind, nbytes);
+  if (algo == TPU_COLL_AUTO) {
+    /* built-in heuristic, identical to the pre-engine behavior */
+    if (op_kind == TPU_OPKIND_ALLREDUCE)
+      algo = (nbytes >= 64 * 1024 && count >= c->size) ? TPU_COLL_RING
+                                                       : TPU_COLL_TREE;
+    else
+      algo = TPU_COLL_RING;
+  }
+  if (op_kind == TPU_OPKIND_ALLGATHER && algo == TPU_COLL_RD &&
+      (c->size & (c->size - 1)) != 0)
+    algo = TPU_COLL_RING;
+  if (op_kind == TPU_OPKIND_ALLGATHER && algo == TPU_COLL_TREE &&
+      c->size > 200)
+    /* the gather half addresses ranks serially; keep the root's recv
+     * loop bounded on very wide worlds */
+    algo = TPU_COLL_RING;
+  return algo;
+}
+
+/* chunk [i] covers elements [i*per, min((i+1)*per, count)) */
+int64_t chunk_lo(int64_t count, int size, int i) {
+  int64_t per = (count + size - 1) / size;
+  int64_t lo = per * i;
+  return lo < count ? lo : count;
+}
+
+/* Receive one exact-size collective frame from `source` and fold it
+ * into `dst` in cache-sized blocks AS THE BYTES ARRIVE: the payload
+ * goes socket -> small hot scratch -> combine, instead of
+ * socket -> multi-MB tmp (a full RAM round trip) -> combine.  Wire
+ * format identical to recv_msg (one frame, same header checks); only
+ * the landing buffer is blocked.  TCP path only — arena comms never
+ * reach the ring schedules. */
+constexpr int64_t kCombineBlockBytes = 128 * 1024;
+
+int recv_combine_msg(Comm* c, int source, char* dst, std::vector<char>& tmp,
+                     int64_t count, int dtype, int op) {
+  const int64_t esize = dtype_size(dtype);
+  const int64_t nbytes = count * esize;
+  MsgHeader h{};
+  if (read_all(c->socks[source], &h, sizeof(h)))
+    FAIL(c, "recv header from %d failed: %s", source, std::strerror(errno));
+  if (h.comm_id != c->comm_id)
+    FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
+         "is comm %d — ops on sibling communicators must run in a "
+         "consistent order on both endpoints", source, h.comm_id,
+         c->comm_id);
+  if (h.tag != kCollectiveTag)
+    FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
+         kCollectiveTag, source, h.tag);
+  if (h.nbytes != nbytes)
+    FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
+         source, (long long)nbytes, (long long)h.nbytes);
+  for (int64_t off = 0; off < nbytes; off += kCombineBlockBytes) {
+    int64_t nb = std::min(nbytes - off, kCombineBlockBytes);
+    if (read_all(c->socks[source], tmp.data(), nb))
+      FAIL(c, "recv payload from %d failed: %s", source,
+           std::strerror(errno));
+    if (combine(dst + off, tmp.data(), nb / esize, dtype, op, c)) return 1;
+  }
+  return 0;
+}
+
+/* Chunked ring: reduce-scatter then allgather, 2*(n-1)/n of the payload
+ * on the wire per rank — the bandwidth-optimal schedule for large
+ * messages.  Handles count < size via empty chunks (zero-byte frames).
+ * The reduce-scatter receive folds blockwise while the frame streams in
+ * (recv_combine_msg) — bitwise identical to landing the whole chunk
+ * first, since elementwise combine is independent per block. */
+int ring_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype,
+                   int op) {
+  const int size = c->size, rank = c->rank;
+  const int64_t esize = dtype_size(dtype);
+  char* buf = static_cast<char*>(recvbuf);
+  int next = (rank + 1) % size, prev = (rank - 1 + size) % size;
+  std::vector<char> tmp(std::min<int64_t>(
+      kCombineBlockBytes, ((count + size - 1) / size) * esize));
+
+  /* phase 1: ring reduce-scatter — after size-1 rounds, chunk (rank+1)%size
+   * holds the full reduction */
+  for (int step = 0; step < size - 1; step++) {
+    int sc = (rank - step + size) % size;
+    int rc = (rank - step - 1 + size) % size;
+    int64_t slo = chunk_lo(count, size, sc), shi = chunk_lo(count, size, sc + 1);
+    int64_t rlo = chunk_lo(count, size, rc), rhi = chunk_lo(count, size, rc + 1);
+    SendJob job;
+    if (async_send(c, &job, next, kCollectiveTag, buf + slo * esize,
+                   (shi - slo) * esize))
+      return 1;
+    int recv_rc = recv_combine_msg(c, prev, buf + rlo * esize, tmp,
+                                   rhi - rlo, dtype, op);
+    if (wait_send(c, &job) || recv_rc) return 1;
+  }
+  /* phase 2: ring allgather of the reduced chunks */
+  for (int step = 0; step < size - 1; step++) {
+    int sc = (rank + 1 - step + size) % size;
+    int rc = (rank - step + size) % size;
+    int64_t slo = chunk_lo(count, size, sc), shi = chunk_lo(count, size, sc + 1);
+    int64_t rlo = chunk_lo(count, size, rc), rhi = chunk_lo(count, size, rc + 1);
+    SendJob job;
+    if (async_send(c, &job, next, kCollectiveTag, buf + slo * esize,
+                   (shi - slo) * esize))
+      return 1;
+    int recv_rc = recv_msg(c, prev, kCollectiveTag, buf + rlo * esize,
+                           (rhi - rlo) * esize);
+    if (wait_send(c, &job) || recv_rc) return 1;
+  }
+  return 0;
+}
+
+/* Binomial-tree reduce to rank 0 + tree bcast: 2*log2(n) serial hops —
+ * the latency-favoring schedule for small payloads (the pre-engine
+ * small-message default). */
+int tree_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype,
+                   int op) {
+  const int64_t nbytes = count * dtype_size(dtype);
+  std::vector<char> tmp(nbytes);
+  for (int mask = 1; mask < c->size; mask <<= 1) {
+    if (c->rank & mask) {
+      if (send_msg(c, c->rank - mask, kCollectiveTag, recvbuf, nbytes))
+        return 1;
+      break;
+    }
+    if (c->rank + mask < c->size) {
+      if (recv_msg(c, c->rank + mask, kCollectiveTag, tmp.data(), nbytes))
+        return 1;
+      if (combine(recvbuf, tmp.data(), count, dtype, op, c)) return 1;
+    }
+  }
+  return bcast_internal(c, recvbuf, nbytes, 0);
+}
+
+/* Recursive doubling: log2(n) rounds of pairwise full-buffer exchange —
+ * every rank holds the result with no bcast phase.  Non-power-of-two
+ * sizes use the standard fold: the first 2*rem ranks pair up (evens
+ * lend their data to odds and sit out the butterfly), the remaining
+ * power-of-two group doubles, then the evens get the result back. */
+int rd_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype, int op) {
+  const int size = c->size, rank = c->rank;
+  const int64_t nbytes = count * dtype_size(dtype);
+  int pof2 = 1;
+  while (pof2 * 2 <= size) pof2 *= 2;
+  const int rem = size - pof2;
+  std::vector<char> tmp(nbytes);
+  int newrank;
+  if (rank < 2 * rem) {
+    if ((rank & 1) == 0) {
+      if (send_msg(c, rank + 1, kCollectiveTag, recvbuf, nbytes)) return 1;
+      newrank = -1;  // sits out the butterfly
+    } else {
+      if (recv_msg(c, rank - 1, kCollectiveTag, tmp.data(), nbytes))
+        return 1;
+      if (combine(recvbuf, tmp.data(), count, dtype, op, c)) return 1;
+      newrank = rank / 2;
+    }
+  } else {
+    newrank = rank - rem;
+  }
+  if (newrank >= 0) {
+    for (int mask = 1; mask < pof2; mask <<= 1) {
+      int newpeer = newrank ^ mask;
+      int peer = newpeer < rem ? newpeer * 2 + 1 : newpeer + rem;
+      SendJob job;
+      if (async_send(c, &job, peer, kCollectiveTag, recvbuf, nbytes))
+        return 1;
+      int rc = recv_msg(c, peer, kCollectiveTag, tmp.data(), nbytes);
+      if (wait_send(c, &job) || rc) return 1;
+      if (combine(recvbuf, tmp.data(), count, dtype, op, c)) return 1;
+    }
+  }
+  if (rank < 2 * rem) {
+    if (rank & 1) {
+      if (send_msg(c, rank - 1, kCollectiveTag, recvbuf, nbytes)) return 1;
+    } else {
+      if (recv_msg(c, rank + 1, kCollectiveTag, recvbuf, nbytes)) return 1;
+    }
+  }
+  return 0;
+}
+
+/* Ring allgather: size-1 rounds, each forwarding the block received
+ * last round (the pre-engine default). */
+int ring_allgather(Comm* c, const void* sendbuf, int64_t nbytes,
+                   void* recvbuf) {
+  char* out = static_cast<char*>(recvbuf);
+  std::memcpy(out + (int64_t)c->rank * nbytes, sendbuf, nbytes);
+  int next = (c->rank + 1) % c->size;
+  int prev = (c->rank - 1 + c->size) % c->size;
+  if (c->size == 1) return 0;
+  for (int round = 0; round < c->size - 1; round++) {
+    int send_block = (c->rank - round + c->size) % c->size;
+    int recv_block = (c->rank - round - 1 + c->size) % c->size;
+    SendJob job;
+    if (async_send(c, &job, next, kCollectiveTag,
+                   out + (int64_t)send_block * nbytes, nbytes))
+      return 1;
+    int recv_rc = recv_msg(c, prev, kCollectiveTag,
+                           out + (int64_t)recv_block * nbytes, nbytes);
+    if (wait_send(c, &job) || recv_rc) return 1;
+  }
+  return 0;
+}
+
+/* Gather to rank 0 + binomial bcast of the stacked result: trades the
+ * ring's n-1 serial rounds for a serial gather + log2(n) bcast hops —
+ * wins at small payloads where per-hop latency dominates. */
+int tree_allgather(Comm* c, const void* sendbuf, int64_t nbytes,
+                   void* recvbuf) {
+  char* out = static_cast<char*>(recvbuf);
+  const int root = 0;
+  if (c->rank == root) {
+    std::memcpy(out + (int64_t)root * nbytes, sendbuf, nbytes);
+    for (int r = 0; r < c->size; r++) {
+      if (r == root) continue;
+      if (recv_msg(c, r, kCollectiveTag, out + (int64_t)r * nbytes, nbytes))
+        return 1;
+    }
+  } else {
+    if (send_msg(c, root, kCollectiveTag, sendbuf, nbytes)) return 1;
+  }
+  return bcast_internal(c, out, (int64_t)c->size * nbytes, root);
+}
+
+/* Recursive-doubling allgather (power-of-two sizes only; resolve_coll_algo
+ * degrades to ring otherwise): at step k each rank swaps its current
+ * 2^k-block group with partner rank^2^k — log2(n) rounds, same total
+ * bytes as the ring. */
+int rd_allgather(Comm* c, const void* sendbuf, int64_t nbytes,
+                 void* recvbuf) {
+  char* out = static_cast<char*>(recvbuf);
+  std::memcpy(out + (int64_t)c->rank * nbytes, sendbuf, nbytes);
+  for (int mask = 1; mask < c->size; mask <<= 1) {
+    int peer = c->rank ^ mask;
+    int64_t my_off = (int64_t)(c->rank & ~(mask - 1)) * nbytes;
+    int64_t peer_off = (int64_t)(peer & ~(mask - 1)) * nbytes;
+    int64_t len = (int64_t)mask * nbytes;
+    SendJob job;
+    if (async_send(c, &job, peer, kCollectiveTag, out + my_off, len))
+      return 1;
+    int rc = recv_msg(c, peer, kCollectiveTag, out + peer_off, len);
+    if (wait_send(c, &job) || rc) return 1;
+  }
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
@@ -2077,32 +2380,30 @@ int tpucomm_scatter(int64_t h, const void* sendbuf, void* recvbuf,
   return recv_msg(c, root, kCollectiveTag, recvbuf, nbytes);
 }
 
-int tpucomm_allgather(int64_t h, const void* sendbuf, int64_t nbytes,
-                      void* recvbuf) {
+int tpucomm_allgather_algo(int64_t h, const void* sendbuf, int64_t nbytes,
+                           void* recvbuf, int algo) {
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  int chosen = resolve_coll_algo(c, TPU_OPKIND_ALLGATHER, nbytes, 0, algo);
   LogScope log(c->rank, "Allgather",
-               [&] { return std::to_string(nbytes) + " bytes"; });
-  if (c->arena) return shm_allgather(c, sendbuf, nbytes, recvbuf, 0, true);
-  /* ring: size-1 rounds, each forwarding the chunk received last round */
-  char* out = static_cast<char*>(recvbuf);
-  std::memcpy(out + (int64_t)c->rank * nbytes, sendbuf, nbytes);
-  int next = (c->rank + 1) % c->size;
-  int prev = (c->rank - 1 + c->size) % c->size;
-  if (c->size == 1) return 0;
-  for (int round = 0; round < c->size - 1; round++) {
-    int send_block = (c->rank - round + c->size) % c->size;
-    int recv_block = (c->rank - round - 1 + c->size) % c->size;
-    SendJob job;
-    if (async_send(c, &job, next, kCollectiveTag,
-                   out + (int64_t)send_block * nbytes, nbytes))
-      return 1;
-    int recv_rc = recv_msg(c, prev, kCollectiveTag,
-                           out + (int64_t)recv_block * nbytes, nbytes);
-    if (wait_send(c, &job) || recv_rc) return 1;
+               [&] { return std::to_string(nbytes) + " bytes algo " +
+                   coll_algo_name(chosen); });
+  if (chosen == TPU_COLL_SHM)
+    return shm_allgather(c, sendbuf, nbytes, recvbuf, 0, true);
+  switch (chosen) {
+    case TPU_COLL_TREE:
+      return tree_allgather(c, sendbuf, nbytes, recvbuf);
+    case TPU_COLL_RD:
+      return rd_allgather(c, sendbuf, nbytes, recvbuf);
+    default:
+      return ring_allgather(c, sendbuf, nbytes, recvbuf);
   }
-  return 0;
+}
+
+int tpucomm_allgather(int64_t h, const void* sendbuf, int64_t nbytes,
+                      void* recvbuf) {
+  return tpucomm_allgather_algo(h, sendbuf, nbytes, recvbuf, TPU_COLL_AUTO);
 }
 
 int tpucomm_alltoall(int64_t h, const void* sendbuf, void* recvbuf,
@@ -2132,100 +2433,67 @@ int tpucomm_alltoall(int64_t h, const void* sendbuf, void* recvbuf,
   return 0;
 }
 
-namespace {
-
-/* chunk [i] covers elements [i*per, min((i+1)*per, count)) */
-int64_t chunk_lo(int64_t count, int size, int i) {
-  int64_t per = (count + size - 1) / size;
-  int64_t lo = per * i;
-  return lo < count ? lo : count;
-}
-
-int ring_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype,
-                   int op) {
-  const int size = c->size, rank = c->rank;
-  const int64_t esize = dtype_size(dtype);
-  char* buf = static_cast<char*>(recvbuf);
-  int next = (rank + 1) % size, prev = (rank - 1 + size) % size;
-  int64_t per = (count + size - 1) / size;
-  std::vector<char> tmp(per * esize);
-
-  /* phase 1: ring reduce-scatter — after size-1 rounds, chunk (rank+1)%size
-   * holds the full reduction */
-  for (int step = 0; step < size - 1; step++) {
-    int sc = (rank - step + size) % size;
-    int rc = (rank - step - 1 + size) % size;
-    int64_t slo = chunk_lo(count, size, sc), shi = chunk_lo(count, size, sc + 1);
-    int64_t rlo = chunk_lo(count, size, rc), rhi = chunk_lo(count, size, rc + 1);
-    SendJob job;
-    if (async_send(c, &job, next, kCollectiveTag, buf + slo * esize,
-                   (shi - slo) * esize))
-      return 1;
-    int recv_rc = recv_msg(c, prev, kCollectiveTag, tmp.data(),
-                           (rhi - rlo) * esize);
-    if (wait_send(c, &job) || recv_rc) return 1;
-    if (rhi > rlo &&
-        combine(buf + rlo * esize, tmp.data(), rhi - rlo, dtype, op, c))
-      return 1;
-  }
-  /* phase 2: ring allgather of the reduced chunks */
-  for (int step = 0; step < size - 1; step++) {
-    int sc = (rank + 1 - step + size) % size;
-    int rc = (rank - step + size) % size;
-    int64_t slo = chunk_lo(count, size, sc), shi = chunk_lo(count, size, sc + 1);
-    int64_t rlo = chunk_lo(count, size, rc), rhi = chunk_lo(count, size, rc + 1);
-    SendJob job;
-    if (async_send(c, &job, next, kCollectiveTag, buf + slo * esize,
-                   (shi - slo) * esize))
-      return 1;
-    int recv_rc = recv_msg(c, prev, kCollectiveTag, buf + rlo * esize,
-                           (rhi - rlo) * esize);
-    if (wait_send(c, &job) || recv_rc) return 1;
-  }
-  return 0;
-}
-
-}  // namespace
-
-int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
-                      int64_t count, int dtype, int op) {
+int tpucomm_allreduce_algo(int64_t h, const void* sendbuf, void* recvbuf,
+                           int64_t count, int dtype, int op, int algo) {
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
-  LogScope log(c->rank, "Allreduce",
-               [&] { return std::to_string(count) + " elems dtype " +
-                   std::to_string(dtype) + " op " + std::to_string(op); });
   int64_t esize = dtype_size(dtype);
   if (esize == 0) FAIL(c, "bad dtype %d", dtype);
   int64_t nbytes = count * esize;
+  int chosen = resolve_coll_algo(c, TPU_OPKIND_ALLREDUCE, nbytes, count,
+                                 algo);
+  LogScope log(c->rank, "Allreduce",
+               [&] { return std::to_string(count) + " elems dtype " +
+                   std::to_string(dtype) + " op " + std::to_string(op) +
+                   " algo " + coll_algo_name(chosen); });
   if (c->size == 1) {
     if (recvbuf != sendbuf) std::memcpy(recvbuf, sendbuf, nbytes);
     return 0;
   }
-  if (c->arena)
+  if (chosen == TPU_COLL_SHM)
     return shm_allreduce_like(c, sendbuf, recvbuf, count, dtype, op, 0, true);
   if (recvbuf != sendbuf) std::memcpy(recvbuf, sendbuf, nbytes);
-  /* large payloads: bandwidth-optimal ring (2*(n-1)/n * bytes on the wire
-   * per rank); small ones: binomial-tree reduce to rank 0 + tree bcast —
-   * 2*log2(n) serial hops instead of the n-hop chain this replaced
-   * (every serial hop is a scheduler round-trip when ranks share cores) */
-  if (nbytes >= 64 * 1024 && count >= c->size) {
-    return ring_allreduce(c, recvbuf, count, dtype, op);
+  /* ring: bandwidth-optimal, 2*(n-1)/n * bytes on the wire per rank;
+   * rd: log2(n) full-buffer exchanges; tree: binomial reduce + bcast,
+   * 2*log2(n) serial hops (every serial hop is a scheduler round-trip
+   * when ranks share cores) */
+  switch (chosen) {
+    case TPU_COLL_RING:
+      return ring_allreduce(c, recvbuf, count, dtype, op);
+    case TPU_COLL_RD:
+      return rd_allreduce(c, recvbuf, count, dtype, op);
+    default:
+      return tree_allreduce(c, recvbuf, count, dtype, op);
   }
-  std::vector<char> tmp(nbytes);
-  for (int mask = 1; mask < c->size; mask <<= 1) {
-    if (c->rank & mask) {
-      if (send_msg(c, c->rank - mask, kCollectiveTag, recvbuf, nbytes))
-        return 1;
-      break;
-    }
-    if (c->rank + mask < c->size) {
-      if (recv_msg(c, c->rank + mask, kCollectiveTag, tmp.data(), nbytes))
-        return 1;
-      if (combine(recvbuf, tmp.data(), count, dtype, op, c)) return 1;
-    }
+}
+
+int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
+                      int64_t count, int dtype, int op) {
+  return tpucomm_allreduce_algo(h, sendbuf, recvbuf, count, dtype, op,
+                                TPU_COLL_AUTO);
+}
+
+void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
+                            const int32_t* algos, int n) {
+  if (op_kind < 0 || op_kind > 1) return;
+  std::vector<std::pair<int64_t, int32_t>> entries;
+  for (int i = 0; i < n; i++) {
+    int32_t a = algos[i];
+    if (a < TPU_COLL_AUTO || a > TPU_COLL_TREE) continue;  // SHM not forcible
+    entries.emplace_back(min_bytes[i], a);
   }
-  return bcast_internal(c, recvbuf, nbytes, 0);
+  std::sort(entries.begin(), entries.end());
+  std::lock_guard<std::mutex> lock(g_coll_table_mu);
+  g_coll_table[op_kind].entries = std::move(entries);
+}
+
+int tpucomm_coll_algo_for(int64_t h, int op_kind, int64_t nbytes) {
+  Comm* c = get_comm(h);
+  if (!c || op_kind < 0 || op_kind > 1) return -1;
+  /* count only gates the built-in allreduce heuristic's ring cutoff;
+   * approximate with 4-byte elements (the table path ignores it) */
+  return resolve_coll_algo(c, op_kind, nbytes, nbytes / 4, TPU_COLL_AUTO);
 }
 
 int tpucomm_reduce(int64_t h, const void* sendbuf, void* recvbuf,

@@ -33,6 +33,25 @@ enum TpuOp {
   TPU_LAND, TPU_LOR, TPU_LXOR, TPU_BAND, TPU_BOR, TPU_BXOR,
 };
 
+/* Collective algorithm codes (keep in sync with mpi4jax_tpu/tune).
+ * AUTO consults the installed decision table (tpucomm_set_coll_table),
+ * falling back to the built-in heuristic when no table entry matches.
+ * SHM is report-only: the same-host arena fast path always wins when a
+ * communicator has one (the selector governs the TCP/multi-host path). */
+enum TpuCollAlgo {
+  TPU_COLL_AUTO = 0,
+  TPU_COLL_RING = 1,  /* chunked ring (bandwidth-optimal) */
+  TPU_COLL_RD = 2,    /* recursive doubling (latency-optimal, log2 rounds) */
+  TPU_COLL_TREE = 3,  /* binomial reduce-to-root + tree bcast */
+  TPU_COLL_SHM = 4,   /* report-only: same-host shared-memory arena */
+};
+
+/* op kinds for the per-op decision tables */
+enum TpuCollOpKind {
+  TPU_OPKIND_ALLREDUCE = 0,
+  TPU_OPKIND_ALLGATHER = 1,
+};
+
 /* Create a communicator: rank/size, base TCP port, comma-separated host
  * list ("" = all localhost). Returns handle > 0, or 0 on failure. */
 int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts);
@@ -99,6 +118,32 @@ int tpucomm_reduce(int64_t h, const void* sendbuf, void* recvbuf,
                    int64_t count, int dtype, int op, int root);
 int tpucomm_scan(int64_t h, const void* sendbuf, void* recvbuf,
                  int64_t count, int dtype, int op);
+
+/* ---- collective algorithm engine (mpi4jax_tpu/tune is the owner) ----
+ *
+ * Explicit-algorithm variants: `algo` is a TpuCollAlgo code forced for
+ * this one call (AUTO = table/heuristic selection as usual).  Every
+ * rank of a communicator must pass the SAME algorithm for the same
+ * call — the algorithms exchange different message schedules, and a
+ * divergent choice fails fast on the ordered transport's frame checks
+ * (tag/size mismatch) rather than corrupting data. */
+int tpucomm_allreduce_algo(int64_t h, const void* sendbuf, void* recvbuf,
+                           int64_t count, int dtype, int op, int algo);
+int tpucomm_allgather_algo(int64_t h, const void* sendbuf, int64_t nbytes,
+                           void* recvbuf, int algo);
+
+/* Install the process-wide decision table for one op kind: `n` entries
+ * of (min_bytes ascending, TpuCollAlgo).  A call with payload `nbytes`
+ * under AUTO picks the last entry with min_bytes <= nbytes; an empty
+ * table (n = 0) restores the built-in heuristic.  The Python tune
+ * package pushes this at communicator creation and on override. */
+void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
+                            const int32_t* algos, int n);
+
+/* Resolution probe for diag/tracing: the TpuCollAlgo code that WOULD
+ * run for (comm, op kind, payload bytes) — including TPU_COLL_SHM when
+ * the same-host arena path serves the call.  -1 for a bad handle. */
+int tpucomm_coll_algo_for(int64_t h, int op_kind, int64_t nbytes);
 
 }  /* extern "C" */
 

@@ -20,4 +20,9 @@ def test_diag_cpu_checks():
     data = json.loads(res.stdout.strip().splitlines()[-1])
     assert data["failed"] == 0
     names = {r["check"] for r in data["results"]}
-    assert names == {"native_build", "ffi_fast_path", "transport_loopback"}
+    assert names == {"native_build", "ffi_fast_path", "coll_algo_engine",
+                     "transport_loopback"}
+    # the loopback probe reports the engine's pick from a live comm
+    loopback = next(r for r in data["results"]
+                    if r["check"] == "transport_loopback")
+    assert "algo16mb=" in loopback["detail"]

@@ -1,0 +1,182 @@
+"""Unit tests for the collective algorithm engine's selection logic
+(mpi4jax_tpu/tune): defaults, env/API override layering, bucket lookup,
+and the persistent cache round-trip.  Pure stdlib — the tune package is
+importable without jax or the native transport, and these tests load it
+standalone when the full package import is unavailable."""
+
+import importlib.util
+import json
+import os
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_tune():
+    try:
+        from mpi4jax_tpu import tune
+
+        return tune
+    except ImportError:
+        # the package __init__ gates on the jax version; the engine
+        # itself is stdlib-only and documented standalone-importable
+        spec = importlib.util.spec_from_file_location(
+            "m4j_tune_standalone", REPO / "mpi4jax_tpu/tune/__init__.py"
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+
+tune = _load_tune()
+
+
+@pytest.fixture(autouse=True)
+def _clean_engine_state(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_COLL_ALGO", raising=False)
+    monkeypatch.delenv("MPI4JAX_TPU_TUNE_CACHE", raising=False)
+    tune._cache_table = None
+    tune._cache_origin = None
+    for op in tune.OPS:
+        tune._overrides[op].clear()
+    yield
+    tune._cache_table = None
+    tune._cache_origin = None
+    for op in tune.OPS:
+        tune._overrides[op].clear()
+
+
+def test_defaults_mirror_builtin_heuristic():
+    assert tune.get_algorithm("allreduce", 1024) == "tree"
+    assert tune.get_algorithm("allreduce", 64 * 1024) == "ring"
+    assert tune.get_algorithm("allreduce", 16 << 20) == "ring"
+    assert tune.get_algorithm("allgather", 1024) == "ring"
+    assert tune.sources() == ["defaults"]
+
+
+def test_env_force_all_ops(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_COLL_ALGO", "ring")
+    assert tune.get_algorithm("allreduce", 16) == "ring"
+    assert tune.get_algorithm("allgather", 16 << 20) == "ring"
+    assert "env:MPI4JAX_TPU_COLL_ALGO" in tune.sources()
+
+
+def test_env_per_op(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_COLL_ALGO", "allreduce=rd,allgather=tree")
+    assert tune.get_algorithm("allreduce", 16 << 20) == "rd"
+    assert tune.get_algorithm("allgather", 64) == "tree"
+
+
+def test_env_invalid_raises(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_COLL_ALGO", "warp-drive")
+    with pytest.raises(ValueError, match="unknown collective algorithm"):
+        tune.get_algorithm("allreduce", 64)
+    monkeypatch.setenv("MPI4JAX_TPU_COLL_ALGO", "teleport=ring")
+    with pytest.raises(ValueError, match="unknown collective op"):
+        tune.get_algorithm("allreduce", 64)
+
+
+def test_api_override_and_clear():
+    tune.set_algorithm("allreduce", "rd")
+    assert tune.get_algorithm("allreduce", 16 << 20) == "rd"
+    assert "api" in tune.sources()
+    # bucketed override: the default tree keeps the small end
+    tune.clear_overrides()
+    tune.set_algorithm("allreduce", "rd", min_bytes=1 << 20)
+    assert tune.get_algorithm("allreduce", 1024) == "tree"
+    assert tune.get_algorithm("allreduce", 2 << 20) == "rd"
+    tune.clear_overrides()
+    assert tune.get_algorithm("allreduce", 16 << 20) == "ring"
+
+
+def test_env_beats_api_override(monkeypatch):
+    tune.set_algorithm("allreduce", "tree")
+    monkeypatch.setenv("MPI4JAX_TPU_COLL_ALGO", "allreduce=ring")
+    assert tune.get_algorithm("allreduce", 64) == "ring"
+
+
+def test_algo_name_aliases():
+    tune.set_algorithm("allreduce", "recursive_doubling")
+    assert tune.get_algorithm("allreduce", 64) == "rd"
+    with pytest.raises(ValueError):
+        tune.set_algorithm("allreduce", "shm")  # report-only, not forcible
+
+
+def test_cache_round_trip(tmp_path):
+    p = tmp_path / "tune_4.json"
+    table = {"allreduce": [(0, "rd"), (1 << 20, "ring")],
+             "allgather": [(0, "ring")]}
+    meas = [{"op": "allreduce", "bytes": 1024, "algo": "rd",
+             "seconds": 1e-5}]
+    written = tune.save_cache(4, table, meas, path=str(p))
+    assert written == str(p)
+    loaded = tune.load_cache(4, path=str(p))
+    assert loaded == {"allreduce": [(0, "rd"), (1048576, "ring")],
+                      "allgather": [(0, "ring")]}
+    # the loaded cache layers under overrides/env
+    assert tune.get_algorithm("allreduce", 1024) == "rd"
+    assert tune.get_algorithm("allreduce", 2 << 20) == "ring"
+    assert any(s.startswith("cache:") for s in tune.sources())
+    data = json.loads(p.read_text())
+    assert data["version"] == tune.CACHE_VERSION
+    assert data["world_size"] == 4
+    assert data["measurements"] == meas
+
+
+def test_cache_malformed_rejected(tmp_path):
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps({"version": 1, "table": {"allreduce": [[0]]}}))
+    with pytest.raises(ValueError, match="malformed"):
+        tune.load_cache(4, path=str(p))
+    p.write_text(json.dumps({"version": 99, "table": {}}))
+    with pytest.raises(ValueError, match="version"):
+        tune.load_cache(4, path=str(p))
+    p.write_text(json.dumps({"hello": 1}))
+    with pytest.raises(ValueError, match="table"):
+        tune.load_cache(4, path=str(p))
+    with pytest.raises(FileNotFoundError):
+        tune.load_cache(4, path=str(tmp_path / "missing.json"))
+
+
+def test_cache_world_size_mismatch_rejected(tmp_path):
+    p = tmp_path / "tune_4.json"
+    tune.save_cache(4, {"allreduce": [(0, "rd")]}, path=str(p))
+    with pytest.raises(ValueError, match="world size"):
+        tune.load_cache(32, path=str(p))
+    assert tune._cache_table is None  # nothing half-loaded
+    assert tune.load_cache(4, path=str(p))  # the measured size loads
+
+
+def test_default_algorithm_ignores_overrides():
+    tune.set_algorithm("allreduce", "rd")
+    assert tune.default_algorithm("allreduce", 1024) == "tree"
+    assert tune.default_algorithm("allreduce", 16 << 20) == "ring"
+    assert tune.default_algorithm("allgather", 64) == "ring"
+
+
+def test_cache_path_knob(monkeypatch, tmp_path):
+    monkeypatch.setenv("MPI4JAX_TPU_TUNE_CACHE", str(tmp_path / "x.json"))
+    assert tune.cache_path(8) == str(tmp_path / "x.json")
+    monkeypatch.delenv("MPI4JAX_TPU_TUNE_CACHE")
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    assert tune.cache_path(8) == str(tmp_path / "mpi4jax_tpu" / "tune_8.json")
+
+
+def test_entries_from_measurements():
+    assert tune.entries_from_measurements({}) == []
+    assert tune.entries_from_measurements(
+        {1024: "tree", 65536: "ring", 262144: "ring"}
+    ) == [(0, "tree"), (65536, "ring")]
+    assert tune.entries_from_measurements(
+        {1024: "rd", 65536: "ring", 262144: "rd"}
+    ) == [(0, "rd"), (65536, "ring"), (262144, "rd")]
+
+
+def test_describe_shape():
+    info = tune.describe()
+    assert set(info) == {"sources", "table", "picks"}
+    for op in tune.OPS:
+        assert info["picks"][op]["1KB"] in ("ring", "rd", "tree")
+        assert info["picks"][op]["16MB"] in ("ring", "rd", "tree")

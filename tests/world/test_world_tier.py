@@ -99,6 +99,93 @@ def test_shm_disabled_tcp_path():
     assert res.stdout.count("full_ops OK") == 2
 
 
+@pytest.mark.parametrize("np_,shm", [(4, "on"), (4, "off"), (3, "off")])
+def test_coll_algo_equivalence(np_, shm):
+    # cross-algorithm equivalence (ring/rd/tree x {f32,i32,bf16} x
+    # {SUM,MAX} vs the default path), under the arena and under
+    # DISABLE_SHM=1; np=3 exercises the non-power-of-two rd fold
+    env = {"MPI4JAX_TPU_DISABLE_SHM": "1" if shm == "off" else ""}
+    res = run_launcher("coll_algo_ops.py", np_, timeout=300, env_extra=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("coll_algo_ops OK") == np_
+
+
+def test_coll_algo_forced_ring_axis():
+    # the forced-`ring` suite axis (mirror of the DISABLE_SHM=1 axis):
+    # the full op battery must hold with every allreduce/allgather
+    # forced onto the ring schedules over TCP
+    res = run_launcher(
+        "full_ops.py", 4, timeout=300,
+        env_extra={"MPI4JAX_TPU_COLL_ALGO": "ring",
+                   "MPI4JAX_TPU_DISABLE_SHM": "1"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("full_ops OK") == 4
+
+
+def test_tune_cli_smoke(tmp_path):
+    # the offline autotuner end to end: the CLI sweeps algorithms at
+    # np=4, writes a well-formed cache, and a SUBSEQUENT run loads and
+    # honors it (algo_report prints the engine's live picks, and debug
+    # tracing names the algorithm on the wire)
+    import json
+
+    cache = tmp_path / "tune_4.json"
+    _port[0] += 9
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.pop("MPI4JAX_TPU_COLL_ALGO", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.tune", "--np", "4",
+         "--port", str(_port[0]), "--sizes", "1024,262144",
+         "--repeats", "3", "--cache", str(cache)],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert cache.exists(), res.stdout
+
+    data = json.loads(cache.read_text())
+    assert data["version"] == 1 and data["world_size"] == 4
+    for op in ("allreduce", "allgather"):
+        entries = data["table"][op]
+        assert entries and entries[0][0] == 0
+        assert all(e[1] in ("ring", "rd", "tree") for e in entries)
+    assert data["measurements"], "tuner wrote no measurements"
+
+    # round-trip through the loader, then honor-check on a live job
+    from mpi4jax_tpu import tune
+
+    try:
+        table = tune.load_cache(4, path=str(cache))
+    finally:
+        # don't leak this cache into the pytest process's own engine state
+        tune._cache_table = None
+        tune._cache_origin = None
+    expected = {}
+    for nbytes in (1024, 262144):
+        algo = "auto"
+        for mb, name in table["allreduce"]:
+            if nbytes >= mb:
+                algo = name
+        expected[nbytes] = algo
+    res = run_launcher(
+        "algo_report.py", 4, timeout=180,
+        env_extra={"MPI4JAX_TPU_TUNE_CACHE": str(cache),
+                   "MPI4JAX_TPU_DISABLE_SHM": "1",
+                   "MPI4JAX_TPU_DEBUG": "1",
+                   "ALGO_REPORT_SIZES": "1024,262144"},
+    )
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("algo_report OK") == 4
+    for nbytes, algo in expected.items():
+        assert res.stdout.count(f"allreduce@{nbytes}={algo}") == 4, (
+            res.stdout
+        )
+    assert res.stdout.count("sources=defaults+cache:") == 4
+    # the native trace line names the algorithm that ran
+    assert "algo " + expected[262144] in res.stderr, res.stderr[-2000:]
+
+
 def test_foreign_launcher_env_adoption():
     # an mpirun-shaped environment (OMPI_COMM_WORLD_RANK/SIZE) with no
     # MPI4JAX_TPU_* vars must be adopted as the world job description —

@@ -1,0 +1,39 @@
+"""Report the engine's selected collective algorithms from a live comm.
+
+Used by the tuner smoke test: run after ``python -m mpi4jax_tpu.tune``
+with ``MPI4JAX_TPU_TUNE_CACHE`` pointing at the written cache, and the
+printed picks must match the cache's table — proof the persistent cache
+is loaded at comm creation and honored.  Also executes one allreduce so
+``MPI4JAX_TPU_DEBUG=1`` runs show the native trace line naming the
+algorithm that ran (``Allreduce ... algo <name>``).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from mpi4jax_tpu import tune
+from mpi4jax_tpu.runtime import bridge, transport
+
+
+def main():
+    comm = transport.get_world_comm()
+    h = comm.handle  # comm creation loads + installs the tune cache
+    sizes = [int(s) for s in
+             os.environ.get("ALGO_REPORT_SIZES", "1024,16777216").split(",")]
+    for nbytes in sizes:
+        x = np.ones(max(nbytes // 4, 1), np.float32)
+        out = np.empty_like(x)
+        bridge.allreduce_raw(h, x, out, 11, 0)  # f32 SUM, engine-selected
+        assert np.allclose(out, comm.size())
+        print(f"algo_report allreduce@{nbytes}="
+              f"{comm.coll_algo('allreduce', nbytes)}", flush=True)
+    print(f"algo_report sources={'+'.join(tune.sources())}", flush=True)
+    print("algo_report OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

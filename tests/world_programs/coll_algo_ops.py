@@ -1,0 +1,103 @@
+"""Cross-algorithm equivalence for the collective algorithm engine.
+
+Every selectable algorithm (ring / rd / tree) x {f32, i32, bf16} x
+{SUM, MAX} must produce results matching the default path bit-for-bit —
+except float SUM under ring/rd, whose different reduction-tree
+association order is allowed the documented fp tolerance (docs/usage.md
+§ Tuning collectives).  Runs under both shm-on and
+``MPI4JAX_TPU_DISABLE_SHM=1`` (the test drives both); on an arena comm
+the forced algorithms are no-ops (shm wins), so equivalence is exact.
+
+Deliberately bridge-level (numpy in/out, no jit): the engine lives
+under every dispatch path, and the bridge is the one that exposes
+per-call forcing.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from mpi4jax_tpu import tune
+from mpi4jax_tpu.runtime import bridge, transport
+
+# wire codes (native/tpucomm.h): SUM=0, MAX=2
+SUM, MAX = 0, 2
+
+
+def f32_to_bf16_bits(a32):
+    """Round-to-nearest-even bf16 bits, mirroring native f32_to_bf16."""
+    bits = a32.view(np.uint32)
+    rounded = bits + np.uint32(0x7FFF) + ((bits >> np.uint32(16)) & np.uint32(1))
+    return (rounded >> np.uint32(16)).astype(np.uint16)
+
+
+def main():
+    comm = transport.get_world_comm()
+    rank, size = comm.rank(), comm.size()
+    h = comm.handle
+    active, _, _ = bridge.shm_info(h)
+    rng = np.random.RandomState(7)
+
+    for count in (5, 513, 70000):  # < size, odd small, > 64KB f32 (ring cutoff)
+        base_i = rng.randint(-1000, 1000, size=(size, count)).astype(np.int32)
+        base_f = rng.randn(size, count).astype(np.float32)
+        cases = []
+        for op in (SUM, MAX):
+            cases.append(("f32", 11, base_f[rank].copy(), op))
+            cases.append(("i32", 3, base_i[rank].copy(), op))
+            # bf16 payload: truncate the f32 field (exactly representable
+            # inputs keep MAX bit-exact; SUM still reassociates)
+            bf_bits = f32_to_bf16_bits(base_f)
+            cases.append(("bf16", 10, bf_bits[rank].copy(), op))
+        for name, dcode, x, op in cases:
+            out_def = np.empty_like(x)
+            bridge.allreduce_raw(h, x, out_def, dcode, op)  # default path
+            for algo in ("ring", "rd", "tree"):
+                out = np.empty_like(x)
+                bridge.allreduce_raw(h, x, out, dcode, op,
+                                     algo=tune.ALGO_CODES[algo])
+                if name == "i32" or op == MAX or active:
+                    assert np.array_equal(out, out_def), (
+                        f"{name} op={op} algo={algo} count={count}: "
+                        f"not bit-identical to the default path"
+                    )
+                else:
+                    # float SUM: ring/rd reassociate — documented tolerance
+                    if name == "bf16":
+                        a = (out.astype(np.uint32) << 16).view(np.float32)
+                        b = (out_def.astype(np.uint32) << 16).view(np.float32)
+                        tol = dict(rtol=2e-2, atol=2e-2 * size)
+                    else:
+                        a, b = out, out_def
+                        tol = dict(rtol=1e-5, atol=1e-5 * size)
+                    assert np.allclose(a, b, **tol), (
+                        f"{name} SUM algo={algo} count={count}: "
+                        f"outside fp tolerance ({np.max(np.abs(a - b))})"
+                    )
+
+        # allgather: pure data movement — bit-for-bit under every algorithm
+        xg = (base_i[rank, :count] + 7 * rank).astype(np.int32)
+        ref = bridge.allgather(h, xg, size)
+        for algo in ("ring", "rd", "tree"):
+            got = bridge.allgather(h, xg, size, algo=tune.ALGO_CODES[algo])
+            assert np.array_equal(got, ref), (
+                f"allgather algo={algo} count={count}: mismatch"
+            )
+
+    # the probe names what ran: on an arena comm everything is "shm",
+    # on TCP the engine's table picks must match the Python-side mirror
+    for nbytes in (1024, 16 << 20):
+        picked = comm.coll_algo("allreduce", nbytes)
+        if active:
+            assert picked == "shm", picked
+        else:
+            assert picked == tune.get_algorithm("allreduce", nbytes), picked
+
+    print(f"coll_algo_ops OK (shm={int(active)})", flush=True)
+
+
+if __name__ == "__main__":
+    main()
