@@ -282,12 +282,20 @@ def _abort(opname: str, rc: int):
         if text:
             detail = f": {text}"
     except Exception:
-        pass
+        lib = None
     print(
         f"tpucomm_{opname} returned error code {rc}{detail}",
         file=sys.stderr, flush=True,
     )
-    # fail-fast across the job: peers will observe dead sockets and abort
+    # job-wide abort propagation: poison every peer socket (non-blocking)
+    # so the group tears down within one deadline instead of waiting for
+    # per-rank timeouts to cascade; peers without a pending recv still
+    # observe the shutdown sockets and abort as before
+    try:
+        if lib is not None and hasattr(lib, "tpucomm_abort_all"):
+            lib.tpucomm_abort_all()
+    except Exception:
+        pass
     os._exit(1)
 
 

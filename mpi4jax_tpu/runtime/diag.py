@@ -134,6 +134,87 @@ def check_transport_loopback(port):
     return True, detail
 
 
+def check_failure_detection(port):
+    """Transport deadlines + teardown: a deterministically hung rank is
+    detected within the configured deadline on a loopback pair, and the
+    resolved timeout knobs are reported."""
+    import tempfile
+
+    from ..utils import config
+
+    cfg_t = config.transport_timeout_s()
+    cfg_c = config.connect_timeout_s()
+    knobs = (f"timeout_s={cfg_t:g}" if cfg_t else "timeout_s=off(0)") \
+        + f" connect_s={cfg_c:g}"
+
+    deadline_s = 3.0
+    # bridge-level ranks (no jax import): rank 1's first recv hangs via
+    # the injector; rank 0's recv from it must trip the deadline and
+    # name the stuck peer, and the launcher must reap the hung rank
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import numpy as np\n"
+        "from mpi4jax_tpu.runtime import bridge, transport\n"
+        "c = transport.get_world_comm()\n"
+        "h = c.handle\n"
+        "if c.rank() == 0:\n"
+        "    bridge.send(h, np.arange(4.0), 1, 7)\n"
+        "    bridge.recv(h, (4,), np.float64, 1, 7)\n"
+        "    print('UNREACHABLE', flush=True)\n"
+        "else:\n"
+        "    bridge.recv(h, (4,), np.float64, 0, 7)\n"
+        % REPO
+    )
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_m4j_diag_fault.py", delete=False
+    ) as f:
+        f.write(code)
+        prog = f.name
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "MPI4JAX_TPU_TIMEOUT_S": str(deadline_s),
+        "MPI4JAX_TPU_DISABLE_SHM": "1",
+        "MPI4JAX_TPU_FAULT": "rank=1,point=recv,after=0,action=hang",
+    }
+    t0 = time.perf_counter()
+    # own process group: if detection regresses, killpg reaps the
+    # launcher AND its (deliberately hung-forever) ranks — a plain
+    # subprocess.run timeout would SIGKILL only the launcher and leak
+    # the injected hang as a permanent orphan
+    import signal as _signal
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mpi4jax_tpu.runtime.launch", "-n", "2",
+         "--port", str(port), prog],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env=env, cwd=REPO, start_new_session=True,
+    )
+    try:
+        out, err = proc.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, _signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            proc.kill()
+        proc.communicate()
+        return False, (f"{knobs}; injected recv-hang NOT detected "
+                       f"within 60 s (deadline {deadline_s:g} s)")
+    finally:
+        os.unlink(prog)
+    dt = time.perf_counter() - t0
+    detected = (
+        proc.returncode != 0
+        and "UNREACHABLE" not in out
+        and "timed out" in err
+        and "from 1" in err  # the stuck peer is named
+    )
+    if not detected:
+        return False, (knobs + "; " + (err.strip() or out.strip())[-180:])
+    return True, (f"{knobs}; injected recv-hang detected in {dt:.1f}s "
+                  f"({deadline_s:g}s deadline, stuck peer named)")
+
+
 def check_device_claim():
     """A fresh process can claim the accelerator."""
     rc, out, _ = _run_snippet(
@@ -208,6 +289,8 @@ def main(argv=None):
         ("ffi_fast_path", check_ffi),
         ("coll_algo_engine", check_coll_algo_engine),
         ("transport_loopback", lambda: check_transport_loopback(args.port)),
+        ("failure_detection",
+         lambda: check_failure_detection(args.port + 7)),
     ]
     if args.device:
         checks += [

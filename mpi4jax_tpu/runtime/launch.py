@@ -6,19 +6,103 @@ The reference has no launcher — users run ``mpirun -n N python prog.py``
     python -m mpi4jax_tpu.runtime.launch -n 4 prog.py [args...]
 
 Each rank becomes one process with ``MPI4JAX_TPU_RANK``/``SIZE``/``COORD``
-set; ``get_default_comm()`` then returns the :class:`WorldComm`.  Fail-fast:
-if any rank exits nonzero, the rest are terminated and the launcher exits
-with that code (the job-teardown role MPI_Abort plays in the reference).
+set; ``get_default_comm()`` then returns the :class:`WorldComm`.
+
+Failure detection & teardown (the job-reaper role MPI_Abort + the mpirun
+supervisor play in the reference):
+
+- **fail-fast**: if any rank exits nonzero, the rest are SIGTERMed (then
+  SIGKILLed after a grace period) and the launcher exits with that code,
+  printing a one-line post-mortem naming the first-failing rank and its
+  last native transport error;
+- **--timeout**: a wall-clock watchdog — when the job outlives it, the
+  whole rank group is reaped (SIGTERM -> SIGKILL) and the launcher exits
+  124, so a wedged job can never hang a scheduler slot forever;
+- **SIGTERM** (scheduler preemption) is forwarded to every rank and the
+  group is reaped before the launcher exits 143 — no orphan ranks;
+- **Ctrl-C** forwards SIGINT, waits a grace period, then escalates to
+  SIGTERM/SIGKILL and reaps (exit 130).
+
+The grace period between escalation steps is ``MPI4JAX_TPU_LAUNCH_GRACE_S``
+(default 5 seconds).
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import os
 import signal
 import subprocess
 import sys
+import threading
 import time
+
+
+def _grace_s() -> float:
+    try:
+        return max(0.1, float(os.environ.get("MPI4JAX_TPU_LAUNCH_GRACE_S",
+                                             "5")))
+    except ValueError:
+        return 5.0
+
+
+class _Terminated(Exception):
+    """Raised by the SIGTERM handler to unwind into the reap path."""
+
+
+def _pump_stderr(pipe, tail):
+    """Forward one rank's stderr verbatim, keeping a tail for the
+    post-mortem.  Verbatim matters: peers' transport diagnostics and the
+    debug-trace format must reach the launcher's stderr unchanged."""
+    try:
+        for line in iter(pipe.readline, b""):
+            tail.append(line)
+            try:
+                sys.stderr.buffer.write(line)
+                sys.stderr.buffer.flush()
+            except Exception:
+                pass
+    finally:
+        try:
+            pipe.close()
+        except Exception:
+            pass
+
+
+def _last_native_error(tail):
+    """The most recent transport diagnostic in a rank's stderr tail."""
+    for line in reversed(tail):
+        text = line.decode(errors="replace").strip()
+        if "tpucomm" in text or "returned error code" in text:
+            return text
+    for line in reversed(tail):
+        text = line.decode(errors="replace").strip()
+        if text:
+            return text
+    return ""
+
+
+def _terminate_group(procs, grace=None):
+    """SIGTERM every live rank, wait up to the grace period, SIGKILL the
+    stragglers, and reap everything — no orphans survive this call."""
+    grace = _grace_s() if grace is None else grace
+    live = [p for p in procs if p.poll() is None]
+    for p in live:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    deadline = time.time() + grace
+    for p in live:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.wait()
 
 
 def main(argv=None):
@@ -32,6 +116,11 @@ def main(argv=None):
                         help="base TCP port (default: derived from pid)")
     parser.add_argument("--platform", default=None,
                         help="JAX_PLATFORMS for the ranks (default: cpu)")
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="wall-clock watchdog: SIGTERM (then SIGKILL) "
+                             "the whole rank group after this many seconds "
+                             "and exit 124 — a wedged job is reaped, not "
+                             "inherited by the scheduler")
     parser.add_argument("--hosts", default=None,
                         help="comma-separated per-rank host list for the "
                              "native transport (pod/DCN layout; default: "
@@ -61,50 +150,153 @@ def main(argv=None):
 
     jobid = uuid.uuid4().hex[:16]
     procs = []
-    for rank in range(args.np):
-        env = dict(os.environ)
-        env["MPI4JAX_TPU_RANK"] = str(rank)
-        env["MPI4JAX_TPU_SIZE"] = str(args.np)
-        env["MPI4JAX_TPU_COORD"] = f"127.0.0.1:{base_port}"
-        env["MPI4JAX_TPU_JOBID"] = jobid
-        if args.hosts:
-            env["MPI4JAX_TPU_HOSTS"] = args.hosts
-        if args.platform:
-            env["JAX_PLATFORMS"] = args.platform
+    tails = []
+    pumps = []
+
+    # scheduler preemption (SIGTERM to the launcher) must take the whole
+    # rank group down, not orphan it — installed BEFORE the first spawn
+    # so a signal landing mid-startup still reaches the reap path.
+    # During the spawn loop itself delivery is DEFERRED, not raised: a
+    # handler firing between Popen() returning and procs.append() would
+    # otherwise reap a group missing the just-forked rank.  (Blocking
+    # the signals with pthread_sigmask instead is wrong: children
+    # inherit the blocked mask through fork+exec and would then never
+    # see forwarded signals at all.)
+    in_spawn = [True]
+    deferred = []
+
+    def _on_sigterm(signum, frame):
+        if in_spawn[0]:
+            deferred.append(_Terminated)
         else:
-            env.setdefault("JAX_PLATFORMS", "cpu")
-        procs.append(
-            subprocess.Popen(
-                [sys.executable, args.prog, *args.args], env=env
-            )
-        )
+            raise _Terminated
+
+    def _on_sigint_spawn(signum, frame):
+        deferred.append(KeyboardInterrupt)
+
+    old_term = signal.signal(signal.SIGTERM, _on_sigterm)
+    old_int = signal.getsignal(signal.SIGINT)
 
     exit_code = 0
+    first_fail = None  # (rank, exit code)
+    watchdog_fired = False
+    t_start = time.time()
+    pending = []
     try:
-        while procs:
-            for p in list(procs):
+        signal.signal(signal.SIGINT, _on_sigint_spawn)
+        for rank in range(args.np):
+            env = dict(os.environ)
+            env["MPI4JAX_TPU_RANK"] = str(rank)
+            env["MPI4JAX_TPU_SIZE"] = str(args.np)
+            env["MPI4JAX_TPU_COORD"] = f"127.0.0.1:{base_port}"
+            env["MPI4JAX_TPU_JOBID"] = jobid
+            if args.hosts:
+                env["MPI4JAX_TPU_HOSTS"] = args.hosts
+            if args.platform:
+                env["JAX_PLATFORMS"] = args.platform
+            else:
+                env.setdefault("JAX_PLATFORMS", "cpu")
+            p = subprocess.Popen(
+                [sys.executable, args.prog, *args.args], env=env,
+                stderr=subprocess.PIPE,
+            )
+            tail = collections.deque(maxlen=80)
+            pump = threading.Thread(
+                target=_pump_stderr, args=(p.stderr, tail), daemon=True
+            )
+            pump.start()
+            procs.append(p)
+            tails.append(tail)
+            pumps.append(pump)
+        in_spawn[0] = False
+        signal.signal(signal.SIGINT, old_int)
+        if deferred:
+            raise deferred[0]  # a signal arrived mid-spawn: reap now
+        pending = list(enumerate(procs))
+        while pending:
+            for rank, p in list(pending):
                 rc = p.poll()
                 if rc is None:
                     continue
-                procs.remove(p)
+                pending.remove((rank, p))
                 if rc != 0:
                     exit_code = rc
+                    if first_fail is None:
+                        first_fail = (rank, rc)
                     # fail-fast: take the rest of the job down
-                    for q in procs:
-                        q.terminate()
-                    deadline = time.time() + 5
-                    for q in procs:
-                        try:
-                            q.wait(timeout=max(0.1, deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            q.kill()
-                    procs.clear()
+                    _terminate_group([q for _, q in pending])
+                    pending.clear()
                     break
+            if pending and args.timeout is not None \
+                    and time.time() - t_start > args.timeout:
+                watchdog_fired = True
+                stuck = sorted(r for r, p in pending if p.poll() is None)
+                print(
+                    f"launch: watchdog: wall-clock timeout after "
+                    f"{args.timeout:g} s; terminating rank(s) {stuck}",
+                    file=sys.stderr, flush=True,
+                )
+                _terminate_group([q for _, q in pending])
+                pending.clear()
+                exit_code = 124
             time.sleep(0.02)
     except KeyboardInterrupt:
-        for q in procs:
-            q.send_signal(signal.SIGINT)
+        # repeated signals must not unwind the reap itself: ignore both
+        # for the remainder of the teardown
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        live = [p for p in procs if p.poll() is None]
+        for p in live:
+            try:
+                p.send_signal(signal.SIGINT)
+            except OSError:
+                pass
+        # grace, then escalate: no orphan ranks survive Ctrl-C
+        deadline = time.time() + _grace_s()
+        for p in live:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                pass
+        _terminate_group(live)
+        signal.signal(signal.SIGINT, old_int)
         exit_code = 130
+    except _Terminated:
+        # a re-delivered SIGTERM (schedulers re-signal) or a Ctrl-C
+        # during the grace wait must not raise inside this very handler
+        # and abort the reap half-way
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        _terminate_group(procs)
+        signal.signal(signal.SIGINT, old_int)
+        exit_code = 143
+    except Exception:
+        # e.g. a Popen failure mid-spawn: already-forked ranks must not
+        # outlive the launcher's own crash
+        _terminate_group(procs)
+        raise
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+        for pump in pumps:
+            pump.join(timeout=2.0)
+
+    if first_fail is not None:
+        rank, rc = first_fail
+        err = _last_native_error(tails[rank])
+        print(
+            f"launch: post-mortem: rank {rank} failed first (exit code "
+            f"{rc})" + (f"; last error: {err}" if err else ""),
+            file=sys.stderr, flush=True,
+        )
+    elif watchdog_fired:
+        print(
+            "launch: post-mortem: no rank failed — the job outlived the "
+            f"--timeout watchdog ({args.timeout:g} s); a hung transport "
+            "wait with MPI4JAX_TPU_TIMEOUT_S unset looks exactly like "
+            "this (docs/sharp-bits.md)",
+            file=sys.stderr, flush=True,
+        )
     return exit_code
 
 

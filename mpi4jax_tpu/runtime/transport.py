@@ -9,6 +9,14 @@ cross-process bytes over DCN) is played by our own TCP transport.
 Process model: one process per rank, launched by
 ``python -m mpi4jax_tpu.runtime.launch -n N prog.py`` which sets
 ``MPI4JAX_TPU_RANK`` / ``MPI4JAX_TPU_SIZE`` / ``MPI4JAX_TPU_COORD``.
+
+Failure contract: every blocking transport wait is bounded when
+``MPI4JAX_TPU_TIMEOUT_S`` is set (progress-based — the clock resets on
+any byte moved), bootstrap is bounded by
+``MPI4JAX_TPU_CONNECT_TIMEOUT_S``, and an aborting rank poisons its
+peers so the group tears down within one deadline (docs/sharp-bits.md
+§ "Hangs, timeouts, and teardown").  The knobs are read in the native
+layer; ``utils/config.py`` is the registry.
 """
 
 from __future__ import annotations

@@ -46,7 +46,49 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 ``WorldComm.from_mpi`` bootstrap
                                 (default 127.0.0.1).
 - ``MPI4JAX_TPU_SHM_TIMEOUT_S`` — shm barrier timeout seconds (default 180;
-                                read natively).
+                                read natively).  Capped by
+                                ``MPI4JAX_TPU_TIMEOUT_S`` when that is
+                                smaller.
+- ``MPI4JAX_TPU_TIMEOUT_S``   — progress-based deadline (seconds) on every
+                                blocking transport wait: send/recv/
+                                ANY_SOURCE polls, collective frames, and
+                                (as a cap) shm barrier/ring waits.  The
+                                clock resets whenever any byte moves, so
+                                slow-but-live bulk transfers survive
+                                while a hung peer trips the deadline
+                                with a diagnostic naming the op, the
+                                peer, the comm, and the bytes moved.
+                                Default 0 = no deadline (historic
+                                behavior; read natively).
+- ``MPI4JAX_TPU_CONNECT_TIMEOUT_S`` — bootstrap deadline (seconds) for
+                                dialing lower ranks (exponential
+                                backoff, last errno reported; default
+                                30, matching the old fixed spin) and —
+                                only when set explicitly — for the
+                                accept side waiting on higher ranks
+                                (read natively).
+- ``MPI4JAX_TPU_LAUNCH_GRACE_S`` — launcher teardown grace period
+                                (seconds, default 5) between escalation
+                                steps (SIGINT/SIGTERM -> SIGKILL) when
+                                reaping a rank group
+                                (runtime/launch.py).
+- ``MPI4JAX_TPU_TEST_TIMEOUT_S`` — per-test hard deadline for the
+                                world-tier suite (seconds, default 600;
+                                0 disables), enforced by
+                                tests/world/conftest.py via SIGALRM so
+                                a hung multi-process job fails its own
+                                test instead of the suite's global
+                                wall clock.
+- ``MPI4JAX_TPU_FAULT``       — deterministic fault injection in the
+                                native transport, for exercising the
+                                failure-detection paths:
+                                ``rank=R,point=send|recv|connect,
+                                after=N,action=hang|exit|close``.  On
+                                rank R the (N+1)-th op at `point` hangs
+                                forever, exits with code 17 (simulated
+                                crash), or shuts down every mesh socket
+                                (simulated partition).  A malformed
+                                spec aborts the job (read natively).
 - ``MPI4JAX_TPU_JOBID``       — unique token for /dev/shm segment names
                                 (the launcher sets a uuid per job; read
                                 natively).
@@ -122,3 +164,37 @@ def ffi_disabled() -> bool:
 
 def pallas_collectives_enabled() -> bool:
     return flag("MPI4JAX_TPU_PALLAS_COLLECTIVES")
+
+
+def _float_knob(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(f"cannot parse {name}={raw!r} as seconds")
+    # an explicit non-positive value means OFF, not "use the default" —
+    # this mirror must agree with the native parser it reports on
+    return v if v > 0 else 0.0
+
+
+def transport_timeout_s() -> float:
+    """Resolved MPI4JAX_TPU_TIMEOUT_S (seconds; 0.0 = no deadline).
+
+    The knob itself is read natively on every wait; this mirror is for
+    diagnostics (``runtime.diag``) and documentation tooling.
+    """
+    return _float_knob("MPI4JAX_TPU_TIMEOUT_S", 0.0)
+
+
+def connect_timeout_s() -> float:
+    """Resolved MPI4JAX_TPU_CONNECT_TIMEOUT_S (seconds; default 30;
+    0.0 = explicitly unbounded, matching the native parser)."""
+    return _float_knob("MPI4JAX_TPU_CONNECT_TIMEOUT_S", 30.0)
+
+
+def fault_spec():
+    """The raw MPI4JAX_TPU_FAULT spec, or None (parsed/enforced natively)."""
+    raw = os.environ.get("MPI4JAX_TPU_FAULT")
+    return raw if raw else None

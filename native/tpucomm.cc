@@ -54,6 +54,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <limits>
 #include <map>
 #include <mutex>
 #include <random>
@@ -241,12 +242,286 @@ int read_all(int fd, void* buf, int64_t n) {
     ssize_t r = ::read(fd, p, (size_t)n);
     if (r <= 0) {
       if (r < 0 && (errno == EINTR)) continue;
+      if (r == 0) errno = ECONNRESET;  // EOF: don't report stale "Success"
       return 1;
     }
     p += r;
     n -= r;
   }
   return 0;
+}
+
+/* ============== failure detection: transport deadlines ==============
+ *
+ * MPI4JAX_TPU_TIMEOUT_S bounds every blocking wait on the TCP mesh
+ * with a PROGRESS-based deadline: the clock resets whenever any byte
+ * moves, so a slow-but-live bulk transfer survives while a wedged peer
+ * (hung process, dead NIC, lost frame) trips the deadline instead of
+ * hanging the whole job forever.  0 (the default) keeps the historic
+ * infinite blocking loops bit-for-bit.  The same knob caps the shm
+ * arena's barrier/ring waits (see shm_timeout_s) so one deadline
+ * bounds the job regardless of which path a message rides. */
+
+/* Strict seconds parser: a typo'd deadline knob must stop the job, not
+ * silently arm NO deadline while the operator believes one is set (the
+ * same loud-failure contract as the fault spec and COLL_ALGO parsers).
+ * Returns the parsed value; callers clamp non-positive to their "off" /
+ * default semantics. */
+double parse_env_seconds(const char* name, double dflt) {
+  const char* e = std::getenv(name);
+  if (!e || !e[0]) return dflt;
+  char* end = nullptr;
+  double v = std::strtod(e, &end);
+  const bool converted = end != e;
+  while (end && (*end == ' ' || *end == '\t')) end++;
+  if (!converted || (end && *end)) {
+    std::fprintf(stderr, "tpucomm: cannot parse %s=%s as seconds\n", name,
+                 e);
+    std::exit(2);
+  }
+  return v;
+}
+
+double transport_timeout_s() {
+  static double v = [] {
+    double t = parse_env_seconds("MPI4JAX_TPU_TIMEOUT_S", 0.0);
+    return t > 0 ? t : 0.0;  // 0 = no deadline (historic behavior)
+  }();
+  return v;
+}
+
+/* 0 means OFF (same convention as TIMEOUT_S): dial retries forever and
+ * accept blocks forever.  Unset = the 30 s default the old fixed
+ * 600 x 50 ms retry spin gave the dial side. */
+double connect_timeout_s() {
+  static double v = [] {
+    double t = parse_env_seconds("MPI4JAX_TPU_CONNECT_TIMEOUT_S", 30.0);
+    return t > 0 ? t : 0.0;
+  }();
+  return v;
+}
+
+/* progress detail for the caller's diagnostic when a deadline fires */
+thread_local int64_t g_io_done = 0;
+thread_local int64_t g_io_want = 0;
+
+/* Deadline-bounded read/write of exactly n bytes.  Returns 0 on
+ * success, 1 on a socket error (errno describes it), 2 when the
+ * deadline passed with zero bytes of progress (g_io_done / g_io_want
+ * hold the transfer state).  `t` defaults to the job-wide knob; with
+ * that unset this IS read_all/write_all. */
+template <bool kWrite>
+int io_all_deadline(int fd, void* buf, int64_t n, double t = -1.0) {
+  if (t < 0) t = transport_timeout_s();
+  if (t <= 0)
+    return kWrite ? write_all(fd, buf, n) : read_all(fd, buf, n);
+  char* p = static_cast<char*>(buf);
+  int64_t left = n;
+  double deadline = now_s() + t;
+  while (left > 0) {
+    double remain = deadline - now_s();
+    if (remain <= 0) {
+      g_io_done = n - left;
+      g_io_want = n;
+      return 2;
+    }
+    pollfd pf{fd, (short)(kWrite ? POLLOUT : POLLIN), 0};
+    int pr = ::poll(&pf, 1, (int)std::min(remain * 1000.0 + 1, 60000.0));
+    if (pr < 0) {
+      if (errno == EINTR) continue;
+      return 1;
+    }
+    if (pr == 0) continue;  // loop re-checks the deadline
+    ssize_t m = kWrite ? ::write(fd, p, (size_t)left)
+                       : ::read(fd, p, (size_t)left);
+    if (m <= 0) {
+      if (m < 0 && (errno == EINTR || errno == EAGAIN ||
+                    errno == EWOULDBLOCK))
+        continue;
+      if (m == 0 && !kWrite) errno = ECONNRESET;  // EOF, not "Success"
+      return 1;
+    }
+    p += m;
+    left -= m;
+    deadline = now_s() + t;  // any progress resets the clock
+  }
+  return 0;
+}
+
+int read_all_dl(int fd, void* buf, int64_t n) {
+  return io_all_deadline<false>(fd, buf, n);
+}
+
+int write_all_dl(int fd, const void* buf, int64_t n) {
+  return io_all_deadline<true>(fd, const_cast<void*>(buf), n);
+}
+
+/* Caller-side diagnostic for a *_dl result: rc 2 = deadline (op, peer,
+ * comm, and bytes-progressed detail), rc 1 = the historic errno text.
+ * dir_fmt is a printf format with one %d for the peer rank, e.g.
+ * "send to %d" — the rc 1 message matches the pre-deadline wording. */
+#define FAIL_IO(comm, rc, dir_fmt, peer)                                    \
+  do {                                                                      \
+    if ((rc) == 2)                                                          \
+      FAIL(comm,                                                            \
+           dir_fmt " timed out after %.0f s on comm %d with %lld/%lld "     \
+                   "bytes moved — the peer is hung or unreachable "         \
+                   "(MPI4JAX_TPU_TIMEOUT_S)",                               \
+           peer, transport_timeout_s(), (comm)->comm_id,                    \
+           (long long)g_io_done, (long long)g_io_want);                     \
+    FAIL(comm, dir_fmt " failed: %s", peer, std::strerror(errno));          \
+  } while (0)
+
+/* ============== deterministic fault injection ==============
+ *
+ * MPI4JAX_TPU_FAULT=rank=R,point=send|recv|connect,after=N,action=hang|exit|close
+ * arms exactly one fault in the native layer: on rank R, the (N+1)-th
+ * operation at the given point (N defaults to 0) either hangs forever,
+ * exits the process (code 17, simulating a crash), or shuts down every
+ * mesh socket (simulating a network partition).  This is how the
+ * timeout / abort-propagation / watchdog paths are exercised by real
+ * multi-process tests — a typo'd spec fails the job loudly instead of
+ * silently injecting nothing. */
+
+enum FaultPoint { FP_NONE = 0, FP_SEND, FP_RECV, FP_CONNECT };
+enum FaultAction { FA_NONE = 0, FA_HANG, FA_EXIT, FA_CLOSE };
+
+struct FaultSpec {
+  bool armed = false;
+  int rank = -1;
+  int point = FP_NONE;
+  long long after = 0;
+  int action = FA_NONE;
+  std::atomic<long long> hits{0};
+};
+FaultSpec g_fault;
+std::once_flag g_fault_once;
+/* the spec's rank=R is a JOB rank: comm-local ranks diverge on split
+ * sub-comms, so injection keys on the rank this process was born with */
+int g_job_rank = -1;
+
+void fault_parse() {
+  const char* e = std::getenv("MPI4JAX_TPU_FAULT");
+  if (!e || !e[0]) return;
+  int rank = -1, point = FP_NONE, action = FA_NONE;
+  long long after = 0;
+  bool ok = true;
+  std::string s(e);
+  size_t pos = 0;
+  while (pos < s.size() && ok) {
+    size_t comma = s.find(',', pos);
+    std::string kv = s.substr(pos, comma == std::string::npos
+                                       ? std::string::npos
+                                       : comma - pos);
+    pos = comma == std::string::npos ? s.size() : comma + 1;
+    size_t eq = kv.find('=');
+    if (eq == std::string::npos) {
+      ok = false;
+      break;
+    }
+    std::string k = kv.substr(0, eq), v = kv.substr(eq + 1);
+    /* numeric fields parse strictly: atoi("x") == 0 would silently arm
+     * the fault on rank 0 — the fake-green failure mode this parser's
+     * loud-exit contract exists to prevent */
+    auto parse_ll = [&ok](const std::string& s, long long* out) {
+      char* end = nullptr;
+      long long n = std::strtoll(s.c_str(), &end, 10);
+      if (end == s.c_str() || *end) ok = false;
+      *out = n;
+    };
+    if (k == "rank") {
+      long long r = -1;
+      parse_ll(v, &r);
+      rank = (int)r;
+    } else if (k == "after") {
+      parse_ll(v, &after);
+    } else if (k == "point") {
+      point = v == "send" ? FP_SEND
+              : v == "recv" ? FP_RECV
+              : v == "connect" ? FP_CONNECT
+                               : FP_NONE;
+      ok = point != FP_NONE;
+    } else if (k == "action") {
+      action = v == "hang" ? FA_HANG
+               : v == "exit" ? FA_EXIT
+               : v == "close" ? FA_CLOSE
+                              : FA_NONE;
+      ok = action != FA_NONE;
+    } else {
+      ok = false;
+    }
+  }
+  if (!ok || rank < 0 || point == FP_NONE || action == FA_NONE) {
+    std::fprintf(stderr,
+                 "tpucomm: malformed MPI4JAX_TPU_FAULT spec %s (expected "
+                 "rank=R,point=send|recv|connect[,after=N],"
+                 "action=hang|exit|close)\n",
+                 e);
+    std::exit(2);  // silently injecting nothing would fake a green test
+  }
+  g_fault.rank = rank;
+  g_fault.point = point;
+  g_fault.after = after;
+  g_fault.action = action;
+  g_fault.armed = true;
+}
+
+void fault_init() { std::call_once(g_fault_once, fault_parse); }
+
+/* Fire the armed fault if (rank, point) match and `after` ops have
+ * already passed this point.  `c` may be null at the connect point. */
+void fault_fire(Comm* c, int rank, int point, const char* what) {
+  if (!g_fault.armed || g_fault.rank != rank || g_fault.point != point)
+    return;
+  if (g_fault.hits.fetch_add(1, std::memory_order_relaxed) < g_fault.after)
+    return;
+  const char* action = g_fault.action == FA_HANG ? "hang"
+                       : g_fault.action == FA_EXIT ? "exit"
+                                                   : "close";
+  std::fprintf(stderr,
+               "tpucomm r%d: fault injection: %s at point=%s "
+               "(MPI4JAX_TPU_FAULT)\n",
+               rank, action, what);
+  std::fflush(stderr);
+  switch (g_fault.action) {
+    case FA_HANG:
+      for (;;) std::this_thread::sleep_for(std::chrono::seconds(3600));
+    case FA_EXIT:
+      std::_Exit(17);
+    case FA_CLOSE:
+      /* shutdown (not close): other threads may hold the fds; all
+       * their I/O now fails/EOFs, exactly like a yanked cable */
+      if (c)
+        for (int fd : c->lock_root->socks)
+          if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+      g_fault.armed = false;  // a partition happens once
+      break;
+    default:
+      break;
+  }
+}
+
+/* ============== job-wide abort propagation (poison frames) ==============
+ *
+ * When this process aborts (any FAIL surfacing to the Python bridge),
+ * tpucomm_abort_all best-effort writes one poison control frame —
+ * kPoisonTag header + this process's last-error text — to every peer
+ * socket and shuts the sockets down.  A peer blocked in any recv path
+ * consumes the poison and fails immediately naming the aborting rank,
+ * so the group tears down within one deadline instead of waiting for
+ * timeouts to cascade rank by rank. */
+constexpr int32_t kPoisonTag = -7707;
+
+/* Consume a poison frame whose header is already read; always fails. */
+int poison_fail(Comm* c, int source, const MsgHeader& h) {
+  char text[448] = {0};
+  int64_t nb = std::min<int64_t>(h.nbytes, (int64_t)sizeof(text) - 1);
+  /* best effort: the aborter shuts the socket down right after the
+   * frame, so a partial payload ends in EOF, not a hang */
+  if (nb > 0) read_all_dl(c->socks[source], text, nb);
+  text[sizeof(text) - 1] = 0;
+  FAIL(c, "rank %d aborted the job: %s", source,
+       text[0] ? text : "(no detail)");
 }
 
 void self_deliver(Comm* c, int tag, const void* buf, int64_t nbytes) {
@@ -257,10 +532,11 @@ void self_deliver(Comm* c, int tag, const void* buf, int64_t nbytes) {
 
 int send_msg_tcp(Comm* c, int dest, int tag, const void* buf,
                  int64_t nbytes) {
+  fault_fire(c, g_job_rank, FP_SEND, "send");
   MsgHeader h{nbytes, tag, c->comm_id};
-  if (write_all(c->socks[dest], &h, sizeof(h)) ||
-      write_all(c->socks[dest], buf, nbytes))
-    FAIL(c, "send to %d failed: %s", dest, std::strerror(errno));
+  int rc = write_all_dl(c->socks[dest], &h, sizeof(h));
+  if (!rc) rc = write_all_dl(c->socks[dest], buf, nbytes);
+  if (rc) FAIL_IO(c, rc, "send to %d", dest);
   return 0;
 }
 
@@ -283,13 +559,29 @@ void writer_loop(Comm* root) {
     SendJob* j = root->wq.front();
     root->wq.pop_front();
     lock.unlock();
+    /* large frames never reach send_msg_tcp's injector hook — a
+     * point=send fault must be able to wedge/kill big transfers too
+     * (hang here hangs the whole rank: wait_send then never returns,
+     * which is exactly the wedged-peer shape the deadlines detect) */
+    fault_fire(nullptr, g_job_rank, FP_SEND, "send");
     int rc = 0;
-    if (write_all(j->fd, &j->hdr, sizeof(j->hdr)) ||
-        write_all(j->fd, j->buf, j->hdr.nbytes)) {
+    int io = write_all_dl(j->fd, &j->hdr, sizeof(j->hdr));
+    if (!io) io = write_all_dl(j->fd, j->buf, j->hdr.nbytes);
+    if (io) {
+      /* wait_send is an unbounded cv wait — this deadline is what keeps
+       * it bounded when the peer stops draining the socket */
+      char why[160];
+      if (io == 2)
+        std::snprintf(why, sizeof(why),
+                      "timed out after %.0f s with %lld/%lld bytes moved "
+                      "(MPI4JAX_TPU_TIMEOUT_S)",
+                      transport_timeout_s(), (long long)g_io_done,
+                      (long long)g_io_want);
+      else
+        std::snprintf(why, sizeof(why), "%s", std::strerror(errno));
       std::fprintf(stderr, "tpucomm r%d: async send to %d failed: %s\n",
-                   j->rank, j->dest, std::strerror(errno));
-      set_last_error(j->rank, "async send to %d failed: %s", j->dest,
-                     std::strerror(errno));
+                   j->rank, j->dest, why);
+      set_last_error(j->rank, "async send to %d failed: %s", j->dest, why);
       rc = 1;
     }
     lock.lock();
@@ -380,6 +672,7 @@ constexpr int kCollectiveTag = -7701;
  * mean the peer raced ahead into a collective we will run later, and
  * must never be consumed as user data. */
 bool header_matches(const Comm* c, const MsgHeader& h, int tag) {
+  if (h.tag == kPoisonTag) return false;  // never user data: a peer abort
   if (h.comm_id != c->comm_id) return false;
   if (tag == kAnyTag) return h.tag != kCollectiveTag;
   return h.tag == tag;
@@ -402,12 +695,28 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
     ranks.push_back(r);
   }
   if (fds.empty()) FAIL(c, "ANY_SOURCE recv with no peers");
+  const double t = transport_timeout_s();
+  double deadline = t > 0 ? now_s() + t : 0;
+  /* per-candidate peeked-header byte counts: the deadline must reset on
+   * actual byte PROGRESS, not on poll readiness — a peer stalled
+   * mid-header keeps POLLIN asserted forever, which would both defeat
+   * the timeout and busy-spin the level-triggered poll */
+  std::vector<int64_t> peeked(ranks.size(), 0);
   for (;;) {
-    int n = ::poll(fds.data(), fds.size(), -1);
+    int n = ::poll(fds.data(), fds.size(), t > 0 ? 100 : -1);
     if (n < 0) {
       if (errno == EINTR) continue;
       FAIL(c, "ANY_SOURCE poll failed: %s", std::strerror(errno));
     }
+    if (n == 0) {
+      if (t > 0 && now_s() > deadline)
+        FAIL(c,
+             "ANY_SOURCE recv timed out after %.0f s — no peer delivered "
+             "a matching message (MPI4JAX_TPU_TIMEOUT_S)",
+             t);
+      continue;
+    }
+    bool progress = false;
     std::vector<size_t> dead;
     for (size_t i = 0; i < fds.size(); i++) {
       if (fds[i].revents & POLLIN) {
@@ -417,6 +726,10 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
         ssize_t p = ::recv(fds[i].fd, &h, sizeof(h),
                            MSG_PEEK | MSG_DONTWAIT);
         if (p == (ssize_t)sizeof(h)) {
+          if (h.tag == kPoisonTag) {
+            ::recv(fds[i].fd, &h, sizeof(h), MSG_DONTWAIT);  // consume hdr
+            return poison_fail(c, ranks[i], h);
+          }
           if (header_matches(c, h, tag)) {
             *out_source = ranks[i];
             return 0;
@@ -425,15 +738,34 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
         } else if (p == 0 || (p < 0 && errno != EAGAIN &&
                               errno != EWOULDBLOCK && errno != EINTR)) {
           dead.push_back(i);
+        } else if (p > 0 && (int64_t)p > peeked[i]) {
+          peeked[i] = p;  // header still arriving: real byte progress
+          progress = true;
         }
-        /* 0 < p < sizeof(h): header still arriving — poll again */
       } else if (fds[i].revents & (POLLHUP | POLLERR)) {
         dead.push_back(i);
+      }
+    }
+    if (t > 0) {
+      if (progress || !dead.empty()) {
+        deadline = now_s() + t;
+      } else {
+        /* only stalled partial headers keep POLLIN raised with nothing
+         * to do: the deadline must be checked HERE too (poll keeps
+         * returning ready, so the n == 0 check above never runs), and
+         * the loop paced so it can fire without burning a core */
+        if (now_s() > deadline)
+          FAIL(c,
+               "ANY_SOURCE recv timed out after %.0f s — a peer stalled "
+               "mid-frame (MPI4JAX_TPU_TIMEOUT_S)",
+               t);
+        std::this_thread::sleep_for(std::chrono::milliseconds(2));
       }
     }
     for (size_t k = dead.size(); k-- > 0;) {
       fds.erase(fds.begin() + dead[k]);
       ranks.erase(ranks.begin() + dead[k]);
+      peeked.erase(peeked.begin() + dead[k]);
     }
     if (fds.empty())
       FAIL(c, "ANY_SOURCE recv: no peer can deliver a matching message "
@@ -448,6 +780,7 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
  * contract collectives rely on. */
 int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
                     int32_t* out_src, int32_t* out_tag, int64_t* out_count) {
+  fault_fire(c, g_job_rank, FP_RECV, "recv");
   if (source == kAnySource) {
     /* a queued self-message is already complete — it wins immediately,
      * but only when its header actually matches the tag filter (a
@@ -488,8 +821,9 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
                            out_count);
   if (out_src) *out_src = source;
   MsgHeader h{};
-  if (read_all(c->socks[source], &h, sizeof(h)))
-    FAIL(c, "recv header from %d failed: %s", source, std::strerror(errno));
+  int rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  if (rc) FAIL_IO(c, rc, "recv header from %d", source);
+  if (h.tag == kPoisonTag) return poison_fail(c, source, h);
   if (h.comm_id != c->comm_id)
     FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
          "is comm %d — ops on sibling communicators must run in a "
@@ -501,8 +835,8 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
   if (h.nbytes > nbytes)
     FAIL(c, "message truncated: rank %d sent %lld bytes into a %lld-byte "
          "buffer", source, (long long)h.nbytes, (long long)nbytes);
-  if (read_all(c->socks[source], buf, h.nbytes))
-    FAIL(c, "recv payload from %d failed: %s", source, std::strerror(errno));
+  rc = read_all_dl(c->socks[source], buf, h.nbytes);
+  if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
   if (out_tag) *out_tag = h.tag;
   if (out_count) *out_count = h.nbytes;
   return 0;
@@ -842,11 +1176,18 @@ int64_t ring_round(int64_t n) { return (n + 15) & ~int64_t(15); }
 bool peer_socket_dead(const std::vector<int>& socks, int r) {
   int fd = r >= 0 && r < (int)socks.size() ? socks[r] : -1;
   if (fd < 0) return false;  // self or never-connected: no evidence
-  char b;
-  ssize_t p = ::recv(fd, &b, 1, MSG_PEEK | MSG_DONTWAIT);
+  char b[sizeof(MsgHeader)];
+  ssize_t p = ::recv(fd, b, sizeof(b), MSG_PEEK | MSG_DONTWAIT);
   if (p == 0) return true;
   if (p < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
     return true;
+  if (p == (ssize_t)sizeof(MsgHeader)) {
+    /* a poison control frame means the peer is aborting the job: treat
+     * it as dead so shm waiters tear down within one probe interval */
+    MsgHeader h{};
+    std::memcpy(&h, b, sizeof(h));
+    if (h.tag == kPoisonTag) return true;
+  }
   return false;
 }
 
@@ -895,7 +1236,12 @@ void arena_destroy(ShmArena* a) {
 double shm_timeout_s() {
   const char* e = std::getenv("MPI4JAX_TPU_SHM_TIMEOUT_S");
   double v = e && e[0] ? std::atof(e) : 180.0;
-  return v > 0 ? v : 180.0;
+  if (v <= 0) v = 180.0;
+  /* the job-wide transport deadline caps shm waits too, so one knob
+   * bounds every blocking wait regardless of the path a message rides */
+  double t = transport_timeout_s();
+  if (t > 0 && t < v) v = t;
+  return v;
 }
 
 /* Non-temporal streaming copy: bypasses the cache and skips the
@@ -1231,6 +1577,10 @@ int ring_poll_any(Comm* c, int tag, int* out_source) {
 
 int shm_try_send(Comm* c, int dest, int tag, const void* buf,
                  int64_t nbytes, bool* inlined) {
+  /* a send that rides the shm rings never reaches send_msg_tcp, so the
+   * injector needs its own hook here (point=send counts transmissions:
+   * a stub-degraded send also pays the TCP-payload count) */
+  fault_fire(c, g_job_rank, FP_SEND, "send");
   ShmArena* a = c->arena;
   RingHdr* rh = a->ring_hdr(c->rank, dest);
   int64_t need = (int64_t)sizeof(RingFrame) + ring_round(nbytes);
@@ -1264,9 +1614,9 @@ int shm_recv_status(Comm* c, int source, int tag, void* buf,
     /* payload is the next TCP frame from this peer; the usual header
        checks keep cross-communicator socket order honest */
     MsgHeader h{};
-    if (read_all(c->socks[source], &h, sizeof(h)))
-      FAIL(c, "recv header from %d failed: %s", source,
-           std::strerror(errno));
+    int rc = read_all_dl(c->socks[source], &h, sizeof(h));
+    if (rc) FAIL_IO(c, rc, "recv header from %d", source);
+    if (h.tag == kPoisonTag) return poison_fail(c, source, h);
     if (h.comm_id != c->comm_id)
       FAIL(c, "communicator mismatch: rank %d's message is for comm %d, "
            "this is comm %d — ops on sibling communicators must run in a "
@@ -1276,9 +1626,8 @@ int shm_recv_status(Comm* c, int source, int tag, void* buf,
       FAIL(c, "shm stub/TCP frame mismatch from rank %d (tag %d/%d, "
            "bytes %lld/%lld)", source, f.tag, h.tag, (long long)f.nbytes,
            (long long)h.nbytes);
-    if (read_all(c->socks[source], buf, h.nbytes))
-      FAIL(c, "recv payload from %d failed: %s", source,
-           std::strerror(errno));
+    rc = read_all_dl(c->socks[source], buf, h.nbytes);
+    if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
   } else {
     RingHdr* rh = a->ring_hdr(source, c->rank);
     uint64_t tail = rh->tail.load(std::memory_order_relaxed);
@@ -1567,8 +1916,10 @@ void arena_init(Comm* c) {
     return;
   }
   nonce = wire;
+  /* attach waits are bounded by 30 s, tightened by the job deadline */
+  const double attach_wait_s = std::min(30.0, shm_timeout_s());
   if (c->rank != 0) {
-    double deadline = now_s() + 30.0;
+    double deadline = now_s() + attach_wait_s;
     for (;;) {
       int fd = ::shm_open(name, O_RDWR, 0600);
       if (fd >= 0) {
@@ -1604,7 +1955,7 @@ void arena_init(Comm* c) {
     a->hdr()->attached.fetch_add(1, std::memory_order_acq_rel);
   }
   /* everyone waits for full attachment, then the name disappears */
-  double deadline = now_s() + 30.0;
+  double deadline = now_s() + attach_wait_s;
   while (a->hdr()->attached.load(std::memory_order_acquire) < c->size) {
     if (now_s() > deadline) {
       std::fprintf(stderr, "tpucomm r%d: shm arena attach wait timed out\n",
@@ -1722,11 +2073,13 @@ constexpr int64_t kCombineBlockBytes = 128 * 1024;
 
 int recv_combine_msg(Comm* c, int source, char* dst, std::vector<char>& tmp,
                      int64_t count, int dtype, int op) {
+  fault_fire(c, g_job_rank, FP_RECV, "recv");
   const int64_t esize = dtype_size(dtype);
   const int64_t nbytes = count * esize;
   MsgHeader h{};
-  if (read_all(c->socks[source], &h, sizeof(h)))
-    FAIL(c, "recv header from %d failed: %s", source, std::strerror(errno));
+  int rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  if (rc) FAIL_IO(c, rc, "recv header from %d", source);
+  if (h.tag == kPoisonTag) return poison_fail(c, source, h);
   if (h.comm_id != c->comm_id)
     FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
          "is comm %d — ops on sibling communicators must run in a "
@@ -1740,9 +2093,8 @@ int recv_combine_msg(Comm* c, int source, char* dst, std::vector<char>& tmp,
          source, (long long)nbytes, (long long)h.nbytes);
   for (int64_t off = 0; off < nbytes; off += kCombineBlockBytes) {
     int64_t nb = std::min(nbytes - off, kCombineBlockBytes);
-    if (read_all(c->socks[source], tmp.data(), nb))
-      FAIL(c, "recv payload from %d failed: %s", source,
-           std::strerror(errno));
+    rc = read_all_dl(c->socks[source], tmp.data(), nb);
+    if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
     if (combine(dst + off, tmp.data(), nb / esize, dtype, op, c)) return 1;
   }
   return 0;
@@ -1937,6 +2289,9 @@ extern "C" {
 void tpucomm_set_logging(int enabled) { g_logging = enabled; }
 
 int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
+  fault_init();
+  g_job_rank = rank;
+  fault_fire(nullptr, rank, FP_CONNECT, "connect");
   auto* c = new Comm;
   c->rank = rank;
   c->size = size;
@@ -1975,23 +2330,76 @@ int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
     }
   }
 
-  /* dial every lower rank (with retries while they come up) */
+  /* dial every lower rank (retrying while they come up): deadline-bounded
+   * with exponential backoff instead of the old fixed 600 x 50 ms spin;
+   * the failure names the last errno so a refused port reads differently
+   * from an unroutable host */
+  const double connect_dl = connect_timeout_s();  // 0 = unbounded
   for (int peer = 0; peer < rank; peer++) {
     int fd = -1;
-    for (int attempt = 0; attempt < 600; attempt++) {
+    int last_errno = 0;
+    double deadline = connect_dl > 0
+                          ? now_s() + connect_dl
+                          : std::numeric_limits<double>::infinity();
+    double backoff_ms = 1.0;
+    for (;;) {
       fd = ::socket(AF_INET, SOCK_STREAM, 0);
       sockaddr_in addr{};
       addr.sin_family = AF_INET;
       addr.sin_port = htons((uint16_t)(base_port + peer));
       ::inet_pton(AF_INET, host_list[peer].c_str(), &addr.sin_addr);
-      if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) == 0) break;
+      /* non-blocking connect + poll: a blackholed host must consume at
+       * most the remaining deadline, not the kernel's ~2 min SYN
+       * retransmit cycle (the deadline is the contract, and the error
+       * text reports the elapsed budget) */
+      int fl = ::fcntl(fd, F_GETFL, 0);
+      ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+      int cr = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
+      if (cr != 0 && errno == EINPROGRESS) {
+        double remain = deadline - now_s();
+        pollfd pf{fd, POLLOUT, 0};
+        int pr = remain > 0
+                     ? ::poll(&pf, 1, (int)std::min(remain * 1000.0 + 1,
+                                                    60000.0))
+                     : 0;
+        if (pr > 0) {
+          int soerr = 0;
+          socklen_t sl = sizeof(soerr);
+          ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &sl);
+          if (soerr == 0) {
+            cr = 0;
+          } else {
+            errno = soerr;
+            cr = -1;
+          }
+        } else {
+          errno = ETIMEDOUT;
+          cr = -1;
+        }
+      }
+      if (cr == 0) {
+        ::fcntl(fd, F_SETFL, fl);  // back to blocking for the handshake
+        break;
+      }
+      last_errno = errno;
       ::close(fd);
       fd = -1;
-      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      if (now_s() + backoff_ms / 1000.0 > deadline) break;
+      std::this_thread::sleep_for(
+          std::chrono::microseconds((int64_t)(backoff_ms * 1000)));
+      backoff_ms = std::min(backoff_ms * 2.0, 200.0);
     }
     if (fd < 0) {
-      std::fprintf(stderr, "tpucomm r%d: cannot reach rank %d (%s:%d)\n",
-                   rank, peer, host_list[peer].c_str(), base_port + peer);
+      std::fprintf(stderr,
+                   "tpucomm r%d: cannot reach rank %d (%s:%d) within "
+                   "%.0f s: %s (MPI4JAX_TPU_CONNECT_TIMEOUT_S)\n",
+                   rank, peer, host_list[peer].c_str(), base_port + peer,
+                   connect_dl, std::strerror(last_errno));
+      set_last_error(rank,
+                     "bootstrap connect to rank %d (%s:%d) timed out after "
+                     "%.0f s: %s", peer, host_list[peer].c_str(),
+                     base_port + peer, connect_dl,
+                     std::strerror(last_errno));
       delete c;
       return 0;
     }
@@ -2005,8 +2413,37 @@ int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
     c->socks[peer] = fd;
   }
 
-  /* accept every higher rank */
+  /* accept every higher rank.  Bounded by the connect deadline only
+   * when MPI4JAX_TPU_CONNECT_TIMEOUT_S is set explicitly: the historic
+   * default blocks forever (ranks may start far apart), but an operator
+   * who bounded the dial side wants the listen side bounded too — a
+   * missing higher rank hangs accept exactly like a missing lower rank
+   * hangs connect. */
+  const char* connect_env = std::getenv("MPI4JAX_TPU_CONNECT_TIMEOUT_S");
+  const bool bounded_accept = connect_env && connect_env[0] &&
+                              connect_dl > 0;
   for (int expected = rank + 1; expected < size; expected++) {
+    if (bounded_accept) {
+      double deadline = now_s() + connect_dl;
+      int pr = 0;
+      do {
+        pollfd pf{listen_fd, POLLIN, 0};
+        pr = ::poll(&pf, 1, 100);
+      } while (pr <= 0 && now_s() < deadline);
+      if (pr <= 0) {
+        std::fprintf(stderr,
+                     "tpucomm r%d: no higher rank dialed within %.0f s "
+                     "(%d of %d peers still missing; "
+                     "MPI4JAX_TPU_CONNECT_TIMEOUT_S)\n",
+                     rank, connect_dl, size - expected, size - rank - 1);
+        set_last_error(rank,
+                       "bootstrap accept timed out after %.0f s with %d "
+                       "higher rank(s) missing", connect_dl,
+                       size - expected);
+        delete c;
+        return 0;
+      }
+    }
     int fd = ::accept(listen_fd, nullptr, nullptr);
     if (fd < 0) {
       std::fprintf(stderr, "tpucomm r%d: accept failed: %s\n", rank,
@@ -2017,7 +2454,16 @@ int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
     int one = 1;
     ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     int32_t peer_rank = -1;
-    if (read_all(fd, &peer_rank, sizeof(peer_rank)) || peer_rank <= rank ||
+    /* bounded handshake (when the accept side is bounded at all): a
+     * peer that connects but wedges before identifying itself must not
+     * hold bootstrap hostage past the deadline.  The fd is blocking
+     * here, which is fine for the read side: io_all_deadline polls
+     * before every read, so it only ever reads available bytes. */
+    int hs_rc = bounded_accept
+                    ? io_all_deadline<false>(fd, &peer_rank,
+                                             sizeof(peer_rank), connect_dl)
+                    : read_all(fd, &peer_rank, sizeof(peer_rank));
+    if (hs_rc || peer_rank <= rank ||
         peer_rank >= size || c->socks[peer_rank] != -1) {
       std::fprintf(stderr, "tpucomm r%d: bad handshake (peer said %d)\n",
                    rank, peer_rank);
@@ -2027,6 +2473,20 @@ int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
     c->socks[peer_rank] = fd;
   }
   if (listen_fd >= 0) ::close(listen_fd);
+
+  /* With a transport deadline armed, the mesh runs on non-blocking fds:
+   * the deadline paths poll() before every transfer and handle EAGAIN,
+   * and a blocking socket write of a large payload would otherwise park
+   * in the kernel until ALL bytes are queued — unwakeable past any
+   * deadline when the peer stops draining.  Without the knob the fds
+   * stay blocking and the historic loops serve untouched. */
+  if (transport_timeout_s() > 0) {
+    for (int fd : c->socks)
+      if (fd >= 0) {
+        int fl = ::fcntl(fd, F_GETFL, 0);
+        if (fl >= 0) ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+      }
+  }
 
   /* same-host groups get the shared-memory collective arena */
   const char* jobid = std::getenv("MPI4JAX_TPU_JOBID");
@@ -2192,6 +2652,42 @@ int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag) {
 const char* tpucomm_last_error(void) {
   std::lock_guard<std::mutex> lock(g_last_error_mu);
   return g_last_error;
+}
+
+void tpucomm_abort_all(void) {
+  /* Best-effort job-wide abort propagation, called by the Python layer
+   * on its way into os._exit: one poison frame (kPoisonTag header +
+   * last-error text) to every peer of every socket-owning comm, then
+   * shutdown — peers blocked in a recv consume the poison and fail
+   * naming this rank; peers parked in shm waits see the socket die on
+   * their next liveness probe.  Everything here is non-blocking: an
+   * abort must never hang behind a full socket buffer. */
+  char text[sizeof(g_last_error)] = {0};
+  {
+    std::lock_guard<std::mutex> lock(g_last_error_mu);
+    std::memcpy(text, g_last_error, sizeof(text));
+  }
+  text[sizeof(text) - 1] = 0;
+  const int64_t len = (int64_t)std::strlen(text);
+  std::lock_guard<std::mutex> lock(g_comms_mu);
+  for (auto& kv : g_comms) {
+    Comm* c = kv.second;
+    if (!c->owns_socks) continue;  // sub-comms borrow these same fds
+    for (int r = 0; r < c->size; r++) {
+      int fd = c->socks[r];
+      if (fd < 0) continue;
+      MsgHeader h{len, kPoisonTag, c->comm_id};
+      ssize_t w = ::send(fd, &h, sizeof(h), MSG_NOSIGNAL | MSG_DONTWAIT);
+      /* payload only behind a COMPLETE header: a partial header send
+       * (nearly-full buffer — the typical abort scenario) followed by
+       * text bytes would be parsed as a garbage frame header on the
+       * peer; partial header + EOF degrades to the historic dead-socket
+       * diagnostic instead */
+      if (w == (ssize_t)sizeof(h) && len > 0)
+        ::send(fd, text, (size_t)len, MSG_NOSIGNAL | MSG_DONTWAIT);
+      ::shutdown(fd, SHUT_RDWR);
+    }
+  }
 }
 
 int tpucomm_recv_status(int64_t h, void* buf, int64_t nbytes, int source,

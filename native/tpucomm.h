@@ -74,6 +74,24 @@ int64_t tpucomm_dup(int64_t h);
  * analog of MPI_Error_string); "" if none. */
 const char* tpucomm_last_error(void);
 
+/* Job-wide abort propagation: best-effort write one poison control
+ * frame (carrying tpucomm_last_error's text) to every peer of every
+ * socket-owning communicator and shut the sockets down.  Peers blocked
+ * in any receive consume the poison and fail fast naming this rank, so
+ * the group tears down within one transport deadline instead of
+ * waiting for timeouts to cascade.  Entirely non-blocking; call it
+ * immediately before exiting the process on an error (the Python
+ * bridge's abort path does).
+ *
+ * Failure-detection knobs read natively (see utils/config.py):
+ *   MPI4JAX_TPU_TIMEOUT_S          progress-based deadline on every
+ *                                  blocking transport wait (0 = off)
+ *   MPI4JAX_TPU_CONNECT_TIMEOUT_S  bootstrap dial/accept deadline
+ *   MPI4JAX_TPU_FAULT              deterministic fault injection:
+ *                                  rank=R,point=send|recv|connect,
+ *                                  after=N,action=hang|exit|close */
+void tpucomm_abort_all(void);
+
 /* Point-to-point.  dest/source == own rank is legal (MPI-style
  * self-messaging: send enqueues on an in-process queue, recv pops it;
  * source may also be -2 = ANY_SOURCE, resolved by polling all peers). */

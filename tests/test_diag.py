@@ -21,8 +21,13 @@ def test_diag_cpu_checks():
     assert data["failed"] == 0
     names = {r["check"] for r in data["results"]}
     assert names == {"native_build", "ffi_fast_path", "coll_algo_engine",
-                     "transport_loopback"}
+                     "transport_loopback", "failure_detection"}
     # the loopback probe reports the engine's pick from a live comm
     loopback = next(r for r in data["results"]
                     if r["check"] == "transport_loopback")
     assert "algo16mb=" in loopback["detail"]
+    # the failure-detection probe reports the resolved knobs and proves
+    # an injected hang trips the deadline with the stuck peer named
+    fd = next(r for r in data["results"] if r["check"] == "failure_detection")
+    assert "timeout_s=" in fd["detail"] and "connect_s=" in fd["detail"]
+    assert "detected" in fd["detail"]

@@ -1,0 +1,152 @@
+"""Foreign-launcher adoption unit tests (runtime/transport.py): the
+rank/size env-pair table, the SLURM batch-step guard, native-variable
+precedence, and the job-token rendezvous-port derivation that backs
+``_default_coord``."""
+
+import importlib.util
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+def _load_transport():
+    try:
+        from mpi4jax_tpu.runtime import transport
+
+        return transport
+    except ImportError:
+        # the package __init__ gates on the jax version; the detection
+        # logic under test is stdlib-only at module level (bridge is a
+        # lazy import inside WorldComm), so load it standalone
+        spec = importlib.util.spec_from_file_location(
+            "m4j_transport_standalone",
+            REPO / "mpi4jax_tpu/runtime/transport.py",
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+
+transport = _load_transport()
+
+ALL_VARS = (
+    "MPI4JAX_TPU_RANK", "MPI4JAX_TPU_SIZE",
+    "OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE",
+    "PMI_RANK", "PMI_SIZE",
+    "SLURM_PROCID", "SLURM_NTASKS", "SLURM_LAUNCH_NODE_IPADDR",
+)
+
+TOKEN_VARS = ("OMPI_MCA_ess_base_jobid", "PMIX_NAMESPACE", "SLURM_JOB_ID",
+              "PMI_JOBID", "PBS_JOBID", "LSB_JOBID", "MPI4JAX_TPU_COORD")
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    for var in ALL_VARS + TOKEN_VARS:
+        monkeypatch.delenv(var, raising=False)
+    return monkeypatch
+
+
+def test_no_launcher_env_means_no_world(clean_env):
+    assert transport._detect_rank_size() is None
+    assert not transport.in_world()
+
+
+def test_native_vars_adopted(clean_env):
+    clean_env.setenv("MPI4JAX_TPU_RANK", "3")
+    clean_env.setenv("MPI4JAX_TPU_SIZE", "8")
+    assert transport._detect_rank_size() == (3, 8)
+    assert transport.in_world()
+
+
+def test_ompi_pair_adopted(clean_env):
+    clean_env.setenv("OMPI_COMM_WORLD_RANK", "1")
+    clean_env.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    assert transport._detect_rank_size() == (1, 4)
+
+
+def test_pmi_pair_adopted(clean_env):
+    clean_env.setenv("PMI_RANK", "2")
+    clean_env.setenv("PMI_SIZE", "6")
+    assert transport._detect_rank_size() == (2, 6)
+
+
+def test_native_vars_beat_foreign_pairs(clean_env):
+    # a job relaunched by this framework inside an mpirun allocation
+    # must follow the native description, not the outer launcher's
+    clean_env.setenv("OMPI_COMM_WORLD_RANK", "1")
+    clean_env.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    clean_env.setenv("PMI_RANK", "2")
+    clean_env.setenv("PMI_SIZE", "6")
+    clean_env.setenv("MPI4JAX_TPU_RANK", "0")
+    clean_env.setenv("MPI4JAX_TPU_SIZE", "2")
+    assert transport._detect_rank_size() == (0, 2)
+
+
+def test_ompi_beats_pmi_in_table_order(clean_env):
+    clean_env.setenv("PMI_RANK", "2")
+    clean_env.setenv("PMI_SIZE", "6")
+    clean_env.setenv("OMPI_COMM_WORLD_RANK", "1")
+    clean_env.setenv("OMPI_COMM_WORLD_SIZE", "4")
+    assert transport._detect_rank_size() == (1, 4)
+
+
+def test_slurm_batch_step_not_adopted(clean_env):
+    # every SLURM *batch step* exports PROCID=0/NTASKS=N into plain
+    # python invocations; adopting it would hang single-process programs
+    # waiting for N-1 phantom peers.  Only srun tasks (which also carry
+    # SLURM_LAUNCH_NODE_IPADDR) count.
+    clean_env.setenv("SLURM_PROCID", "0")
+    clean_env.setenv("SLURM_NTASKS", "16")
+    assert transport._detect_rank_size() is None
+    assert not transport.in_world()
+
+
+def test_slurm_srun_task_adopted(clean_env):
+    clean_env.setenv("SLURM_PROCID", "5")
+    clean_env.setenv("SLURM_NTASKS", "16")
+    clean_env.setenv("SLURM_LAUNCH_NODE_IPADDR", "10.0.0.1")
+    assert transport._detect_rank_size() == (5, 16)
+
+
+def test_half_pairs_ignored(clean_env):
+    # a rank var without its size var is not a world signal
+    clean_env.setenv("OMPI_COMM_WORLD_RANK", "1")
+    assert transport._detect_rank_size() is None
+    clean_env.setenv("PMI_SIZE", "6")
+    assert transport._detect_rank_size() is None
+
+
+def test_default_coord_without_token_is_fixed(clean_env):
+    assert transport._default_coord() == "127.0.0.1:49817"
+
+
+def test_default_coord_derives_stable_port_from_job_token(clean_env):
+    clean_env.setenv("SLURM_JOB_ID", "777123")
+    first = transport._default_coord()
+    assert first == transport._default_coord()  # stable across ranks
+    host, _, port = first.partition(":")
+    assert host == "127.0.0.1"
+    assert 41000 <= int(port) < 49000
+
+
+def test_default_coord_distinct_jobs_distinct_ports(clean_env):
+    clean_env.setenv("SLURM_JOB_ID", "777123")
+    a = transport._default_coord()
+    clean_env.setenv("SLURM_JOB_ID", "777124")
+    b = transport._default_coord()
+    assert a != b
+
+
+def test_default_coord_token_precedence(clean_env):
+    # first token var in table order wins (OMPI jobid over SLURM's)
+    clean_env.setenv("SLURM_JOB_ID", "999")
+    slurm_only = transport._default_coord()
+    clean_env.setenv("OMPI_MCA_ess_base_jobid", "123")
+    with_ompi = transport._default_coord()
+    clean_env.delenv("SLURM_JOB_ID")
+    # the OMPI token decided the port, with or without SLURM's present
+    assert transport._default_coord() == with_ompi
+    assert slurm_only != with_ompi

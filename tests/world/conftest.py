@@ -1,0 +1,77 @@
+"""World-tier harness: a per-test hard deadline.
+
+Every test here drives real multi-process jobs; a transport regression
+that hangs one (a stuck launcher wait, a subprocess call missing its
+``timeout=``) must fail THAT test fast instead of eating the whole
+suite's global wall-clock budget.  SIGALRM fires in the main thread, so
+it interrupts even a blocking ``subprocess`` wait — and before failing
+the test it SIGKILLs every descendant process, because unwinding a
+``subprocess.run`` kills only the direct child (the launcher), which
+then can never reap its ranks (a deliberately hung fault-injected rank
+would survive as a permanent orphan).
+
+``MPI4JAX_TPU_TEST_TIMEOUT_S`` overrides the per-test budget (0 turns
+the backstop off); the default comfortably exceeds every individual
+test's own subprocess timeouts, so it only fires on a genuine hang.
+"""
+
+import os
+import signal
+
+import pytest
+
+_BUDGET_S = float(os.environ.get("MPI4JAX_TPU_TEST_TIMEOUT_S", "600"))
+
+
+def _descendant_pids():
+    """All live descendants of this process, children before parents
+    (stdlib /proc walk — psutil is not a test dependency)."""
+    children = {}
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    fields = f.read().rsplit(")", 1)[1].split()
+                children.setdefault(int(fields[1]), []).append(int(pid))
+            except (OSError, IndexError, ValueError):
+                continue
+    except OSError:
+        return []
+    out = []
+    stack = [os.getpid()]
+    while stack:
+        for child in children.get(stack.pop(), []):
+            out.append(child)
+            stack.append(child)
+    return out[::-1]  # deepest first
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    if _BUDGET_S <= 0 or not hasattr(signal, "SIGALRM"):
+        yield
+        return
+
+    def _fire(signum, frame):
+        for pid in _descendant_pids():
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+        raise TimeoutError(
+            f"world test exceeded the {_BUDGET_S:.0f} s hard deadline "
+            "(tests/world/conftest.py; override with "
+            "MPI4JAX_TPU_TEST_TIMEOUT_S) — a multi-process job hung "
+            "instead of failing fast; all descendant processes were "
+            "SIGKILLed"
+        )
+
+    old = signal.signal(signal.SIGALRM, _fire)
+    signal.setitimer(signal.ITIMER_REAL, _BUDGET_S)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)

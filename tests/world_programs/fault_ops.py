@@ -1,0 +1,40 @@
+"""Deterministic point-to-point traffic for the failure-detection tests.
+
+A ring of sendrecv rounds at the bridge level (no jax import — the
+failure paths under test live entirely in the native transport, and a
+lean program keeps the detection-latency assertions about the
+*transport*, not interpreter startup).  Under ``MPI4JAX_TPU_FAULT`` one
+rank hangs / exits / partitions mid-schedule; its peers must abort with
+the transport's diagnostics instead of hanging (tests/world/
+test_failure_detection.py asserts the teardown latency and wording).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from mpi4jax_tpu.runtime import bridge, transport
+
+
+def main():
+    comm = transport.get_world_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size >= 2, "run under the launcher with -n >= 2"
+    h = comm.handle
+
+    rounds = int(os.environ.get("FAULT_OPS_ROUNDS", "6"))
+    peer_hi = (rank + 1) % size
+    peer_lo = (rank - 1) % size
+    base = np.arange(8, dtype=np.float64)
+    for i in range(rounds):
+        got = bridge.sendrecv(h, base + rank + i, (8,), np.float64,
+                              peer_lo, peer_hi, 40 + i)
+        np.testing.assert_allclose(got, base + peer_lo + i)
+    print(f"rank {rank}: fault_ops OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

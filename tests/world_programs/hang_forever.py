@@ -1,0 +1,18 @@
+"""A rank that never finishes: the launcher watchdog / signal-teardown
+target.  Writes its pid to $HANG_PID_DIR (when set) so tests can prove
+no orphan survives the reap; $HANG_IGNORE_SIGINT=1 forces the launcher's
+SIGINT grace period to escalate to SIGTERM/SIGKILL."""
+
+import os
+import signal
+import time
+
+if os.environ.get("HANG_IGNORE_SIGINT"):
+    signal.signal(signal.SIGINT, signal.SIG_IGN)
+
+piddir = os.environ.get("HANG_PID_DIR")
+if piddir:
+    with open(os.path.join(piddir, f"pid_{os.getpid()}"), "w") as f:
+        f.write(str(os.getpid()))
+
+time.sleep(600)
