@@ -1,0 +1,90 @@
+"""CLI for the static communication verifier.
+
+    python -m mpi4jax_tpu.analyze program.py --np 4 [--json]
+                                             [--timeout S] [--schedules]
+
+Runs ``program.py`` once per simulated rank inside one process (virtual
+world: threads, in-memory matching, real values — no processes spawned,
+no live communication), and prints the findings table with the finding
+kind, the rank pair, and the source line/equation of every involved op.
+
+Exit codes: 0 clean, 3 findings reported, 2 usage or analyzer error —
+the same contract ``mpi4jax_tpu.launch --verify`` relies on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+EXIT_CLEAN = 0
+EXIT_ERROR = 2
+EXIT_FINDINGS = 3
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m mpi4jax_tpu.analyze",
+        description="statically verify a world-tier program's "
+                    "communication schedule (no processes, no live comm)",
+    )
+    ap.add_argument("prog", help="per-rank python program to verify")
+    ap.add_argument("-n", "--np", type=int, required=True, dest="np_",
+                    metavar="N", help="world size to verify at")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="virtual-world wall deadline in seconds "
+                         "(default MPI4JAX_TPU_ANALYZE_TIMEOUT_S or 120)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    ap.add_argument("--schedules", action="store_true",
+                    help="also print each rank's extracted schedule")
+    ap.add_argument("--show-output", action="store_true",
+                    help="echo the analyzed program's captured "
+                         "stdout/stderr")
+    ap.add_argument("--errors-only", action="store_true",
+                    help="exit 3 only on error-severity findings; "
+                         "warnings are still printed (the launch "
+                         "--verify gate uses this: a warning documents "
+                         "an assumption, it does not block a job)")
+    # anything the analyzer doesn't recognize is the PROGRAM's argv
+    # (its sys.argv, exactly as under the launcher); a leading "--"
+    # separates explicitly when a program flag collides with ours
+    args, prog_args = ap.parse_known_args(argv)
+    if prog_args[:1] == ["--"]:
+        prog_args = prog_args[1:]
+
+    if args.np_ < 1:
+        print("--np must be >= 1", file=sys.stderr)
+        return EXIT_ERROR
+
+    from . import check_program
+
+    try:
+        report = check_program(args.prog, args.np_,
+                               timeout_s=args.timeout,
+                               argv=prog_args)
+    except (OSError, SyntaxError, ValueError) as err:
+        # unreadable file / not-python / bad arguments: usage error
+        print(f"cannot analyze {args.prog}: {err}", file=sys.stderr)
+        return EXIT_ERROR
+    except Exception as err:  # analyzer bug: still honor the contract
+        import traceback
+
+        traceback.print_exc()
+        print(f"analyzer error on {args.prog}: {err}", file=sys.stderr)
+        return EXIT_ERROR
+
+    if args.json:
+        print(json.dumps(report.to_json()))
+    else:
+        print(report.format_table(show_schedules=args.schedules))
+        if args.show_output and report.output:
+            print("-- program output (captured) --")
+            print(report.output, end="")
+    flagged = report.errors if args.errors_only else report.findings
+    return EXIT_FINDINGS if flagged else EXIT_CLEAN
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""Event and finding vocabulary for the static communication verifier.
+
+Deliberately jax-free: the match simulation (``_match.py``) and these data
+types run anywhere — the tier-1 suite exercises them even on hosts whose
+jax predates the package minimum, and the launcher's ``--verify`` parses
+their JSON form without importing jax in-process.
+
+A :class:`CommEvent` is one communication operation as it appears in one
+rank's ordered schedule — extracted either statically from a closed jaxpr
+(``_schedule.py``) or dynamically by the virtual-world executor
+(``_sim.py``).  Field semantics follow the primitives' params
+(``ops/_world_impl.SCHEDULE_SIGNATURES`` is the authoritative export).
+
+Wildcard sentinels match ``utils/status.py`` (ANY_TAG = -1,
+ANY_SOURCE = -2) but are re-declared here to keep this module
+import-light.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+ANY_TAG = -1
+ANY_SOURCE = -2
+
+#: Event kinds that move data point-to-point.
+P2P_KINDS = frozenset({"send", "recv", "sendrecv", "shift2"})
+
+#: Event kinds that are collective over every member of the comm.
+COLLECTIVE_KINDS = frozenset({
+    "allreduce", "reduce", "scan", "bcast", "allgather", "gather",
+    "scatter", "alltoall", "barrier", "split",
+})
+
+#: Collective kinds whose semantics depend on a reduce operator.
+REDUCING_KINDS = frozenset({"allreduce", "reduce", "scan"})
+
+#: Collective kinds with a root parameter.
+ROOTED_KINDS = frozenset({"reduce", "bcast", "gather", "scatter"})
+
+
+@dataclass
+class CommEvent:
+    """One communication op in one rank's schedule."""
+
+    rank: int
+    idx: int                       # position in this rank's schedule
+    kind: str                      # see P2P_KINDS / COLLECTIVE_KINDS
+    comm: Tuple = (0,)             # comm key (lineage tuple; same across ranks)
+    # point-to-point routing (None where not applicable)
+    dest: Optional[int] = None
+    source: Optional[int] = None
+    lo: Optional[int] = None       # shift2 neighbors (-1 = wall)
+    hi: Optional[int] = None
+    root: Optional[int] = None
+    tag: Optional[int] = None      # send tag / directed recv tag
+    sendtag: Optional[int] = None  # sendrecv split tags
+    recvtag: Optional[int] = None
+    reduce_op: Optional[str] = None
+    dtype: Optional[str] = None
+    shape: Optional[Tuple[int, ...]] = None
+    site: str = ""                 # "file.py:123 (eqn 4 mpi4jax_tpu_send)"
+    # internal matcher state (not part of identity)
+    _sent: bool = field(default=False, repr=False, compare=False)
+
+    def describe(self) -> str:
+        bits = [self.kind]
+        if self.kind == "send":
+            bits.append(f"to {self.dest} tag {self.tag}")
+        elif self.kind == "recv":
+            src = "ANY_SOURCE" if self.source == ANY_SOURCE else self.source
+            tag = "ANY_TAG" if self.tag == ANY_TAG else self.tag
+            bits.append(f"from {src} tag {tag}")
+        elif self.kind == "sendrecv":
+            bits.append(f"to {self.dest} from {self.source}")
+        elif self.kind == "shift2":
+            bits.append(f"lo {self.lo} hi {self.hi}")
+        elif self.root is not None:
+            bits.append(f"root {self.root}")
+        if self.reduce_op:
+            bits.append(f"op {self.reduce_op}")
+        if self.dtype:
+            shape = "x".join(map(str, self.shape or ()))
+            bits.append(f"{self.dtype}[{shape}]")
+        where = f" @ {self.site}" if self.site else ""
+        return " ".join(bits) + where
+
+    def collective_signature(self):
+        """The fields every rank must agree on for a matched collective.
+
+        ``split`` deliberately excludes color/key (divergent colors are the
+        point); reducing kinds include the operator; rooted kinds the root.
+        """
+        sig = [self.kind]
+        if self.kind in REDUCING_KINDS:
+            sig.append(("op", self.reduce_op))
+        if self.kind in ROOTED_KINDS:
+            sig.append(("root", self.root))
+        if self.kind not in ("barrier", "split"):
+            sig.append(("dtype", self.dtype))
+            sig.append(("shape", self.shape))
+        return tuple(sig)
+
+
+# ---------------------------------------------------------------------------
+# findings
+
+#: kind -> (severity, one-line description) — the finding catalogue
+#: (docs/analysis.md carries a worked example per kind).
+FINDING_KINDS = {
+    "deadlock": ("error", "cyclic send/recv or collective wait"),
+    "unmatched_send": ("error", "a sent message is never received"),
+    "unmatched_recv": ("error", "a receive no rank ever sends to"),
+    "tag_mismatch": ("error", "matched endpoints disagree on the tag"),
+    "dtype_mismatch": ("error", "matched endpoints disagree on the dtype"),
+    "shape_mismatch": ("error",
+                       "matched endpoints disagree on the shape/byte count"),
+    "collective_mismatch": ("error",
+                            "ranks run different collectives at the same "
+                            "program position"),
+    "reduce_op_mismatch": ("error",
+                           "ranks run the same collective with different "
+                           "reduce operators"),
+    "root_mismatch": ("error",
+                      "ranks run the same collective with different roots"),
+    "wildcard_starvation": ("error",
+                            "an ANY_SOURCE receive has no send left to "
+                            "match"),
+    "token_violation": ("warning",
+                        "a world op's effect token is unthreaded or "
+                        "reordered (undefined order in explicit-token "
+                        "mode)"),
+    "order_critical_exchange": ("warning",
+                                "cyclic send<->recv traffic between two "
+                                "ranks: correct only under strict "
+                                "program-order execution; any reordering "
+                                "or missing effect edge deadlocks"),
+    "control_divergence": ("warning",
+                           "communication differs between cond branches; "
+                           "data-dependent schedules cannot be verified "
+                           "statically"),
+    "comm_in_while": ("warning",
+                      "communication inside a while loop: trip count is "
+                      "data-dependent, one iteration assumed"),
+    "rank_error": ("error", "a rank's program raised during analysis"),
+    "analysis_timeout": ("error",
+                         "the match simulation did not finish in time"),
+}
+
+
+@dataclass
+class Finding:
+    kind: str
+    message: str
+    ranks: Tuple[int, ...] = ()
+    comm: Tuple = ()
+    sites: Tuple[str, ...] = ()
+
+    @property
+    def severity(self) -> str:
+        return FINDING_KINDS.get(self.kind, ("error", ""))[0]
+
+    def format(self) -> str:
+        ranks = ",".join(map(str, self.ranks)) if self.ranks else "-"
+        head = f"{self.severity.upper():7s} {self.kind:24s} ranks {ranks:7s}"
+        lines = [f"{head} {self.message}"]
+        for s in self.sites:
+            lines.append(f"{'':8s}  at {s}")
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return {
+            "kind": self.kind,
+            "severity": self.severity,
+            "message": self.message,
+            "ranks": list(self.ranks),
+            "comm": list(self.comm),
+            "sites": list(self.sites),
+        }
+
+
+@dataclass
+class Report:
+    """Verdict of one verification run."""
+
+    world_size: int
+    target: str                    # program path or function name
+    findings: list
+    schedules: dict = field(default_factory=dict)  # rank -> [event str]
+    output: str = ""               # captured program stdout/stderr (sim)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == "warning"]
+
+    def kinds(self):
+        return {f.kind for f in self.findings}
+
+    def format_table(self, *, show_schedules: bool = False) -> str:
+        lines = [
+            f"static verify: {self.target} at world size {self.world_size}"
+        ]
+        if not self.findings:
+            lines.append("CLEAN   no findings")
+        for f in self.findings:
+            lines.append(f.format())
+        if show_schedules:
+            for rank in sorted(self.schedules):
+                lines.append(f"-- rank {rank} schedule --")
+                for s in self.schedules[rank]:
+                    lines.append(f"   {s}")
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return {
+            "target": self.target,
+            "world_size": self.world_size,
+            "ok": self.ok,
+            "findings": [f.to_json() for f in self.findings],
+            "schedules": {
+                str(r): list(v) for r, v in self.schedules.items()
+            },
+        }

@@ -1,0 +1,85 @@
+"""Abstract communicators for analysis: the shape of a WorldComm with no
+transport behind it.
+
+An :class:`AbstractComm` carries rank/size/lineage so every op-layer code
+path (validation, primitive params, rank-dependent avals like ``gather``)
+behaves exactly as in production, but touching the native handle is an
+error — analysis must never open a socket or shared-memory segment.
+
+Static checking (``analysis.check``) uses one AbstractComm per simulated
+rank and traces with abstract values only.  The virtual-world executor
+(``analysis._sim``) attaches a live session so collective comm management
+(``split``/``dup``) rendezvouses across rank threads.
+"""
+
+from __future__ import annotations
+
+from ..runtime.transport import WorldComm
+
+
+class AnalysisError(RuntimeError):
+    """The analyzed program attempted something analysis cannot allow
+    (e.g. touching the native transport)."""
+
+
+class AbstractComm(WorldComm):
+    """A WorldComm stand-in for one simulated rank.
+
+    ``key`` plays the lineage role (identical across the comm's members,
+    so primitive-param hashes agree rank-to-rank exactly like production
+    comms); ``members`` is the world-rank tuple ordered by sub-rank.
+    """
+
+    def __init__(self, rank, size, *, key=(0,), members=None, session=None):
+        super().__init__(rank, size, coord="analysis:virtual",
+                         lineage=tuple(key))
+        self._members = tuple(members) if members is not None \
+            else tuple(range(size))
+        self._session = session
+
+    @property
+    def key(self):
+        return self._lineage
+
+    @property
+    def members(self):
+        return self._members
+
+    @property
+    def handle(self):
+        raise AnalysisError(
+            "an op reached the native transport during static analysis — "
+            "this is a bug in mpi4jax_tpu.analysis (no live communication "
+            "may happen here)"
+        )
+
+    def split(self, color, key=None):
+        if self._session is None:
+            raise NotImplementedError(
+                "comm.split() inside analysis.check() is not supported: a "
+                "split's membership depends on every rank's color, which a "
+                "per-rank static trace cannot see.  Analyze the full "
+                "program instead: python -m mpi4jax_tpu.analyze prog.py "
+                "--np N"
+            )
+        return self._session.split_collective(self, int(color), key)
+
+    def dup(self):
+        if self._session is None:
+            raise NotImplementedError(
+                "comm.dup() inside analysis.check() is not supported; "
+                "analyze the full program via python -m "
+                "mpi4jax_tpu.analyze instead"
+            )
+        return self._session.dup_collective(self)
+
+    clone = dup
+    Clone = dup
+    Split = split
+
+    def coll_algo(self, op: str, nbytes: int) -> str:
+        return "analysis"
+
+    def __repr__(self):
+        return (f"AbstractComm(rank={self._rank}, size={self._size}, "
+                f"key={self._lineage})")

@@ -1,0 +1,345 @@
+"""Static schedule extraction: closed jaxpr -> per-rank CommEvent list.
+
+``trace_rank_schedule`` traces a user function once for one simulated rank
+(abstract eval only — world primitives never execute, no comm exists) and
+walks the closed jaxpr, including every sub-jaxpr a higher-order primitive
+carries:
+
+- ``pjit``/``closed_call``/``custom_jvp/vjp``/``remat``: inlined — each
+  call site contributes its body's events in place, so the same inner
+  function called twice is two schedule segments (exactly what executes);
+- ``scan``: the body is unrolled ``length`` times (the trip count is
+  static in the jaxpr);
+- ``while``: the trip count is data-dependent — the body is walked once
+  and a ``comm_in_while`` warning is attached when it communicates;
+- ``cond``: branch schedules are compared; diverging communication is a
+  ``control_divergence`` warning (branch 0 is assumed), since the taken
+  branch cannot be known statically.
+
+On top of extraction, a static token-discipline pass checks the
+explicit-token wire format (the ``*_t`` primitives): every token-variant
+equation on a comm must be reachable — through the value graph — from the
+previous token-variant equation on that comm, else their relative order is
+undefined (the reordered/forked-chain footgun); a tokenless world op bound
+with the unordered effect amid live chains is flagged the same way.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+from ._events import CommEvent, Finding
+from ._fake import AbstractComm
+
+#: scan bodies are unrolled; cap the total extracted events per rank so a
+#: million-step scan cannot stall analysis (a finding reports the cut).
+MAX_EVENTS_PER_RANK = 20000
+
+
+def _site_of(eqn, pos) -> str:
+    label = f"eqn {pos} {eqn.primitive.name}"
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return (f"{os.path.basename(frame.file_name)}:"
+                    f"{frame.start_line} ({label})")
+    except Exception:
+        pass
+    return label
+
+
+def _comm_key(comm):
+    if isinstance(comm, AbstractComm):
+        return comm.key
+    lineage = getattr(comm, "_lineage", None)
+    return tuple(lineage) if lineage is not None else ("comm", id(comm))
+
+
+def _sub_jaxprs(params):
+    """Generic recursion targets: every (Closed)Jaxpr in eqn params."""
+    from jax._src import core as jcore
+
+    out = []
+    for v in params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for item in vals:
+            if isinstance(item, jcore.ClosedJaxpr):
+                out.append(item.jaxpr)
+            elif isinstance(item, jcore.Jaxpr):
+                out.append(item)
+    return out
+
+
+class _Extractor:
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self.events: List[CommEvent] = []
+        self.findings: List[Finding] = []
+        self.truncated = False
+
+    # -- events ---------------------------------------------------------
+
+    def _emit(self, eqn, pos):
+        from ..ops import _world_impl
+
+        sig = _world_impl.schedule_signature(eqn.primitive.name)
+        if sig is None:
+            return False
+        base, spec, token_variant = sig
+        params = eqn.params
+        if params.get("transpose"):
+            return True  # transposed allreduce lowers to identity: no comm
+        if len(self.events) >= MAX_EVENTS_PER_RANK:
+            if not self.truncated:
+                self.truncated = True
+                self.findings.append(Finding(
+                    "analysis_timeout",
+                    f"rank {self.rank}: schedule longer than "
+                    f"{MAX_EVENTS_PER_RANK} events; truncated",
+                    ranks=(self.rank,),
+                ))
+            return True
+        comm = params.get("comm")
+        fields = {}
+        for field, pname in spec.items():
+            if field == "kind":
+                continue
+            value = params.get(pname)
+            if field == "reduce_op" and value is not None:
+                value = value.name
+            fields[field] = value
+        dtype = shape = None
+        data_vars = [v for v in eqn.invars
+                     if hasattr(v, "aval") and hasattr(v.aval, "shape")]
+        if token_variant and len(data_vars) > 1:
+            data_vars = data_vars[:-1]  # trailing operand is the token
+        if spec["kind"] not in ("barrier",) and data_vars:
+            aval = data_vars[0].aval
+            dtype = str(aval.dtype)
+            shape = tuple(aval.shape)
+        self.events.append(CommEvent(
+            rank=self.rank,
+            idx=len(self.events),
+            kind=spec["kind"],
+            comm=_comm_key(comm),
+            dtype=dtype,
+            shape=shape,
+            site=_site_of(eqn, pos),
+            **fields,
+        ))
+        return True
+
+    # -- recursion ------------------------------------------------------
+
+    def walk(self, jaxpr):
+        self._token_pass(jaxpr)
+        for pos, eqn in enumerate(jaxpr.eqns):
+            if self.truncated:
+                return
+            if self._emit(eqn, pos):
+                continue
+            name = eqn.primitive.name
+            params = eqn.params
+            if name == "scan":
+                body = params["jaxpr"].jaxpr
+                length = int(params.get("length", 1))
+                if length > 0:
+                    before = len(self.events)
+                    self.walk(body)
+                    per_iter = len(self.events) - before
+                    if per_iter:
+                        for _ in range(length - 1):
+                            if self.truncated:
+                                return
+                            self.walk(body)
+            elif name == "while":
+                # runtime order is cond, body, cond, ... — one iteration
+                # assumed: cond events first, then the body's
+                before = len(self.events)
+                cond = params.get("cond_jaxpr")
+                if cond is not None:
+                    self.walk(cond.jaxpr)
+                self.walk(params["body_jaxpr"].jaxpr)
+                if len(self.events) > before:
+                    self.findings.append(Finding(
+                        "comm_in_while",
+                        f"rank {self.rank}: communication inside a while "
+                        "loop — the trip count is data-dependent, one "
+                        "iteration assumed; divergent per-rank trip "
+                        "counts would deadlock at run time",
+                        ranks=(self.rank,),
+                        sites=(_site_of(eqn, pos),),
+                    ))
+            elif name == "cond":
+                branches = params.get("branches", ())
+                sub_schedules = []
+                for br in branches:
+                    sub = _Extractor(self.rank, self.world_size)
+                    sub.walk(br.jaxpr)
+                    sub_schedules.append(sub)
+                sigs = [
+                    tuple(
+                        (e.kind, e.comm, e.dest, e.source, e.root,
+                         e.tag, e.sendtag, e.recvtag, e.reduce_op,
+                         e.dtype, e.shape)
+                        for e in sub.events
+                    )
+                    for sub in sub_schedules
+                ]
+                if len(set(sigs)) > 1:
+                    self.findings.append(Finding(
+                        "control_divergence",
+                        f"rank {self.rank}: cond branches carry different "
+                        "communication schedules — the taken branch is "
+                        "data-dependent, so ranks can diverge at run "
+                        "time; branch 0 assumed for matching",
+                        ranks=(self.rank,),
+                        sites=(_site_of(eqn, pos),),
+                    ))
+                if sub_schedules:
+                    base = len(self.events)
+                    chosen = sub_schedules[0]
+                    for e in chosen.events:
+                        e.idx = base + e.idx
+                        self.events.append(e)
+                    self.findings.extend(chosen.findings)
+            else:
+                for sub in _sub_jaxprs(params):
+                    self.walk(sub)
+
+    # -- static token discipline ---------------------------------------
+
+    def _token_pass(self, jaxpr):
+        """Flag reordered/unthreaded explicit-token chains in one jaxpr."""
+        from ..ops import _world_impl
+
+        producer = {}
+        for eqn in jaxpr.eqns:
+            for v in eqn.outvars:
+                producer[v] = eqn
+        comm_eqns = []          # (pos, eqn, comm_key, is_token_variant)
+        for pos, eqn in enumerate(jaxpr.eqns):
+            sig = _world_impl.schedule_signature(eqn.primitive.name)
+            if sig is None or eqn.params.get("transpose"):
+                continue
+            _, _, token_variant = sig
+            if token_variant or eqn.params.get("ordered") is False:
+                comm_eqns.append(
+                    (pos, eqn, _comm_key(eqn.params.get("comm")),
+                     token_variant))
+        if len(comm_eqns) < 2:
+            return
+
+        from jax._src import core as jcore
+
+        def _vars(eqn):
+            return [v for v in eqn.invars
+                    if isinstance(v, jcore.Var) and v in producer]
+
+        ancestor_cache = {}
+
+        def comm_ancestors(eqn):
+            key = id(eqn)
+            if key in ancestor_cache:
+                return ancestor_cache[key]
+            ancestor_cache[key] = acc = set()
+            stack = _vars(eqn)
+            seen = set()
+            while stack:
+                v = stack.pop()
+                if v in seen:
+                    continue
+                seen.add(v)
+                parent = producer.get(v)
+                if parent is None:
+                    continue
+                from ..ops import _world_impl as wi
+
+                if wi.schedule_signature(parent.primitive.name):
+                    acc.add(id(parent))
+                acc |= comm_ancestors(parent)
+                stack.extend(_vars(parent))
+            return acc
+
+        prev_by_comm = {}
+        for pos, eqn, ckey, token_variant in comm_eqns:
+            prev = prev_by_comm.get(ckey)
+            if prev is not None:
+                prev_pos, prev_eqn = prev
+                if not token_variant:
+                    self.findings.append(Finding(
+                        "token_violation",
+                        f"rank {self.rank}: a tokenless world op runs "
+                        "with the unordered effect while explicit token "
+                        "chains are live on the same comm — its order "
+                        "against them is undefined",
+                        ranks=(self.rank,), comm=ckey,
+                        sites=(_site_of(eqn, pos),
+                               _site_of(prev_eqn, prev_pos)),
+                    ))
+                elif id(prev_eqn) not in comm_ancestors(eqn):
+                    self.findings.append(Finding(
+                        "token_violation",
+                        f"rank {self.rank}: two world ops on the same "
+                        "comm sit on unconnected token chains — their "
+                        "relative order is undefined and can deadlock "
+                        "(thread the previous op's token, or root a new "
+                        "chain with create_token(x))",
+                        ranks=(self.rank,), comm=ckey,
+                        sites=(_site_of(eqn, pos),
+                               _site_of(prev_eqn, prev_pos)),
+                    ))
+            prev_by_comm[ckey] = (pos, eqn)
+
+
+def trace_rank_schedule(fn, args, kwargs, rank: int, world_size: int,
+                        comm=None
+                        ) -> Tuple[List[CommEvent], List[Finding]]:
+    """Trace ``fn`` for one simulated rank; abstract eval only.
+
+    The trace-time token chain guard's warnings are captured as
+    ``token_violation`` findings: the guard sees the *user-level* chain
+    (a forked chain the AD side-chain later repairs on the wire is still
+    a program bug worth reporting).
+    """
+    import jax
+
+    from ..ops import _world_impl
+
+    if comm is None:
+        comm = AbstractComm(rank, world_size)
+    guard_findings: List[Finding] = []
+
+    def _warn_hook(warn_comm, n_heads, how):
+        guard_findings.append(Finding(
+            "token_violation",
+            f"rank {rank}: a world op on {warn_comm!r} is {how} while "
+            f"{n_heads} other token chain(s) on the same comm are live — "
+            "relative order is UNDEFINED in explicit-token mode and can "
+            "deadlock",
+            ranks=(rank,), comm=_comm_key(warn_comm),
+        ))
+
+    old_trace = _world_impl._analysis_token_trace
+    old_warn = _world_impl._analysis_warn_hook
+    _world_impl._set_analysis_token_hooks(old_trace, _warn_hook)
+    try:
+        with comm:  # ambient default comm for tokenless call sites
+            closed = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    except Exception as err:  # surface trace failures as findings
+        guard_findings.append(Finding(
+            "rank_error",
+            f"rank {rank}: tracing failed with "
+            f"{type(err).__name__}: {err}",
+            ranks=(rank,),
+        ))
+        return [], guard_findings
+    finally:
+        _world_impl._set_analysis_token_hooks(old_trace, old_warn)
+    ex = _Extractor(rank, world_size)
+    ex.walk(closed.jaxpr)
+    return ex.events, ex.findings + guard_findings

@@ -1,0 +1,678 @@
+"""Virtual-world executor: run a per-rank program under analysis.
+
+Executes the *whole program* once per rank — each rank a thread inside one
+process — with every world-tier op intercepted at the primitive-impl layer
+and served by an in-memory matcher instead of the native transport:
+
+- no processes are spawned and no live communication is created (sockets,
+  shm, the native library are never touched);
+- values are real: collectives/point-to-point compute their actual numpy
+  semantics, so known-good programs' assertions pass and the verdict
+  "clean" means the full program ran;
+- everything runs under ``jax.disable_jit`` so each op executes eagerly on
+  its rank's thread in exact program order — the analyzer sees the true
+  per-rank schedule (including data-dependent trip counts) and can name
+  the source line of every event;
+- matching failures (tag/dtype/shape mismatch, divergent collectives) are
+  findings mirroring the native transport's fail-fast aborts; a global
+  stall is classified by the wait graph (deadlock cycles, unmatched ops,
+  wildcard starvation) in milliseconds instead of a runtime deadline.
+
+The conservative schedule-level passes (``order_critical_findings``) run
+on the recorded schedules afterwards, so hazards that do not bite under
+correct ordering are still reported.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+import numpy as np
+
+from . import _match
+from ._events import ANY_SOURCE, ANY_TAG, CommEvent, Finding, Report
+from ._fake import AbstractComm, AnalysisError
+
+
+class SimAbort(RuntimeError):
+    """Raised inside a rank thread when the virtual world aborts the job
+    (mirrors the native transport's fail-fast poison cascade)."""
+
+
+_NP_COMBINE = {
+    "SUM": np.add, "PROD": np.multiply,
+    "MAX": np.maximum, "MIN": np.minimum,
+    "LAND": np.logical_and, "LOR": np.logical_or, "LXOR": np.logical_xor,
+    "BAND": np.bitwise_and, "BOR": np.bitwise_or, "BXOR": np.bitwise_xor,
+}
+
+
+def _fold(op_name, arrays):
+    uf = _NP_COMBINE[op_name]
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = uf(out, a)
+    return np.asarray(out, dtype=arrays[0].dtype)
+
+
+class _TokenCtx:
+    """Per-rank-thread pseudo-trace for the chain guard, keeping analyzed
+    tokens alive so id()-keyed tracking cannot alias."""
+
+    __slots__ = ("refs", "__weakref__")
+
+    def __init__(self):
+        self.refs = []
+
+
+class VirtualWorld:
+    """One analysis run of ``program`` at world size ``size``."""
+
+    def __init__(self, size: int, program: str, timeout_s=None, argv=None):
+        from ..utils import config
+
+        self.size = int(size)
+        self.program = os.path.abspath(program)
+        self.argv = list(argv or ())
+        if timeout_s is None:
+            timeout_s = config.analyze_timeout_s()
+        # 0 = no deadline, matching MPI4JAX_TPU_TIMEOUT_S's convention
+        self.timeout_s = float(timeout_s)
+        self.cv = threading.Condition()
+        self.channels = {}      # (comm_key, src_w, dst_w) -> deque
+        self.schedules = {r: [] for r in range(self.size)}
+        self.comms = {(0,): tuple(range(self.size))}
+        self.findings = []
+        self._finding_keys = set()
+        self.state = {r: "init" for r in range(self.size)}
+        self.blocked = {}       # rank -> (CommEvent, waits_on_fn)
+        self.coll = {}          # (comm_key, seq) -> {rank: (event, payload)}
+        self.coll_results = {}  # (comm_key, seq) -> {rank: value}
+        self.coll_counter = {}  # (rank, comm_key) -> int
+        self.aborted = False
+        self._token_ctx = {}    # thread ident -> _TokenCtx
+
+    # -- executor protocol (ops/_world_impl hooks) ----------------------
+
+    def owns(self, comm) -> bool:
+        # require OUR session: a rank thread leaked by a timed-out earlier
+        # run must not inject events into a later run's world
+        return isinstance(comm, AbstractComm) and comm._session is self
+
+    def run_primitive(self, prim_name, args, params):
+        from ..ops import _world_impl
+
+        sig = _world_impl.schedule_signature(prim_name)
+        if sig is None:
+            raise AnalysisError(
+                f"no schedule signature for primitive {prim_name!r}")
+        base, spec, _ = sig
+        comm = params["comm"]
+        data = np.asarray(args[0]) if args else None
+        event = self._make_event(base, spec, comm, data, params)
+        with self.cv:
+            self.schedules[event.rank].append(event)
+        value = self._dispatch(event, comm, data, params)
+        # hand jax back a jax array: downstream code (and jax internals)
+        # expect op results to be Arrays, not bare numpy
+        import jax.numpy as jnp
+
+        return jnp.asarray(value)
+
+    def _make_event(self, base, spec, comm, data, params):
+        world_rank = comm.members[comm.rank()]
+        fields = {}
+        for field, pname in spec.items():
+            if field == "kind":
+                continue
+            value = params.get(pname)
+            if field == "reduce_op" and value is not None:
+                value = value.name
+            fields[field] = value
+        return CommEvent(
+            rank=world_rank,
+            idx=len(self.schedules[world_rank]),
+            kind=spec["kind"],
+            comm=comm.key,
+            dtype=None if data is None else str(data.dtype),
+            shape=None if data is None else tuple(data.shape),
+            site=self._site(),
+            **fields,
+        )
+
+    def _site(self) -> str:
+        # walk raw frames (cheap) instead of materializing the whole
+        # stack per event: the DEEPEST frame in the analyzed file wins
+        import linecache
+
+        frame = sys._getframe(1)
+        best = None
+        while frame is not None:
+            if frame.f_code.co_filename == self.program:
+                best = (frame.f_lineno,)
+                break  # walking outward: first hit IS the deepest
+            frame = frame.f_back
+        if best is None:
+            return "<analysis>"
+        lineno = best[0]
+        text = linecache.getline(self.program, lineno).strip()
+        loc = f"{os.path.basename(self.program)}:{lineno}"
+        return f"{loc} `{text[:70]}`" if text else loc
+
+    # -- op dispatch ----------------------------------------------------
+
+    def _dispatch(self, event, comm, data, params):
+        kind = event.kind
+        if kind == "send":
+            self._push_send(event, comm, event.dest, data)
+            return np.zeros((), np.int32)
+        if kind == "recv":
+            payload, src_local, tag, nbytes = self._complete_recv(
+                event, comm, event.source, event.tag)
+            self._fill_status(params, src_local, tag, nbytes)
+            return payload
+        if kind == "sendrecv":
+            send_part = CommEvent(
+                rank=event.rank, idx=event.idx, kind="send",
+                comm=event.comm, dest=event.dest, tag=event.sendtag,
+                dtype=event.dtype, shape=event.shape, site=event.site)
+            self._push_send(send_part, comm, event.dest, data)
+            payload, src_local, tag, nbytes = self._complete_recv(
+                event, comm, event.source, event.recvtag)
+            self._fill_status(params, src_local, tag, nbytes)
+            return payload
+        if kind == "shift2":
+            return self._do_shift2(event, comm, data)
+        if kind == "barrier":
+            self._do_collective(event, comm, None)
+            return np.zeros((), np.int32)
+        return self._do_collective(event, comm, data)
+
+    @staticmethod
+    def _fill_status(params, src_local, tag, nbytes):
+        status = params.get("status")
+        if status is not None:
+            status.obj._fill(src_local, tag, nbytes)
+
+    def _push_send(self, event, comm, dest_local, payload):
+        with self.cv:
+            self._raise_if_aborted()
+            dst_w = comm.members[dest_local]
+            key = (comm.key, event.rank, dst_w)
+            self.channels.setdefault(key, deque()).append((payload, event))
+            self.cv.notify_all()
+
+    def _complete_recv(self, event, comm, source_local, tag):
+        me = event.rank
+        with self.cv:
+            while True:
+                self._raise_if_aborted()
+                got = self._match_recv_locked(event, comm, source_local,
+                                              tag)
+                if got is not None:
+                    self._set_running(me)
+                    payload, send_ev, src_w = got
+                    return (payload, comm.members.index(src_w),
+                            send_ev.tag,
+                            0 if payload is None else payload.nbytes)
+                self._block(me, event,
+                            ("recv", comm, source_local, tag))
+                self._stall_check_locked()
+                self.cv.wait(0.05)
+
+    def _match_recv_locked(self, event, comm, source_local, tag):
+        me = event.rank
+        if source_local == ANY_SOURCE:
+            for src_w in comm.members:  # self-sends are legal; scan all
+                q = self.channels.get((comm.key, src_w, me))
+                if not q:
+                    continue
+                head_payload, head_ev = q[0]
+                if tag not in (None, ANY_TAG) and head_ev.tag != tag:
+                    continue  # wildcard scan skips incompatible heads
+                q.popleft()
+                self._settle_match(head_ev, event)
+                return head_payload, head_ev, src_w
+            return None
+        src_w = comm.members[source_local]
+        q = self.channels.get((comm.key, src_w, me))
+        if not q:
+            return None
+        # strict in-order channel: the head IS the match; any field
+        # disagreement is a fail-fast program error (native abort)
+        head_payload, head_ev = q.popleft()
+        self._settle_match(head_ev, event)
+        return head_payload, head_ev, src_w
+
+    def _settle_match(self, send_ev, recv_ev):
+        found = _match.compare_p2p(send_ev, recv_ev)
+        if found:
+            self._record_locked(found)
+            self._abort_locked()
+            raise SimAbort(found[0].message)
+
+    def _do_shift2(self, event, comm, data):
+        me = event.rank
+        out = [None, None]
+        for i, peer in enumerate((event.lo, event.hi)):
+            if peer is None or peer < 0:
+                continue
+            send_part = CommEvent(
+                rank=me, idx=event.idx, kind="send", comm=event.comm,
+                dest=peer, tag=event.tag,
+                dtype=event.dtype, shape=event.shape, site=event.site)
+            self._push_send(send_part, comm, peer, data[i])
+        with self.cv:
+            for i, peer in enumerate((event.lo, event.hi)):
+                if peer is None or peer < 0:
+                    # wall: passthrough of the opposite input strip
+                    out[i] = data[1 - i]
+                    continue
+                src_w = comm.members[peer]
+                while True:
+                    self._raise_if_aborted()
+                    q = self.channels.get((comm.key, src_w, me))
+                    if q:
+                        payload, send_ev = q.popleft()
+                        self._settle_match(send_ev, event)
+                        out[i] = payload
+                        break
+                    self._block(me, event, ("recv", comm, peer, event.tag))
+                    self._stall_check_locked()
+                    self.cv.wait(0.05)
+            self._set_running(me)
+        return np.stack(out)
+
+    def _do_collective(self, event, comm, payload):
+        me = event.rank
+        with self.cv:
+            self._raise_if_aborted()
+            seq = self.coll_counter.get((me, comm.key), 0)
+            self.coll_counter[(me, comm.key)] = seq + 1
+            gkey = (comm.key, seq)
+            group = self.coll.setdefault(gkey, {})
+            group[me] = (event, payload)
+            members = comm.members
+            if set(group) == set(members):
+                events = [group[m][0] for m in members]
+                found = _match.compare_collective(events)
+                if found:
+                    self._record_locked(found)
+                    self._abort_locked()
+                    raise SimAbort(found[0].message)
+                self.coll_results[gkey] = self._compute_collective(
+                    gkey, members)
+                self.cv.notify_all()
+            else:
+                while gkey not in self.coll_results:
+                    self._block(me, event, ("coll", gkey, members))
+                    self._stall_check_locked()
+                    self.cv.wait(0.05)
+                    self._raise_if_aborted()
+            self._set_running(me)
+            results = self.coll_results[gkey]
+            value = results.pop(me)
+            if not results:
+                del self.coll_results[gkey]
+                del self.coll[gkey]
+            return value
+
+    def _compute_collective(self, gkey, members):
+        group = self.coll[gkey]
+        kind = group[members[0]][0].kind
+        stack = [np.asarray(group[m][1]) for m in members
+                 if group[m][1] is not None]
+        out = {}
+        if kind == "barrier":
+            for m in members:
+                out[m] = np.zeros((), np.int32)
+        elif kind == "allreduce":
+            red = _fold(group[members[0]][0].reduce_op, stack)
+            for m in members:
+                out[m] = red
+        elif kind == "reduce":
+            root_ev = group[members[0]][0]
+            red = _fold(root_ev.reduce_op, stack)
+            for i, m in enumerate(members):
+                out[m] = red if i == root_ev.root else np.asarray(
+                    group[m][1])
+        elif kind == "scan":
+            op = group[members[0]][0].reduce_op
+            for i, m in enumerate(members):
+                out[m] = _fold(op, stack[:i + 1])
+        elif kind == "bcast":
+            root = group[members[0]][0].root
+            val = np.asarray(group[members[root]][1])
+            for m in members:
+                out[m] = val
+        elif kind == "allgather":
+            val = np.stack(stack)
+            for m in members:
+                out[m] = val
+        elif kind == "gather":
+            root = group[members[0]][0].root
+            val = np.stack(stack)
+            for i, m in enumerate(members):
+                out[m] = val if i == root else np.asarray(group[m][1])
+        elif kind == "scatter":
+            root = group[members[0]][0].root
+            rows = np.asarray(group[members[root]][1])
+            for i, m in enumerate(members):
+                out[m] = rows[i]
+        elif kind == "alltoall":
+            for i, m in enumerate(members):
+                out[m] = np.stack(
+                    [np.asarray(group[mj][1])[i] for mj in members])
+        else:  # split/dup rendezvous values are computed by the caller
+            for m in members:
+                out[m] = None
+        return out
+
+    # -- comm management (FakeComm.split/dup route here) ----------------
+
+    def split_collective(self, comm, color, key, _dup=False):
+        comm._split_seq += 1
+        seq = comm._split_seq
+        me_local = comm.rank()
+        me_world = comm.members[me_local]
+        sort_key = me_local if key is None else int(key)
+        event = CommEvent(
+            rank=me_world, idx=len(self.schedules[me_world]),
+            kind="split", comm=comm.key, site=self._site())
+        with self.cv:
+            self.schedules[me_world].append(event)
+            gkey = (comm.key, "split", seq)
+            group = self.coll.setdefault(gkey, {})
+            group[me_world] = (event, (color, sort_key, me_local))
+            members = comm.members
+            if set(group) == set(members):
+                results = {}
+                by_color = {}
+                for m in members:
+                    c, k, loc = group[m][1]
+                    if c < 0:
+                        results[m] = None
+                        continue
+                    by_color.setdefault(c, []).append((k, loc, m))
+                for c, entries in by_color.items():
+                    entries.sort()
+                    sub_members = tuple(m for _, _, m in entries)
+                    new_key = comm.key + (seq, c)
+                    self.comms[new_key] = sub_members
+                    for sub_rank, (_, _, m) in enumerate(entries):
+                        results[m] = (new_key, sub_members, sub_rank)
+                self.coll_results[gkey] = results
+                self.cv.notify_all()
+            else:
+                while gkey not in self.coll_results:
+                    self._block(me_world, event, ("coll", gkey, members))
+                    self._stall_check_locked()
+                    self.cv.wait(0.05)
+                    self._raise_if_aborted()
+            self._set_running(me_world)
+            results = self.coll_results[gkey]
+            mine = results.pop(me_world)
+            if not results:
+                del self.coll_results[gkey]
+                del self.coll[gkey]
+        if mine is None:
+            return None
+        new_key, sub_members, sub_rank = mine
+        return AbstractComm(sub_rank, len(sub_members), key=new_key,
+                            members=sub_members, session=self)
+
+    def dup_collective(self, comm):
+        return self.split_collective(comm, 0, None, _dup=True)
+
+    # -- chain-guard hooks ----------------------------------------------
+
+    def _token_trace(self, tok=None):
+        ident = threading.get_ident()
+        ctx = self._token_ctx.get(ident)
+        if ctx is None:
+            ctx = self._token_ctx[ident] = _TokenCtx()
+        if tok is not None:
+            ctx.refs.append(tok)
+        return ctx
+
+    def _token_warn(self, comm, n_heads, how):
+        rank = None
+        if isinstance(comm, AbstractComm):
+            rank = comm.members[comm.rank()]
+        finding = Finding(
+            "token_violation",
+            f"a world op on {comm!r} is {how} while {n_heads} other token "
+            "chain(s) on the same comm are live — relative order is "
+            "UNDEFINED in explicit-token mode and can deadlock",
+            ranks=() if rank is None else (rank,),
+            comm=comm.key if isinstance(comm, AbstractComm) else (),
+            sites=(self._site(),),
+        )
+        with self.cv:
+            self._record_locked([finding])
+
+    # -- bookkeeping ----------------------------------------------------
+
+    def _record_locked(self, findings):
+        for f in findings:
+            key = (f.kind, f.ranks, f.comm, f.message)
+            if key in self._finding_keys:
+                continue
+            self._finding_keys.add(key)
+            self.findings.append(f)
+
+    def _raise_if_aborted(self):
+        if self.aborted:
+            raise SimAbort("virtual world aborted")
+
+    def _abort_locked(self):
+        self.aborted = True
+        self.cv.notify_all()
+
+    def _block(self, rank, event, info):
+        self.state[rank] = "blocked"
+        self.blocked[rank] = (event, info)
+
+    def _set_running(self, rank):
+        self.state[rank] = "running"
+        self.blocked.pop(rank, None)
+
+    def _satisfiable_locked(self, event, info) -> bool:
+        """Fresh check: can this blocked op still make progress?"""
+        kind = info[0]
+        if kind == "recv":
+            comm, source_local, tag = info[1], info[2], info[3]
+            me = event.rank
+            if source_local == ANY_SOURCE:
+                for src_w in comm.members:
+                    q = self.channels.get((comm.key, src_w, me))
+                    if q and (tag in (None, ANY_TAG)
+                              or q[0][1].tag == tag):
+                        return True
+                return False
+            return bool(self.channels.get(
+                (comm.key, comm.members[source_local], me)))
+        if kind == "coll":
+            gkey, members = info[1], info[2]
+            if gkey in self.coll_results:
+                return True  # result computed, pickup pending
+            group = self.coll.get(gkey, {})
+            return set(group) == set(members)
+        return True  # unknown: never declare a stall on it
+
+    def _waits_on_locked(self, event, info):
+        kind = info[0]
+        if kind == "recv":
+            comm, source_local = info[1], info[2]
+            if source_local == ANY_SOURCE:
+                return tuple(m for m in comm.members if m != event.rank)
+            return (comm.members[source_local],)
+        if kind == "coll":
+            gkey, members = info[1], info[2]
+            group = self.coll.get(gkey, {})
+            return tuple(m for m in members if m not in group)
+        return ()
+
+    def _stall_check_locked(self):
+        """Declare a stall only when it is PROVEN: nobody is running and
+        no blocked op can make progress.  Every predicate is re-evaluated
+        fresh under the lock — state captured at block time can be stale
+        (a result may be computed but not yet picked up)."""
+        if self.aborted:
+            return
+        if any(s in ("init", "running") for s in self.state.values()):
+            return
+        blocked = {r: be for r, be in self.blocked.items()
+                   if self.state[r] == "blocked"}
+        if not blocked:
+            return
+        if any(self._satisfiable_locked(ev, info)
+               for ev, info in blocked.values()):
+            return
+        blocked_evs = {r: ev for r, (ev, _) in blocked.items()}
+        waits_on = {r: self._waits_on_locked(ev, info)
+                    for r, (ev, info) in blocked.items()}
+        done = frozenset(r for r, s in self.state.items()
+                         if s in ("done", "failed"))
+        found = _match.wait_graph_findings(blocked_evs, waits_on, done)
+        if found:
+            self._record_locked(found)
+        self._abort_locked()
+
+    def _record_rank_error(self, rank, err):
+        site = ""
+        if isinstance(err, BaseException):
+            for frame in traceback.extract_tb(err.__traceback__):
+                if os.path.abspath(frame.filename) == self.program:
+                    site = (f"{os.path.basename(frame.filename)}:"
+                            f"{frame.lineno} `{(frame.line or '').strip()[:70]}`")
+            message = (f"rank {rank} raised "
+                       f"{type(err).__name__}: {err}")
+        else:
+            message = f"rank {rank} {err}"
+        with self.cv:
+            self._record_locked([Finding(
+                "rank_error", message, ranks=(rank,),
+                sites=(site,) if site else (),
+            )])
+
+    # -- the run --------------------------------------------------------
+
+    def _rank_main(self, rank, code):
+        from ..parallel import mesh
+
+        comm = AbstractComm(rank, self.size, key=(0,),
+                            members=tuple(range(self.size)), session=self)
+        mesh._push_comm(comm)
+        with self.cv:
+            self.state[rank] = "running"
+        ok = False
+        g = {"__name__": "__main__", "__file__": self.program,
+             "__builtins__": __builtins__}
+        try:
+            exec(code, g)
+            ok = True
+        except SystemExit as e:
+            ok = e.code in (None, 0)
+            if not ok:
+                self._record_rank_error(rank, f"exited with code {e.code}")
+        except SimAbort:
+            pass  # the abort's cause is already a finding
+        except BaseException as e:  # noqa: BLE001 - report, then classify
+            self._record_rank_error(rank, e)
+        finally:
+            with self.cv:
+                self.state[rank] = "done" if ok else "failed"
+                self.blocked.pop(rank, None)
+                self.cv.notify_all()
+                self._stall_check_locked()
+
+    def run(self) -> Report:
+        import jax
+
+        from ..ops import _world_impl
+
+        with open(self.program) as f:
+            src = f.read()
+        code = compile(src, self.program, "exec")
+        old_disable = bool(jax.config.jax_disable_jit)
+        # programs mutate process-global jax config at import (x64 is the
+        # common one); snapshot so one analyzed program cannot leak into
+        # the next run in this process
+        old_x64 = bool(jax.config.jax_enable_x64)
+        # the program sees its own argv, exactly as under the launcher
+        old_argv = sys.argv
+        sys.argv = [self.program] + self.argv
+        jax.config.update("jax_disable_jit", True)
+        _world_impl._set_analysis_executor(self)
+        _world_impl._set_analysis_token_hooks(self._token_trace,
+                                              self._token_warn)
+        out_buf = io.StringIO()
+        threads = [
+            threading.Thread(target=self._rank_main, args=(r, code),
+                             daemon=True, name=f"analysis-rank-{r}")
+            for r in range(self.size)
+        ]
+        t0 = time.monotonic()
+        try:
+            with contextlib.redirect_stdout(out_buf), \
+                    contextlib.redirect_stderr(out_buf):
+                for t in threads:
+                    t.start()
+                if self.timeout_s > 0:
+                    deadline = t0 + self.timeout_s
+                    for t in threads:
+                        t.join(max(0.1, deadline - time.monotonic()))
+                else:  # 0 = no deadline (the stall detector still runs)
+                    for t in threads:
+                        t.join()
+                if any(t.is_alive() for t in threads):
+                    with self.cv:
+                        self._record_locked([Finding(
+                            "analysis_timeout",
+                            f"virtual world did not finish within "
+                            f"{self.timeout_s:g}s; rank states: "
+                            f"{dict(sorted(self.state.items()))}",
+                        )])
+                        self._abort_locked()
+                    for t in threads:
+                        t.join(2.0)
+        finally:
+            _world_impl._set_analysis_executor(None)
+            _world_impl._set_analysis_token_hooks(None, None)
+            sys.argv = old_argv
+            jax.config.update("jax_disable_jit", old_disable)
+            jax.config.update("jax_enable_x64", old_x64)
+        with self.cv:
+            if not self.aborted:
+                seen_chan = set()
+                for (ckey, s, d), q in self.channels.items():
+                    if not q or (ckey, s, d) in seen_chan:
+                        continue
+                    seen_chan.add((ckey, s, d))
+                    _, ev = q[0]
+                    self._record_locked([Finding(
+                        "unmatched_send",
+                        f"rank {s} sends to rank {d} (tag {ev.tag}) but "
+                        "no matching receive ever runs "
+                        f"({len(q)} message(s) queued)",
+                        ranks=(s, d), comm=ckey,
+                        sites=(f"rank {s}: {ev.describe()}",),
+                    )])
+            self._record_locked(
+                _match.order_critical_findings(self.schedules, self.comms))
+        return Report(
+            world_size=self.size,
+            target=self.program,
+            findings=list(self.findings),
+            schedules={r: [e.describe() for e in evs]
+                       for r, evs in self.schedules.items()},
+            output=out_buf.getvalue(),
+        )

@@ -1,0 +1,17 @@
+"""``python -m mpi4jax_tpu.analyze`` — static communication verifier CLI.
+
+Thin entry point; the implementation lives in
+:mod:`mpi4jax_tpu.analysis._cli`.
+"""
+
+import os
+import sys
+
+# the analyzed program never talks to a device; pin cpu before any
+# backend initialization so analysis runs identically on every host
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from .analysis import _cli  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(_cli.main())

@@ -43,6 +43,83 @@ _OP_CODE = {op.name: i for i, op in enumerate(ALL_OPS)}
 
 _STAGED_EAGER = None
 
+# ---------------- static-analysis hooks (mpi4jax_tpu.analysis) ----------
+#
+# Each primitive exports its *schedule signature* — how to read one
+# communication event off its params — and every eager impl offers itself
+# to an installed analysis executor before touching the real transport.
+# The executor (analysis._sim.VirtualWorld) owns ops whose comm is an
+# AbstractComm; with none installed the hooks are two predicate checks.
+
+#: primitive base name -> param roles for the communication verifier.
+#: "peer"-valued entries name primitive params holding comm-local ranks;
+#: token variants ("mpi4jax_tpu_<name>_t") share the base signature.
+SCHEDULE_SIGNATURES = {
+    "allreduce": {"kind": "allreduce", "reduce_op": "op"},
+    "reduce": {"kind": "reduce", "reduce_op": "op", "root": "root"},
+    "scan": {"kind": "scan", "reduce_op": "op"},
+    "bcast": {"kind": "bcast", "root": "root"},
+    "allgather": {"kind": "allgather"},
+    "gather": {"kind": "gather", "root": "root"},
+    "scatter": {"kind": "scatter", "root": "root"},
+    "alltoall": {"kind": "alltoall"},
+    "barrier": {"kind": "barrier"},
+    "send": {"kind": "send", "dest": "dest", "tag": "tag"},
+    "recv": {"kind": "recv", "source": "source", "tag": "tag"},
+    "sendrecv": {"kind": "sendrecv", "source": "source", "dest": "dest",
+                 "sendtag": "sendtag", "recvtag": "recvtag"},
+    "shift2": {"kind": "shift2", "lo": "lo", "hi": "hi", "tag": "tag"},
+}
+
+
+def schedule_signature(prim_name: str):
+    """(base_name, signature, is_token_variant) for a world primitive
+    name, or None for foreign primitives."""
+    if not prim_name.startswith("mpi4jax_tpu_"):
+        return None
+    base = prim_name[len("mpi4jax_tpu_"):]
+    token_variant = base.endswith("_t")
+    if token_variant:
+        base = base[:-2]
+    sig = SCHEDULE_SIGNATURES.get(base)
+    if sig is None:
+        return None
+    return base, sig, token_variant
+
+
+_analysis_executor = None
+
+
+def _set_analysis_executor(executor):
+    """Install (or with None remove) the virtual-world executor that
+    serves world-tier impls during program analysis."""
+    global _analysis_executor
+    _analysis_executor = executor
+
+
+def _analysis_intercept(prim_name, args, params):
+    """Route an eager bind to the analysis executor when one is installed
+    and owns the op's comm.  Returns None when the op should execute
+    normally."""
+    ex = _analysis_executor
+    if ex is not None and ex.owns(params.get("comm")):
+        return ex.run_primitive(prim_name, args, params)
+    return None
+
+
+# During virtual-world analysis everything executes eagerly, so the token
+# chain guard below — which normally watches tracers — is handed a
+# per-rank-thread pseudo-trace to key its state on, plus a hook that turns
+# its warnings into structured findings.  Both are None outside analysis.
+_analysis_token_trace = None   # fn(tok=None) -> pseudo-trace object
+_analysis_warn_hook = None     # fn(comm, n_heads, how) -> None
+
+
+def _set_analysis_token_hooks(token_trace, warn_hook):
+    global _analysis_token_trace, _analysis_warn_hook
+    _analysis_token_trace = token_trace
+    _analysis_warn_hook = warn_hook
+
 # ---------------- ordering mode ----------------
 #
 # JAX refuses ORDERED effects in computations spanning more than one
@@ -58,9 +135,9 @@ _STAGED_EAGER = None
 # A jax config state (not a bare global) so the mode participates in the
 # jit cache key and trace context: a function traced inside the context
 # must never be silently reused outside it (and vice versa).
-from jax._src import config as _jax_config  # noqa: E402
+from ..utils import jax_compat as _jax_compat  # noqa: E402
 
-_explicit_tokens_cfg = _jax_config.bool_state(
+_explicit_tokens_cfg = _jax_compat.bool_state(
     name="mpi4jax_tpu_explicit_tokens",
     default=False,
     help=(
@@ -180,6 +257,10 @@ class _TokenChainGuard:
 
         if isinstance(tok, jax.core.Tracer):
             return getattr(tok, "_trace", None)
+        if _analysis_token_trace is not None:
+            # virtual-world analysis: concrete tokens, Python-ordered per
+            # rank thread — key chain state on the thread's pseudo-trace
+            return _analysis_token_trace(tok)
         return None
 
     def note_rooted(self, tok):
@@ -229,7 +310,9 @@ class _TokenChainGuard:
             return
         trace = getattr(core.trace_ctx, "trace", None)
         if trace is None or type(trace).__name__ == "EvalTrace":
-            return
+            if _analysis_token_trace is None:
+                return
+            trace = _analysis_token_trace()
         ent = self._heads.get((id(comm), id(trace)))
         if ent and ent[1]:
             self._warn(comm, len(ent[1]), "traced with no token")
@@ -238,6 +321,9 @@ class _TokenChainGuard:
         import warnings
 
         from ..utils import config as _config
+
+        if _analysis_warn_hook is not None:
+            _analysis_warn_hook(comm, n_heads, how)
 
         msg = (
             f"explicit_token_ordering: a world op on comm {comm!r} is "
@@ -368,6 +454,9 @@ def _staged_eager_impl(p, out_aval_fn, host_fn):
     """
 
     def eager_impl(*args, **params):
+        analyzed = _analysis_intercept(p.name, args, params)
+        if analyzed is not None:
+            return analyzed
         if _use_staged_eager():
             host_params = {k: v for k, v in params.items() if k != "ordered"}
             avals = [core.get_aval(a) for a in args]
@@ -620,6 +709,10 @@ def _make_token_variant(name, out_aval_fn, host_fn, n_data=1,
     def impl(*args, **params):
         if _is_identity(params):
             return args[0], args[n_data]
+        analyzed = _analysis_intercept(
+            p.name, args[:n_data], _host_params(params))
+        if analyzed is not None:
+            return analyzed, args[n_data]
         if _use_staged_eager():
             data, tok = args[:n_data], args[n_data]
             avals = [core.get_aval(a) for a in data]
@@ -922,6 +1015,7 @@ _allreduce_staged = _staged_eager_impl(
 def _allreduce_impl(x, *, comm, op, transpose=False, ordered=True):
     if transpose:
         return x  # identity: skip the staging D2H/H2D round trip too
+    # (_allreduce_staged's eager_impl performs the analysis intercept)
     return _allreduce_staged(x, comm=comm, op=op, transpose=transpose,
                              ordered=ordered)
 
@@ -1445,7 +1539,8 @@ def scatter(x, root, comm):
     if x.ndim < 1 or x.shape[0] != comm.size():
         raise ValueError(
             f"scatter requires input shape (size, ...) = ({comm.size()}, "
-            f"...), got {x.shape}"
+            f"...), got {x.shape} [scatter, rank "
+            f"{comm.rank()}/{comm.size()}, dtype {x.dtype}]"
         )
     return scatter_p.bind(x, comm=comm, root=root, ordered=_ordered_now())
 
@@ -1455,7 +1550,8 @@ def alltoall(x, comm):
     if x.ndim < 1 or x.shape[0] != comm.size():
         raise ValueError(
             f"alltoall requires leading axis == communicator size "
-            f"({comm.size()}), got shape {x.shape}"
+            f"({comm.size()}), got shape {x.shape} [alltoall, rank "
+            f"{comm.rank()}/{comm.size()}, dtype {x.dtype}]"
         )
     return alltoall_p.bind(x, comm=comm, ordered=_ordered_now())
 

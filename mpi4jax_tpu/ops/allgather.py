@@ -21,6 +21,7 @@ def allgather(x, *, comm=None, token=None):
     else:
         from . import _world_impl
 
+        _validation.check_wire_dtype("allgather", x, comm)
         body = lambda v: _world_impl.allgather(v, comm)
         return _dispatch.maybe_tokenized(
             body, x, token,

@@ -11,7 +11,6 @@ by the double-transpose tests.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 from ..utils import validation as _validation
 from . import _dispatch, _mesh_impl
@@ -37,11 +36,14 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
 
     if compression is not None:
         if compression != "int8":
-            raise ValueError(f"unknown compression {compression!r}")
+            _validation.fail(
+                f"unknown compression {compression!r}; supported: 'int8'",
+                op="allreduce", comm=comm, x=x, exc=ValueError)
         if op.name != "SUM":
-            raise NotImplementedError(
-                "compression='int8' is supported with op=SUM"
-            )
+            _validation.fail(
+                f"compression='int8' is supported with op=SUM, got "
+                f"{op.name}",
+                op="allreduce", comm=comm, x=x, exc=NotImplementedError)
         if _dispatch.is_mesh(comm):
             from .quantized import quantized_allreduce_sum
 
@@ -57,7 +59,8 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
     else:
         from . import _world_impl
 
-        op.check_dtype(jnp.result_type(x))
+        _validation.check_reduce_dtype("allreduce", op, x, comm)
+        _validation.check_wire_dtype("allreduce", x, comm)
         body = lambda v: _world_impl.allreduce(v, op, comm)
         if op.custom:  # allgather + local fold, token-chained
             return _dispatch.maybe_tokenized(

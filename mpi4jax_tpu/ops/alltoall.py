@@ -26,12 +26,13 @@ def alltoall(x, *, comm=None, token=None):
     else:
         from . import _world_impl
 
+        _validation.check_wire_dtype("alltoall", x, comm)
         body = lambda v: _world_impl.alltoall(v, comm)
         if x.ndim < 1 or x.shape[0] != comm.size():
-            raise ValueError(
+            _validation.fail(
                 f"alltoall requires leading axis == communicator size "
-                f"({comm.size()}), got shape {x.shape}"
-            )
+                f"({comm.size()})",
+                op="alltoall", comm=comm, x=x, exc=ValueError)
         return _dispatch.maybe_tokenized(
             body, x, token,
             token_fn=_world_impl.token_variant_fn("alltoall", comm=comm))

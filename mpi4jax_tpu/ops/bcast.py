@@ -24,7 +24,9 @@ def bcast(x, root=0, *, comm=None, token=None):
     else:
         from . import _world_impl
 
-        _validation.check_in_range("root", root, comm.size())
+        _validation.check_in_range("root", root, comm.size(),
+                                   op="bcast", comm=comm)
+        _validation.check_wire_dtype("bcast", x, comm)
         body = lambda v: _world_impl.bcast(v, root, comm)
         return _dispatch.maybe_tokenized(
             body, x, token,

@@ -29,7 +29,9 @@ def gather(x, root=0, *, comm=None, token=None):
     else:
         from . import _world_impl
 
-        _validation.check_in_range("root", root, comm.size())
+        _validation.check_in_range("root", root, comm.size(),
+                                   op="gather", comm=comm)
+        _validation.check_wire_dtype("gather", x, comm)
         body = lambda v: _world_impl.gather(v, root, comm)
         return _dispatch.maybe_tokenized(
             body, x, token,

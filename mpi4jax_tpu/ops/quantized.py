@@ -47,18 +47,21 @@ def _quantize(x):
     return q, scale
 
 
-def check_quantizable(dtype):
+def check_quantizable(x, comm=None):
     """int8 compression is defined for real floating inputs only: the
     quantize/dequantize round-trip runs in f32 (complex would silently
     drop the imaginary part; integers would lose exactness the normal
     path guarantees)."""
     import numpy as np
 
-    if not jnp.issubdtype(np.dtype(dtype), jnp.floating):
-        raise TypeError(
+    from ..utils import validation as _validation
+
+    if not jnp.issubdtype(np.dtype(x.dtype), jnp.floating):
+        _validation.fail(
             f"compression='int8' requires a real floating dtype, got "
-            f"{np.dtype(dtype).name}; use the uncompressed allreduce"
-        )
+            f"{np.dtype(x.dtype).name}; use the uncompressed allreduce",
+            op="allreduce(compression='int8')", comm=comm, x=x,
+            exc=TypeError)
 
 
 def _quantized_schedule(x, size, alltoall, allgather):
@@ -93,7 +96,7 @@ def quantized_allreduce_sum(x, axis):
     Returns an approximation of ``psum(x, axis)`` with ~1e-2 relative
     error; payload on the wire is ~1/4 of the float32 collective.
     """
-    check_quantizable(x.dtype)
+    check_quantizable(x)
     size = lax.axis_size(axis)
     x = _mesh_impl.as_varying(x, axis)
     return _quantized_schedule(
@@ -111,7 +114,7 @@ def quantized_allreduce_sum_world(x, comm):
     where the ~4x byte saving is the point)."""
     from . import _world_impl
 
-    check_quantizable(x.dtype)
+    check_quantizable(x, comm)
     return _quantized_schedule(
         x, comm.size(),
         lambda rows: _world_impl.alltoall(rows, comm),

@@ -54,5 +54,7 @@ def recv(x, source, tag=None, *, comm=None, token=None, status=None):
     from . import _world_impl
 
     if source != ANY_SOURCE:
-        _validation.check_in_range("source", source, comm.size())
+        _validation.check_in_range("source", source, comm.size(),
+                                   op="recv", comm=comm)
+    _validation.check_wire_dtype("recv", x, comm)
     return _world_impl.recv(x, source, tag, comm, token, status)

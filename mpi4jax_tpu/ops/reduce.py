@@ -9,7 +9,6 @@ same collective a rooted reduce would use on ICI anyway.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 from ..utils import validation as _validation
 from . import _dispatch, _mesh_impl
@@ -28,8 +27,10 @@ def reduce(x, op=SUM, root=0, *, comm=None, token=None):
     else:
         from . import _world_impl
 
-        _validation.check_in_range("root", root, comm.size())
-        op.check_dtype(jnp.result_type(x))
+        _validation.check_in_range("root", root, comm.size(),
+                                   op="reduce", comm=comm)
+        _validation.check_reduce_dtype("reduce", op, x, comm)
+        _validation.check_wire_dtype("reduce", x, comm)
         body = lambda v: _world_impl.reduce(v, op, root, comm)
         if op.custom:  # gather + local fold at root, token-chained
             return _dispatch.maybe_tokenized(

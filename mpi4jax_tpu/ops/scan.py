@@ -8,7 +8,6 @@ host round-trips.
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 
 from ..utils import validation as _validation
 from . import _dispatch, _mesh_impl
@@ -26,7 +25,8 @@ def scan(x, op=SUM, *, comm=None, token=None):
     else:
         from . import _world_impl
 
-        op.check_dtype(jnp.result_type(x))
+        _validation.check_reduce_dtype("scan", op, x, comm)
+        _validation.check_wire_dtype("scan", x, comm)
         body = lambda v: _world_impl.scan(v, op, comm)
         if op.custom:  # allgather + local prefix fold, token-chained
             return _dispatch.maybe_tokenized(

@@ -25,13 +25,15 @@ def scatter(x, root=0, *, comm=None, token=None):
     else:
         from . import _world_impl
 
-        _validation.check_in_range("root", root, comm.size())
+        _validation.check_in_range("root", root, comm.size(),
+                                   op="scatter", comm=comm)
+        _validation.check_wire_dtype("scatter", x, comm)
         body = lambda v: _world_impl.scatter(v, root, comm)
         if x.ndim < 1 or x.shape[0] != comm.size():
-            raise ValueError(
+            _validation.fail(
                 f"scatter requires input shape (size, ...) = "
-                f"({comm.size()}, ...), got {x.shape}"
-            )
+                f"({comm.size()}, ...)",
+                op="scatter", comm=comm, x=x, exc=ValueError)
         return _dispatch.maybe_tokenized(
             body, x, token,
             token_fn=_world_impl.token_variant_fn("scatter", comm=comm,

@@ -35,5 +35,7 @@ def send(x, dest, tag=0, *, comm=None, token=None):
 
     from . import _world_impl
 
-    _validation.check_in_range("dest", dest, comm.size())
+    _validation.check_in_range("dest", dest, comm.size(),
+                               op="send", comm=comm)
+    _validation.check_wire_dtype("send", x, comm)
     return _world_impl.send(x, dest, tag, comm, token)

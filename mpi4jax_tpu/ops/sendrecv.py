@@ -95,6 +95,7 @@ def sendrecv(x, *, perm=None, shift=None, wrap=True, source=None, dest=None,
 
     from . import _world_impl
 
+    _validation.check_wire_dtype("sendrecv", x, comm)
     return _world_impl.sendrecv_dispatch(
         x, perm=perm, shift=shift, wrap=wrap, comm=comm, token=token,
         source=source, dest=dest, sendtag=sendtag, recvtag=recvtag,

@@ -98,6 +98,15 @@ def get_lib() -> ctypes.CDLL:
     global _lib
     if _lib is not None:
         return _lib
+    so_path = config.native_lib_override()
+    if so_path is not None:
+        # explicit library (sanitizer builds, cross-build tests): load it
+        # verbatim — no staleness heuristics, no rebuild
+        if not os.path.exists(so_path):
+            raise RuntimeError(
+                f"MPI4JAX_TPU_NATIVE_LIB={so_path} does not exist"
+            )
+        return _finish_lib_setup(ctypes.CDLL(so_path))
     if _stale():
         if not os.path.exists(_SRC) and not os.path.exists(_SO_PATH):
             raise RuntimeError(
@@ -118,7 +127,11 @@ def get_lib() -> ctypes.CDLL:
                 f"rebuilding stale native transport failed ({e}); using the "
                 f"existing {_SO_PATH}"
             )
-    lib = ctypes.CDLL(_SO_PATH)
+    return _finish_lib_setup(ctypes.CDLL(_SO_PATH))
+
+
+def _finish_lib_setup(lib: ctypes.CDLL) -> ctypes.CDLL:
+    global _lib
     lib.tpucomm_init.restype = ctypes.c_int64
     lib.tpucomm_init.argtypes = [
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,

@@ -215,6 +215,74 @@ def check_failure_detection(port):
                   f"({deadline_s:g}s deadline, stuck peer named)")
 
 
+def check_static_verify():
+    """The static communication verifier reaches correct verdicts: a
+    known-bad snippet (tag mismatch) is flagged with the right finding
+    kind and a known-good snippet verifies clean — all without spawning
+    a process or opening a socket."""
+    import tempfile
+
+    bad = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "import mpi4jax_tpu as m4j\n"
+        "comm = m4j.get_default_comm()\n"
+        "x = jnp.arange(3.0)\n"
+        "if comm.rank() == 0:\n"
+        "    m4j.send(x, dest=1, tag=5, comm=comm)\n"
+        "else:\n"
+        "    m4j.recv(x, source=0, tag=7, comm=comm)\n"
+    )
+    good = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "import mpi4jax_tpu as m4j\n"
+        "comm = m4j.get_default_comm()\n"
+        "out = m4j.allreduce(jnp.arange(4.0), op=m4j.SUM, comm=comm)\n"
+        "assert float(out[1]) == 2.0, out\n"
+    )
+    t0 = time.perf_counter()
+    verdicts = []
+    for name, src, want_rc in (("bad", bad, 3), ("good", good, 0)):
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=f"_m4j_diag_{name}.py", delete=False
+        ) as f:
+            f.write(src)
+            prog = f.name
+        try:
+            env = dict(os.environ)
+            env.setdefault("PYTHONPATH", REPO)
+            env.setdefault("JAX_PLATFORMS", "cpu")
+            res = subprocess.run(
+                [sys.executable, "-m", "mpi4jax_tpu.analyze", prog,
+                 "-n", "2", "--json"],
+                capture_output=True, text=True, timeout=150, env=env,
+                cwd=REPO,
+            )
+        except subprocess.TimeoutExpired:
+            return False, f"analyzer hung on the {name} snippet"
+        finally:
+            os.unlink(prog)
+        if res.returncode != want_rc:
+            return False, (
+                f"{name} snippet: expected exit {want_rc}, got "
+                f"{res.returncode}: {(res.stderr or res.stdout)[-150:]}"
+            )
+        if name == "bad":
+            data = json.loads(res.stdout)
+            kinds = {f["kind"] for f in data["findings"]}
+            if "tag_mismatch" not in kinds:
+                return False, f"bad snippet flagged as {sorted(kinds)}"
+            verdicts.append("tag_mismatch flagged")
+        else:
+            verdicts.append("clean verified")
+    dt = time.perf_counter() - t0
+    return True, (f"{' + '.join(verdicts)} in {dt:.1f}s, "
+                  "no process spawned, no live comm")
+
+
 def check_device_claim():
     """A fresh process can claim the accelerator."""
     rc, out, _ = _run_snippet(
@@ -288,6 +356,7 @@ def main(argv=None):
         ("native_build", check_native_build),
         ("ffi_fast_path", check_ffi),
         ("coll_algo_engine", check_coll_algo_engine),
+        ("static_verify", check_static_verify),
         ("transport_loopback", lambda: check_transport_loopback(args.port)),
         ("failure_detection",
          lambda: check_failure_detection(args.port + 7)),

@@ -105,6 +105,51 @@ def _terminate_group(procs, grace=None):
             p.wait()
 
 
+def _preflight_verify(prog: str, np_: int, prog_args=()) -> int:
+    """Run the static communication verifier on ``prog`` before spawning
+    any rank.  Returns 0 to proceed; 3 (with the findings table on
+    stderr) when verification fails; the analyzer's own code on analyzer
+    errors.
+
+    Runs as a subprocess on purpose: the launcher itself imports no jax,
+    and a verifier crash must not take the launcher down with it.
+    """
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env.setdefault("PYTHONPATH", repo)
+    # warnings document assumptions and do not block a launch; the "--"
+    # keeps the program's own flags out of the analyzer's parser
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analyze", prog,
+         "--np", str(np_), "--errors-only", "--", *prog_args],
+        capture_output=True, text=True, env=env,
+    )
+    if res.returncode == 0:
+        if "WARNING" in res.stdout:
+            print(f"[launch] --verify: {prog} has warnings at np={np_} "
+                  "(launch proceeds):", file=sys.stderr)
+            sys.stderr.write(res.stdout)
+        else:
+            print(f"[launch] --verify: {prog} clean at np={np_}",
+                  file=sys.stderr)
+        sys.stderr.flush()
+        return 0
+    if res.returncode == 3:
+        print(f"[launch] --verify FAILED for {prog} at np={np_} — "
+              "no rank was spawned:", file=sys.stderr)
+        sys.stderr.write(res.stdout)
+        sys.stderr.write(res.stderr)
+        sys.stderr.flush()
+        return 3
+    print(f"[launch] --verify could not run the analyzer "
+          f"(exit {res.returncode}):", file=sys.stderr)
+    sys.stderr.write(res.stderr[-2000:])
+    sys.stderr.flush()
+    return res.returncode or 2
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m mpi4jax_tpu.runtime.launch",
@@ -132,9 +177,20 @@ def main(argv=None):
                              "start one process per rank with your "
                              "scheduler and set MPI4JAX_TPU_RANK/SIZE "
                              "plus MPI4JAX_TPU_HOSTS directly.")
+    parser.add_argument("--verify", action="store_true",
+                        help="pre-flight: statically verify the program's "
+                             "communication schedule (python -m "
+                             "mpi4jax_tpu.analyze) and exit 3 with the "
+                             "findings table when it fails — BEFORE any "
+                             "rank is spawned")
     parser.add_argument("prog", help="python program to run")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
+
+    if args.verify:
+        rc = _preflight_verify(args.prog, args.np, args.args)
+        if rc != 0:
+            return rc
 
     if args.hosts:
         nhosts = len(args.hosts.split(","))

@@ -8,7 +8,6 @@ table, with one parser.
 Knobs (all prefixed ``MPI4JAX_TPU_``):
 
 - ``MPI4JAX_TPU_DEBUG``       — per-call debug tracing (rank | call-id | op | dt).
-- ``MPI4JAX_TPU_TRANSPORT``   — world-tier transport ("tcp" only for now).
 - ``MPI4JAX_TPU_NO_WARN_JAX_VERSION`` — silence the jax version check.
 - ``MPI4JAX_TPU_DISABLE_FFI`` — skip the native XLA FFI custom-call fast
                                 path on cpu and route world-tier ops through
@@ -105,6 +104,20 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 tune_<world_size>.json``), written by
                                 ``python -m mpi4jax_tpu.tune`` and loaded
                                 at communicator creation.
+- ``MPI4JAX_TPU_ANALYZE_TIMEOUT_S`` — wall-clock deadline (seconds,
+                                default 120; 0 = no deadline) for one
+                                virtual-world run of the static
+                                communication verifier (``python -m
+                                mpi4jax_tpu.analyze`` /
+                                ``launch --verify``); a program that
+                                spins past it fails analysis with an
+                                ``analysis_timeout`` finding.
+- ``MPI4JAX_TPU_NATIVE_LIB``  — absolute path of the native transport
+                                library to load instead of the built
+                                ``runtime/_native/libtpucomm.so``
+                                (sanitizer builds, cross-build tests;
+                                runtime/bridge.py skips the staleness
+                                rebuild when set).
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels
@@ -124,6 +137,40 @@ changes the primary API's return types at a distance would be a footgun.
 from __future__ import annotations
 
 import os
+
+#: The complete knob registry: every environment variable the framework
+#: (Python *and* native layers, launcher, test harness) reads, with a
+#: one-line role.  ``tests/test_config_lint.py`` greps the source tree and
+#: fails when a knob is read anywhere without being declared here — the
+#: docstring above carries the long-form documentation.
+KNOBS = {
+    "MPI4JAX_TPU_DEBUG": "per-call debug tracing",
+    "MPI4JAX_TPU_NO_WARN_JAX_VERSION": "silence the jax version warning",
+    "MPI4JAX_TPU_DISABLE_FFI": "skip the native XLA FFI fast path",
+    "MPI4JAX_TPU_DISABLE_SHM": "force TCP collectives on shared hosts",
+    "MPI4JAX_TPU_SHM_MB": "shm arena slot size (MB)",
+    "MPI4JAX_TPU_SHM_RING_KB": "per-pair shm p2p ring size (KB)",
+    "MPI4JAX_TPU_DISABLE_SHM_P2P": "keep p2p on TCP, collectives on shm",
+    "MPI4JAX_TPU_STRICT_TOKENS": "chain guard: warn/raise/silent",
+    "MPI4JAX_TPU_STAGED_EAGER": "force/forbid staged-eager dispatch",
+    "MPI4JAX_TPU_RANK": "world job: this process's rank",
+    "MPI4JAX_TPU_SIZE": "world job: world size",
+    "MPI4JAX_TPU_COORD": "world job: rendezvous host:base-port",
+    "MPI4JAX_TPU_HOSTS": "world job: per-rank host table",
+    "MPI4JAX_TPU_HOST": "this rank's reachable address (from_mpi)",
+    "MPI4JAX_TPU_SHM_TIMEOUT_S": "shm barrier timeout (seconds)",
+    "MPI4JAX_TPU_TIMEOUT_S": "progress-based transport deadline (seconds)",
+    "MPI4JAX_TPU_CONNECT_TIMEOUT_S": "bootstrap dial/accept deadline",
+    "MPI4JAX_TPU_LAUNCH_GRACE_S": "launcher teardown grace (seconds)",
+    "MPI4JAX_TPU_TEST_TIMEOUT_S": "world-test per-test hard deadline",
+    "MPI4JAX_TPU_FAULT": "deterministic native fault injection",
+    "MPI4JAX_TPU_JOBID": "unique token for /dev/shm segment names",
+    "MPI4JAX_TPU_COLL_ALGO": "force world-tier collective algorithms",
+    "MPI4JAX_TPU_TUNE_CACHE": "persistent autotune cache path",
+    "MPI4JAX_TPU_PALLAS_COLLECTIVES": "route mesh collectives via Pallas",
+    "MPI4JAX_TPU_ANALYZE_TIMEOUT_S": "static verifier wall deadline",
+    "MPI4JAX_TPU_NATIVE_LIB": "override path of the native transport .so",
+}
 
 _TRUTHY = frozenset(("1", "true", "on", "yes", "y"))
 _FALSY = frozenset(("0", "false", "off", "no", "n", ""))
@@ -152,10 +199,6 @@ def setting(name: str, default: str) -> str:
 
 def debug_enabled() -> bool:
     return flag("MPI4JAX_TPU_DEBUG")
-
-
-def transport_name() -> str:
-    return setting("MPI4JAX_TPU_TRANSPORT", "tcp")
 
 
 def ffi_disabled() -> bool:
@@ -197,4 +240,16 @@ def connect_timeout_s() -> float:
 def fault_spec():
     """The raw MPI4JAX_TPU_FAULT spec, or None (parsed/enforced natively)."""
     raw = os.environ.get("MPI4JAX_TPU_FAULT")
+    return raw if raw else None
+
+
+def analyze_timeout_s() -> float:
+    """Resolved MPI4JAX_TPU_ANALYZE_TIMEOUT_S (seconds; default 120;
+    0 = no deadline, matching MPI4JAX_TPU_TIMEOUT_S's convention)."""
+    return _float_knob("MPI4JAX_TPU_ANALYZE_TIMEOUT_S", 120.0)
+
+
+def native_lib_override():
+    """MPI4JAX_TPU_NATIVE_LIB: an explicit transport .so path, or None."""
+    raw = os.environ.get("MPI4JAX_TPU_NATIVE_LIB")
     return raw if raw else None

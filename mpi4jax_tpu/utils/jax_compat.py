@@ -62,3 +62,32 @@ def vma_check_mode():
         return bool(_jcfg._check_vma.value)
     except Exception:
         return None
+
+
+def bool_state(**kwargs):
+    """``jax._src.config.bool_state`` across jax versions.
+
+    Newer keyword-only flags (``include_in_jit_key``,
+    ``include_in_trace_context``) are dropped when the installed jax
+    predates them, so modules defining config states stay *importable* on
+    older jax — wanted by tooling that runs without compiling anything
+    (``mpi4jax_tpu.analysis`` executes eagerly under ``disable_jit``,
+    where the jit-cache-key flag is moot).  Production use is still
+    gated on MIN_JAX_VERSION by ``check_jax_version``.
+    """
+    from jax._src import config as _jcfg
+
+    kw = dict(kwargs)
+    for _ in range(2):
+        try:
+            return _jcfg.bool_state(**kw)
+        except TypeError as err:
+            dropped = False
+            for opt in ("include_in_trace_context", "include_in_jit_key"):
+                if opt in kw and opt in str(err):
+                    kw.pop(opt)
+                    dropped = True
+                    break
+            if not dropped:
+                raise
+    return _jcfg.bool_state(**kw)

@@ -69,9 +69,82 @@ def check_array(name: str, value: Any):
     )
 
 
-def check_in_range(name: str, value: int, size: int):
-    if not 0 <= value < size:
+def op_context(op_name: str, comm=None, x=None) -> str:
+    """Uniform context suffix for ops-layer errors.
+
+    Every validation failure names the op, the rank (or mesh axes — a
+    MeshComm's rank is a traced value, so the axes stand in for it), and
+    the offending array's dtype/shape: ``[allreduce, rank 2/4, dtype
+    float32, shape (4,)]``.  A multi-process job surfaces one rank's
+    traceback; this suffix is what lets the reader place it without
+    re-running under a debugger.
+    """
+    bits = [op_name]
+    if comm is not None:
+        rank = getattr(comm, "_rank", None)
+        if isinstance(rank, (int, np.integer)):
+            bits.append(f"rank {int(rank)}/{comm.size()}")
+        else:
+            axes = getattr(comm, "axes", None)
+            bits.append(f"mesh axes {axes!r}" if axes else "mesh tier")
+    if x is not None:
+        try:
+            aval = _get_aval(x)
+            bits.append(f"dtype {np.dtype(aval.dtype).name}")
+            bits.append(f"shape {tuple(aval.shape)}")
+        except Exception:
+            pass
+    return " [" + ", ".join(bits) + "]"
+
+
+def _get_aval(x):
+    from jax._src import core as _jcore  # stable across jax 0.4-0.9
+
+    return _jcore.get_aval(x)
+
+
+def fail(msg: str, *, op: str, comm=None, x=None, exc=None):
+    """Raise a :class:`ValidationError` (or ``exc``) with op context."""
+    exc = exc or ValidationError
+    raise exc(msg + op_context(op, comm, x))
+
+
+def check_reduce_dtype(op_name: str, reduce_op, x, comm):
+    """Run ``reduce_op.check_dtype`` and re-raise with full op context."""
+    try:
+        reduce_op.check_dtype(_result_dtype(x))
+    except TypeError as err:
         raise ValidationError(
-            f"{name}={value} out of range for communicator of size {size}"
+            f"{err}{op_context(op_name, comm, x)}"
+        ) from None
+
+
+def check_wire_dtype(op_name: str, x, comm):
+    """Fail fast — with op/rank/dtype/shape context — on dtypes the native
+    wire protocol cannot carry, instead of a bare bridge-layer TypeError
+    deep inside a compiled callback."""
+    from . import dtypes as _dtypes
+
+    try:
+        _dtypes.wire_code(_result_dtype(x))
+    except TypeError as err:
+        raise ValidationError(
+            f"{err}{op_context(op_name, comm, x)}"
+        ) from None
+
+
+def _result_dtype(x):
+    try:
+        return _get_aval(x).dtype  # tracers, jax/np arrays
+    except Exception:
+        return np.result_type(x)   # python scalars
+
+
+def check_in_range(name: str, value: int, size: int, *, op=None, comm=None):
+    if not 0 <= value < size:
+        context = op_context(op, comm) if op else ""
+        raise ValidationError(
+            f"{name}={value} out of range for communicator of size "
+            f"{size}{context}"
         )
     return value

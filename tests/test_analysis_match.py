@@ -1,0 +1,190 @@
+"""Unit tests for the N-rank match simulation (analysis/_match.py).
+
+Loaded standalone (no package import, no jax): the matcher is pure
+Python by design, so these run — and the matching rules stay pinned —
+even on hosts whose jax predates the package minimum.
+"""
+
+import importlib.util
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mpi4jax_tpu", "analysis")
+
+
+def _load():
+    """Load _events/_match standalone under a private package name."""
+    if "m4j_an._match" in sys.modules:
+        return sys.modules["m4j_an._events"], sys.modules["m4j_an._match"]
+    pkg = types.ModuleType("m4j_an")
+    pkg.__path__ = [PKG]
+    sys.modules["m4j_an"] = pkg
+    mods = {}
+    for name in ("_events", "_match"):
+        spec = importlib.util.spec_from_file_location(
+            f"m4j_an.{name}", os.path.join(PKG, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"m4j_an.{name}"] = mod
+        spec.loader.exec_module(mod)
+        mods[name] = mod
+    return mods["_events"], mods["_match"]
+
+
+EV, MT = _load()
+WORLD2 = {(0,): (0, 1)}
+
+
+def _send(r, i, dest, tag=0, dtype="float32", shape=(4,)):
+    return EV.CommEvent(r, i, "send", dest=dest, tag=tag, dtype=dtype,
+                        shape=shape, site=f"prog.py:{10 + i}")
+
+
+def _recv(r, i, source, tag=0, dtype="float32", shape=(4,)):
+    return EV.CommEvent(r, i, "recv", source=source, tag=tag, dtype=dtype,
+                        shape=shape, site=f"prog.py:{10 + i}")
+
+
+def _coll(r, i, kind="allreduce", **kw):
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("shape", (8,))
+    if kind in ("allreduce", "reduce", "scan"):
+        kw.setdefault("reduce_op", "SUM")
+    return EV.CommEvent(r, i, kind, **kw)
+
+
+def kinds(findings):
+    return sorted({f.kind for f in findings})
+
+
+def test_clean_pair_and_ring():
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1)], 1: [_recv(1, 0, source=0)]}, WORLD2)
+    assert out == []
+    world3 = {(0,): (0, 1, 2)}
+    ring = {r: [EV.CommEvent(r, 0, "sendrecv", dest=(r + 1) % 3,
+                             source=(r - 1) % 3, sendtag=0, recvtag=0,
+                             dtype="f32", shape=(4,))]
+            for r in range(3)}
+    assert MT.match_schedules(ring, world3) == []
+
+
+def test_tag_mismatch_names_rank_pair_and_sites():
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1, tag=5)],
+         1: [_recv(1, 0, source=0, tag=7)]}, WORLD2)
+    assert kinds(out) == ["tag_mismatch"]
+    f = out[0]
+    assert f.ranks == (0, 1)
+    assert len(f.sites) == 2 and "prog.py:10" in f.sites[0]
+    assert f.severity == "error"
+
+
+def test_dtype_and_shape_mismatch():
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1, dtype="float32")],
+         1: [_recv(1, 0, source=0, dtype="int32")]}, WORLD2)
+    assert kinds(out) == ["dtype_mismatch"]
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1, shape=(4,))],
+         1: [_recv(1, 0, source=0, shape=(8,))]}, WORLD2)
+    assert kinds(out) == ["shape_mismatch"]
+
+
+def test_collective_divergence_kinds():
+    out = MT.match_schedules(
+        {0: [_coll(0, 0, "allreduce")],
+         1: [_coll(1, 0, "bcast", root=1)]}, WORLD2)
+    assert kinds(out) == ["collective_mismatch"]
+    out = MT.match_schedules(
+        {0: [_coll(0, 0, reduce_op="SUM")],
+         1: [_coll(1, 0, reduce_op="MAX")]}, WORLD2)
+    assert kinds(out) == ["reduce_op_mismatch"]
+    out = MT.match_schedules(
+        {0: [_coll(0, 0, "bcast", root=0)],
+         1: [_coll(1, 0, "bcast", root=1)]}, WORLD2)
+    assert kinds(out) == ["root_mismatch"]
+
+
+def test_deadlock_cycle_detected():
+    out = MT.match_schedules(
+        {0: [_recv(0, 0, source=1), _send(0, 1, dest=1)],
+         1: [_recv(1, 0, source=0), _send(1, 1, dest=0)]}, WORLD2)
+    assert "deadlock" in kinds(out)
+    dead = next(f for f in out if f.kind == "deadlock")
+    assert set(dead.ranks) == {0, 1}
+
+
+def test_unmatched_send_and_recv():
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1)], 1: []}, WORLD2)
+    assert kinds(out) == ["unmatched_send"]
+    out = MT.match_schedules(
+        {0: [], 1: [_recv(1, 0, source=0)]}, WORLD2)
+    assert kinds(out) == ["unmatched_recv"]
+
+
+def test_wildcard_starvation_and_scan_skip():
+    any_src = EV.ANY_SOURCE
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1, tag=3)],
+         1: [_recv(1, 0, source=any_src, tag=3),
+             _recv(1, 1, source=any_src, tag=3)]}, WORLD2)
+    assert kinds(out) == ["wildcard_starvation"]
+    # a concrete-tag wildcard must skip an incompatible head and match
+    # the compatible peer (transport regression: wildcard_recv.py §4)
+    world3 = {(0,): (0, 1, 2)}
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=2, tag=7)],
+         1: [_send(1, 0, dest=2, tag=5)],
+         2: [_recv(2, 0, source=any_src, tag=5),
+             _recv(2, 1, source=any_src, tag=7)]}, world3)
+    assert out == []
+
+
+def test_order_critical_exchange_fires_only_on_cycles():
+    # bidirectional raw send/recv -> warning
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1), _recv(0, 1, source=1)],
+         1: [_recv(1, 0, source=0), _send(1, 1, dest=0)]}, WORLD2)
+    assert kinds(out) == ["order_critical_exchange"]
+    assert out[0].severity == "warning"
+    # one-directional traffic stays clean (basic_ops shape)
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1)], 1: [_recv(1, 0, source=0)]}, WORLD2)
+    assert out == []
+
+
+def test_collective_straggler():
+    out = MT.match_schedules(
+        {0: [_coll(0, 0)], 1: []}, WORLD2)
+    assert kinds(out) == ["collective_mismatch"]
+    f = out[0]
+    assert 0 in f.ranks and 1 in f.ranks
+
+
+def test_subcomm_local_rank_translation():
+    # comm (0, 1, 0) has members (world 2, world 3); local 0 <-> world 2
+    comms = {(0,): (0, 1, 2, 3), (0, 1, 0): (2, 3)}
+    sub = (0, 1, 0)
+    out = MT.match_schedules(
+        {0: [], 1: [],
+         2: [EV.CommEvent(2, 0, "send", comm=sub, dest=1, tag=0,
+                          dtype="f32", shape=(2,))],
+         3: [EV.CommEvent(3, 0, "recv", comm=sub, source=0, tag=0,
+                          dtype="f32", shape=(2,))]}, comms)
+    assert out == []
+
+
+def test_report_json_round_trip():
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1, tag=5)],
+         1: [_recv(1, 0, source=0, tag=7)]}, WORLD2)
+    rep = EV.Report(world_size=2, target="prog.py", findings=out)
+    data = rep.to_json()
+    assert data["ok"] is False
+    assert data["findings"][0]["kind"] == "tag_mismatch"
+    assert data["findings"][0]["ranks"] == [0, 1]
+    table = rep.format_table()
+    assert "tag_mismatch" in table and "prog.py:10" in table

@@ -1,0 +1,100 @@
+"""Config-registry lint: every MPI4JAX_TPU_* knob read anywhere in the
+tree must be declared in ``utils/config.py``'s ``KNOBS`` registry (and
+documented in its module docstring), and every registered knob must
+actually be read somewhere — no silent env vars, no stale registry rows.
+
+PR 1 and PR 2 each added knobs by hand; this enforces the discipline.
+Stdlib-only on purpose (``config.py`` is loaded standalone), so the lint
+runs even where jax itself cannot import.
+"""
+
+import importlib.util
+import os
+import re
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PREFIX = "MPI4JAX_TPU_"
+
+# lines that READ env: python os.environ/getenv forms + C/C++ getenv
+_READ_RE = re.compile(
+    r"(os\.environ|getenv|environ\.get|secure_getenv)"
+)
+_KNOB_RE = re.compile(r"MPI4JAX_TPU_[A-Z0-9_]+")
+
+# knob-shaped strings that are not knobs (doc prefixes, format templates)
+_NOT_KNOBS = {PREFIX.rstrip("_"), PREFIX}
+
+
+def _load_config():
+    spec = importlib.util.spec_from_file_location(
+        "m4j_config_lint", os.path.join(REPO, "mpi4jax_tpu", "utils",
+                                        "config.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _source_files(*roots, exts):
+    for root in roots:
+        base = os.path.join(REPO, root)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", "_native")]
+            for name in filenames:
+                if name.endswith(exts):
+                    yield os.path.join(dirpath, name)
+
+
+def _knobs_in(line):
+    return {k for k in _KNOB_RE.findall(line) if k not in _NOT_KNOBS}
+
+
+def test_every_env_read_is_registered():
+    config = _load_config()
+    registered = set(config.KNOBS)
+    offenders = []
+    for path in _source_files("mpi4jax_tpu", "native",
+                              exts=(".py", ".cc", ".h")):
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                if not _READ_RE.search(line):
+                    continue
+                for knob in _knobs_in(line) - registered:
+                    rel = os.path.relpath(path, REPO)
+                    offenders.append(f"{rel}:{lineno}: {knob}")
+    assert not offenders, (
+        "env knobs read but not registered in utils/config.py KNOBS:\n  "
+        + "\n  ".join(sorted(offenders))
+    )
+
+
+def test_every_registered_knob_is_used():
+    config = _load_config()
+    used = set()
+    for path in _source_files("mpi4jax_tpu", "native", "tests",
+                              "benchmarks", "examples",
+                              exts=(".py", ".cc", ".h")):
+        if path.endswith(os.path.join("utils", "config.py")):
+            continue
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                used |= _knobs_in(line)
+    stale = set(config.KNOBS) - used
+    assert not stale, (
+        "knobs registered in utils/config.py KNOBS but never read "
+        f"anywhere: {sorted(stale)}"
+    )
+
+
+def test_every_registered_knob_is_documented():
+    config = _load_config()
+    path = os.path.join(REPO, "mpi4jax_tpu", "utils", "config.py")
+    with open(path, encoding="utf-8") as f:
+        docstring = f.read().split('"""')[1]
+    missing = [k for k in config.KNOBS if k not in docstring]
+    assert not missing, (
+        "knobs in KNOBS but not documented in the config.py module "
+        f"docstring: {sorted(missing)}"
+    )

@@ -21,7 +21,12 @@ def test_diag_cpu_checks():
     assert data["failed"] == 0
     names = {r["check"] for r in data["results"]}
     assert names == {"native_build", "ffi_fast_path", "coll_algo_engine",
-                     "transport_loopback", "failure_detection"}
+                     "static_verify", "transport_loopback",
+                     "failure_detection"}
+    # the static verifier check proves both verdict directions
+    sv = next(r for r in data["results"] if r["check"] == "static_verify")
+    assert "tag_mismatch flagged" in sv["detail"]
+    assert "clean verified" in sv["detail"]
     # the loopback probe reports the engine's pick from a live comm
     loopback = next(r for r in data["results"]
                     if r["check"] == "transport_loopback")

@@ -1,0 +1,175 @@
+"""Native transport under ThreadSanitizer / AddressSanitizer+UBSan.
+
+Slow tier: builds ``native/tpucomm.cc`` with ``make tsan`` / ``make asan``
+(transport-only — no jaxlib headers, no XLA in the loop) and runs a
+2-rank loopback pair under each build, failing on ANY sanitizer report.
+
+The rank processes drive the sanitized library through raw ctypes (no
+jax import: the sanitizer runtimes would otherwise drown the report in
+uninstrumented-interpreter noise), exercising the hot concurrency paths:
+bootstrap accept/dial, framed send/recv both directions, allreduce (the
+algorithm engine's threaded fan-in), and barrier — in a loop, with the
+shm arena on (its lock-free rings are exactly what tsan is for) and off.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+SO_DIR = os.path.join(REPO, "mpi4jax_tpu", "runtime", "_native")
+
+_RANK_SRC = r"""
+import ctypes, os, sys
+import numpy as np
+
+so = os.environ["SAN_SO"]
+rank = int(os.environ["SAN_RANK"])
+size = 2
+port = int(os.environ["SAN_PORT"])
+
+lib = ctypes.CDLL(so)
+lib.tpucomm_init.restype = ctypes.c_int64
+lib.tpucomm_init.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                             ctypes.c_char_p]
+h = lib.tpucomm_init(rank, size, port, b"")
+assert h > 0, "tpucomm_init failed"
+
+F32, SUM = 11, 0  # wire codes (tpucomm.h)
+n = 1024
+buf = np.arange(n, dtype=np.float32) + rank
+out = np.zeros_like(buf)
+for it in range(20):
+    # p2p both directions (framed path + shm rings when arena is on)
+    if rank == 0:
+        lib.tpucomm_send(h, buf.ctypes.data_as(ctypes.c_void_p),
+                         buf.nbytes, 1, it)
+        rc = lib.tpucomm_recv(h, out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes, 1, it)
+    else:
+        rc = lib.tpucomm_recv(h, out.ctypes.data_as(ctypes.c_void_p),
+                              out.nbytes, 0, it)
+        lib.tpucomm_send(h, buf.ctypes.data_as(ctypes.c_void_p),
+                         buf.nbytes, 0, it)
+    assert rc == 0, f"recv failed at iter {it}"
+    assert out[3] == 3.0 + (1 - rank), out[3]
+    # collective fan-in + barrier
+    rc = lib.tpucomm_allreduce(
+        h, buf.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p), n, F32, SUM)
+    assert rc == 0, f"allreduce failed at iter {it}"
+    assert out[1] == 3.0, out[1]  # (1+0) + (1+1)
+    assert lib.tpucomm_barrier(h) == 0
+lib.tpucomm_finalize(h)
+print("san-rank-ok", rank, flush=True)
+"""
+
+_REPORT_MARKERS = (
+    "WARNING: ThreadSanitizer",
+    "ERROR: AddressSanitizer",
+    "ERROR: LeakSanitizer",
+    "runtime error:",          # UBSan
+    "SUMMARY: ThreadSanitizer",
+    "SUMMARY: AddressSanitizer",
+    "SUMMARY: UndefinedBehaviorSanitizer",
+)
+
+
+def _preload_path(libname):
+    gcc = shutil.which("g++") or shutil.which("gcc")
+    if gcc is None:
+        pytest.skip("no C++ toolchain")
+    path = subprocess.run(
+        [gcc, f"-print-file-name={libname}"],
+        capture_output=True, text=True,
+    ).stdout.strip()
+    if not path or not os.path.isabs(path) or not os.path.exists(path):
+        pytest.skip(f"{libname} not installed")
+    return path
+
+
+def _run_pair(so_path, preload, san_env, port, extra_env):
+    env = {
+        **os.environ,
+        "SAN_SO": so_path,
+        "SAN_PORT": str(port),
+        "LD_PRELOAD": preload,
+        **san_env,
+        **extra_env,
+    }
+    procs = []
+    for rank in range(2):
+        env_r = {**env, "SAN_RANK": str(rank)}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _RANK_SRC],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env_r,
+        ))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            pytest.fail(f"sanitized rank hung: {out[-500:]} {err[-500:]}")
+        outs.append((p.returncode, out, err))
+    for rank, (rc, out, err) in enumerate(outs):
+        blob = out + err
+        for marker in _REPORT_MARKERS:
+            assert marker not in blob, (
+                f"sanitizer report from rank {rank}:\n{blob[-4000:]}"
+            )
+        assert rc == 0, (
+            f"rank {rank} exited {rc} (sanitizer exitcode=66 means a "
+            f"report fired):\n{(out + err)[-2000:]}"
+        )
+        assert f"san-rank-ok {rank}" in out, out
+
+
+def _build(target):
+    res = subprocess.run(
+        ["make", "-C", NATIVE, target], capture_output=True, text=True,
+    )
+    assert res.returncode == 0, f"make {target} failed:\n{res.stderr[-2000:]}"
+
+
+@pytest.mark.parametrize("shm", ["on", "off"])
+def test_tsan_loopback_pair(shm):
+    _build("tsan")
+    preload = _preload_path("libtsan.so")
+    so = os.path.join(SO_DIR, "libtpucomm_tsan.so")
+    extra = {"MPI4JAX_TPU_JOBID": f"tsan{shm}{os.getpid()}"}
+    if shm == "off":
+        extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    _run_pair(
+        so, preload,
+        {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"},
+        46200 + (os.getpid() + (7 if shm == "on" else 0)) % 900,
+        extra,
+    )
+
+
+@pytest.mark.parametrize("shm", ["on", "off"])
+def test_asan_loopback_pair(shm):
+    _build("asan")
+    preload = _preload_path("libasan.so")
+    so = os.path.join(SO_DIR, "libtpucomm_asan.so")
+    extra = {"MPI4JAX_TPU_JOBID": f"asan{shm}{os.getpid()}"}
+    if shm == "off":
+        extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    _run_pair(
+        so, preload,
+        {
+            "ASAN_OPTIONS": "exitcode=66 detect_leaks=0 halt_on_error=1",
+            "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1",
+        },
+        47200 + (os.getpid() + (7 if shm == "on" else 0)) % 900,
+        extra,
+    )
