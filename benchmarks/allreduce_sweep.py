@@ -56,14 +56,15 @@ def mesh_tier_sweep(max_bytes, pallas=False):
             out = fn(x)
         jax.block_until_ready(out)
         dt = (time.perf_counter() - t0) / reps
-        eff = 2 * (ndev - 1) / ndev * size / dt / 1e9 if ndev > 1 else size / dt / 1e9
-        rec = {
-            "op": "allreduce", "tier": "pallas" if pallas else "mesh",
-            "devices": ndev,
-            "bytes": size, "seconds": round(dt, 9),
-            "eff_GBps_per_chip": round(eff, 3),
-            "platform": jax.devices()[0].platform,
-        }
+        from mpi4jax_tpu import obs
+
+        # shared benchmark serializer (obs.bench_record): same field
+        # names as the world sweep, BENCH artifacts, and profile reports
+        rec = obs.bench_record(
+            op="allreduce", nbytes=size, seconds=dt, ranks=ndev,
+            tier="pallas" if pallas else "mesh", devices=ndev,
+            platform=jax.devices()[0].platform,
+        )
         print(json.dumps(rec), flush=True)
         results.append(rec)
         size *= 4
@@ -215,21 +216,21 @@ def world_tier_rank(max_bytes, sizes=None, algos=None):
                 # (forced algorithms are no-ops there), else the engine's
                 # pick / the forced algorithm
                 probed = comm.coll_algo("allreduce", size)
-                print(json.dumps({
-                    "op": "allreduce", "tier": "world", "ranks": n,
-                    "bytes": size, "algo": algo,
-                    "resolved_algo": probed if (probed == "shm" or algo == "auto")
-                                     else algo,
-                    "seconds": round(dt, 9),
-                    "raw_seconds": round(raw_dt, 9),
-                    "ops_per_jit": K,
-                    "eff_GBps_per_chip": round(
-                        2 * (n - 1) / n * size / dt / 1e9, 3
-                    ),
-                    "raw_eff_GBps_per_chip": round(
+                from mpi4jax_tpu import obs
+
+                # shared serializer (obs.bench_record) keeps this curve
+                # field-compatible with BENCH_*.json and profile reports
+                print(json.dumps(obs.bench_record(
+                    op="allreduce", nbytes=size, seconds=dt, ranks=n,
+                    tier="world", algo=algo,
+                    resolved_algo=probed if (probed == "shm" or algo == "auto")
+                                  else algo,
+                    raw_seconds=round(raw_dt, 9),
+                    ops_per_jit=K,
+                    raw_eff_GBps_per_chip=round(
                         2 * (n - 1) / n * size / raw_dt / 1e9, 3
                     ),
-                }), flush=True)
+                )), flush=True)
     tune.clear_overrides()
 
 

@@ -22,6 +22,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
+from mpi4jax_tpu import obs
 from mpi4jax_tpu.runtime import bridge, transport
 
 
@@ -51,8 +52,12 @@ def main():
                             peer, peer, 7)
 
         dt = timeit(round_trip, reps)
-        rows.append({"op": "sendrecv_round", "bytes": nbytes,
-                     "us": round(dt * 1e6, 2), "reps": reps})
+        # one serializer for every benchmark artifact (obs.bench_record):
+        # BENCH_*.json, sweep curves, and profile reports stay
+        # field-compatible on (op, bytes, seconds)
+        rows.append(obs.bench_record(op="sendrecv_round", nbytes=nbytes,
+                                     seconds=dt, tier="transport",
+                                     reps=reps))
 
     # allreduce: the doc table's three sizes
     for nbytes, reps in ((1024, 2000), (65536, 300), (16 << 20, 5)):
@@ -62,10 +67,9 @@ def main():
             bridge.allreduce(handle, buf, 0)  # 0 = SUM
 
         dt = timeit(reduce_once, reps)
-        rows.append({"op": "allreduce", "bytes": nbytes,
-                     "us": round(dt * 1e6, 2), "reps": reps,
-                     "GBps": round(2 * (size - 1) / size * nbytes / dt
-                                   / 1e9, 3)})
+        rows.append(obs.bench_record(op="allreduce", nbytes=nbytes,
+                                     seconds=dt, ranks=size,
+                                     tier="transport", reps=reps))
 
     bridge.barrier(handle)
     if rank == 0:

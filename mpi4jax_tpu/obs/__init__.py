@@ -1,0 +1,91 @@
+"""Observability: structured per-op event recording, stats, and traces.
+
+The reference's sole observability is per-call debug prints
+(``mpi_xla_bridge.pyx`` there, ``utils/tracing.py`` +
+``native/tpucomm.cc`` debug lines here).  This package replaces
+grep-able stderr with structured telemetry:
+
+- a **per-rank event recorder** — a fixed-size in-memory ring on the
+  native side (world-tier transport ops: op, peer/root, tag, bytes,
+  algorithm, wait/transfer split) plus an ops-layer span ring fed by
+  ``tracing.CallTrace`` — with exact drop accounting on overflow and
+  strictly zero cost when disabled;
+- :func:`stats` — per-op / per-peer / per-algorithm aggregates (count,
+  bytes, p50/p95/p99 latency, wait fraction, effective GB/s);
+- **Chrome-trace export** — ``mpi4jax_tpu.launch --trace out.json``
+  merges every rank's recording (clock-offset aligned) into one
+  Perfetto-loadable timeline; ``python -m mpi4jax_tpu.profile``
+  renders tables from the same dumps;
+- a **feedback path into the tuner** — ``python -m mpi4jax_tpu.tune
+  --from-trace`` derives the persistent algorithm cache from recorded
+  real-run timings instead of a synthetic sweep.
+
+Recording turns on via ``MPI4JAX_TPU_TRACE=<out-path>`` (the launcher's
+``--trace`` sets it) or programmatically via :func:`start`; ring size is
+``MPI4JAX_TPU_TRACE_BUF_KB`` (utils/config.py is the registry).  This
+package is stdlib-importable without jax, numpy, or the native library —
+the launcher's merge step and the profile CLI rely on that.
+"""
+
+from ._dump import (  # noqa: F401
+    load_events,
+    load_part,
+    part_path,
+    part_paths,
+    write_part,
+)
+from ._recorder import (  # noqa: F401
+    Recorder,
+    clock_offset_us,
+    default_capacity_events,
+    dropped,
+    enabled,
+    events,
+    record_span,
+    reset,
+    start,
+    stop,
+)
+from ._stats import (  # noqa: F401
+    STATS_SCHEMA,
+    bench_record,
+    percentile,
+    render_table,
+    summarize,
+)
+from ._trace import (  # noqa: F401
+    TRACE_SCHEMA,
+    merge_parts,
+    rank_trace_events,
+    validate_chrome_trace,
+)
+from . import _recorder
+
+
+def stats(event_list=None) -> dict:
+    """Aggregates over ``event_list`` (default: everything this rank has
+    recorded so far) — see ``_stats.summarize`` for the row schema."""
+    if event_list is None:
+        event_list = events()
+        return summarize(event_list, dropped=dropped(),
+                         rank=_recorder.rank())
+    return summarize(event_list)
+
+
+def dump(base_path: str) -> str:
+    """Write this rank's recording part file (``<base>.rank<r>.json``);
+    returns the path.  Called automatically at interpreter exit when
+    ``MPI4JAX_TPU_TRACE`` is set (see ``runtime/bridge.py``)."""
+    return write_part(
+        base_path,
+        rank=_recorder.rank(),
+        size=_recorder.size(),
+        events=events(),
+        dropped=dropped(),
+        clock_offset_us=clock_offset_us(),
+    )
+
+
+def merge_files(part_files) -> dict:
+    """Merged Chrome trace dict from part file paths."""
+    return merge_parts([load_part(p) for p in part_files])

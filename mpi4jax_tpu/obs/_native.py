@@ -1,0 +1,110 @@
+"""ctypes glue to the native transport's observability event ring.
+
+The wire contract lives in ``native/tpucomm.h``: ``TpuObsEvent`` (this
+module's :class:`TpuObsEvent` must stay field-for-field identical) and
+the ``tpucomm_obs_*`` entry points.  Everything here takes the loaded
+library object explicitly — this module never loads (or builds) the
+transport itself, so the pure-Python half of the subsystem stays usable
+without it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+#: index-matched names for TpuObsEvent.op (enum TpuObsOp in tpucomm.h)
+OBS_OP_NAMES = (
+    "Send", "Recv", "Sendrecv", "Shift2", "Barrier", "Bcast", "Gather",
+    "Scatter", "Allgather", "Alltoall", "Allreduce", "Reduce", "Scan",
+)
+
+#: TpuCollAlgo codes -> names (keep in sync with mpi4jax_tpu/tune)
+ALGO_NAMES = {0: "auto", 1: "ring", 2: "rd", 3: "tree", 4: "shm"}
+
+
+class TpuObsEvent(ctypes.Structure):
+    _fields_ = [
+        ("t_start", ctypes.c_double),
+        ("dur_s", ctypes.c_double),
+        ("wait_s", ctypes.c_double),
+        ("nbytes", ctypes.c_int64),
+        ("op", ctypes.c_int32),
+        ("peer", ctypes.c_int32),
+        ("tag", ctypes.c_int32),
+        ("algo", ctypes.c_int32),
+    ]
+
+
+#: bytes per ring slot, for sizing the ring from MPI4JAX_TPU_TRACE_BUF_KB
+EVENT_BYTES = ctypes.sizeof(TpuObsEvent)
+
+
+def available(lib) -> bool:
+    """True when the loaded .so carries the event ring (a stale prebuilt
+    library predating it keeps working, just unobserved)."""
+    if lib is None or not hasattr(lib, "tpucomm_obs_enable"):
+        return False
+    # idempotent signature setup (works for bridge-loaded and
+    # standalone-loaded libraries alike)
+    lib.tpucomm_obs_enable.argtypes = [ctypes.c_int, ctypes.c_int64]
+    lib.tpucomm_obs_enable.restype = None
+    lib.tpucomm_obs_counts.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.tpucomm_obs_counts.restype = None
+    lib.tpucomm_obs_drain.restype = ctypes.c_int64
+    lib.tpucomm_obs_clock.restype = ctypes.c_double
+    return True
+
+
+def enable(lib, capacity_events: int) -> None:
+    lib.tpucomm_obs_enable(1, ctypes.c_int64(int(capacity_events)))
+
+
+def disable(lib) -> None:
+    lib.tpucomm_obs_enable(0, ctypes.c_int64(0))
+
+
+def counts(lib):
+    """(events held, events dropped by overflow) right now."""
+    rec = ctypes.c_int64(0)
+    drop = ctypes.c_int64(0)
+    lib.tpucomm_obs_counts(ctypes.byref(rec), ctypes.byref(drop))
+    return rec.value, drop.value
+
+
+def clock(lib) -> float:
+    """The native recorder clock (monotonic seconds, process epoch)."""
+    fn = lib.tpucomm_obs_clock
+    fn.restype = ctypes.c_double
+    return float(fn())
+
+
+def drain(lib, max_events: int = 1 << 20):
+    """Pull and clear the held events, oldest first, as raw dicts with
+    the native clock's timestamps (seconds): op/peer/tag/bytes/algo/
+    t/dur_s/wait_s.  Events the buffer cannot take (appended between
+    the count probe and the drain, or beyond ``max_events``) are
+    counted as dropped by the native side, never silently lost."""
+    held, _ = counts(lib)
+    # headroom for events appended after the count probe (the native
+    # drain clamps to what is actually held)
+    n = min(held + 64, max_events)
+    if n <= 0 or held <= 0:
+        return []
+    buf = (TpuObsEvent * n)()
+    got = lib.tpucomm_obs_drain(buf, ctypes.c_int64(n))
+    out = []
+    for i in range(got):
+        e = buf[i]
+        op = OBS_OP_NAMES[e.op] if 0 <= e.op < len(OBS_OP_NAMES) else "?"
+        out.append({
+            "name": op,
+            "t": e.t_start,
+            "dur_s": e.dur_s,
+            "wait_s": e.wait_s,
+            "bytes": e.nbytes,
+            "peer": e.peer,
+            "tag": e.tag,
+            "algo": ALGO_NAMES.get(e.algo),
+        })
+    return out

@@ -1,0 +1,155 @@
+"""Aggregation over recorded events, and the shared benchmark serializer.
+
+Pure stdlib: the launcher's merge step and the profile CLI run this
+without jax, numpy, or the native library.
+
+Canonical event shape (every producer — the native ring, the ops-layer
+``CallTrace`` hook, and part-file loads — normalizes to this):
+
+    {"name": "Allreduce", "src": "native" | "ops", "ts_us": float,
+     "dur_us": float, "wait_us": float, "bytes": int, "peer": int,
+     "tag": int, "algo": "ring" | ... | None}
+
+``ts_us`` is on the job-global aligned timeline (unix microseconds plus
+the rank's estimated clock offset — see ``_trace.py``).
+"""
+
+from __future__ import annotations
+
+import math
+
+STATS_SCHEMA = "mpi4jax_tpu.obs.stats/1"
+
+
+def _sig(x: float, figures: int = 4) -> float:
+    """Round to significant figures: throughputs span nine orders of
+    magnitude across benchmark points, so fixed decimals would collapse
+    the small end to 0."""
+    return float(f"{float(x):.{figures}g}")
+
+
+def percentile(values, q: float) -> float:
+    """``numpy.percentile(values, q)`` (the default linear-interpolation
+    method), reimplemented so the stdlib-only paths agree bit-for-bit
+    with numpy on the same corpus (test-enforced)."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return 0.0
+    if len(vals) == 1:
+        return vals[0]
+    k = (len(vals) - 1) * (float(q) / 100.0)
+    f = math.floor(k)
+    c = math.ceil(k)
+    if f == c:
+        return vals[int(k)]
+    return vals[f] * (c - k) + vals[c] * (k - f)
+
+
+def summarize(events, dropped=None, rank=None) -> dict:
+    """Per-(op, source, peer, algorithm) aggregates over canonical
+    events.
+
+    Returns ``{"schema", "rank", "total_events", "dropped", "per_op"}``
+    where ``per_op`` rows carry count, total bytes, p50/p95/p99 latency
+    (microseconds), the wait fraction (share of wall time blocked on
+    peers rather than moving bytes), and effective GB/s
+    (``sum(bytes) / sum(seconds)`` — payload over wall time, no
+    algorithm factor).
+    """
+    groups = {}
+    for ev in events:
+        # src is part of the key: the native ring and the ops-layer
+        # span record the SAME call from two vantage points — collapsing
+        # them would double-count every send/recv and dilute wait_frac
+        key = (ev.get("name", "?"), ev.get("src", "?"),
+               int(ev.get("peer", -1)), ev.get("algo") or "-")
+        groups.setdefault(key, []).append(ev)
+    rows = []
+    for (op, src, peer, algo), evs in sorted(groups.items()):
+        durs = [float(e.get("dur_us", 0.0)) for e in evs]
+        waits = [float(e.get("wait_us", 0.0)) for e in evs]
+        nbytes = sum(int(e.get("bytes", 0)) for e in evs)
+        seconds = sum(durs) / 1e6
+        rows.append({
+            "op": op,
+            "src": src,
+            "peer": peer,
+            "algo": algo,
+            "count": len(evs),
+            "bytes": nbytes,
+            "seconds": round(seconds, 9),
+            "p50_us": round(percentile(durs, 50), 3),
+            "p95_us": round(percentile(durs, 95), 3),
+            "p99_us": round(percentile(durs, 99), 3),
+            "wait_frac": round(sum(waits) / max(sum(durs), 1e-12), 4),
+            "eff_GBps": _sig(nbytes / max(seconds, 1e-12) / 1e9),
+        })
+    out = {
+        "schema": STATS_SCHEMA,
+        "total_events": len(events),
+        "dropped": dict(dropped or {}),
+        "per_op": rows,
+    }
+    if rank is not None:
+        out["rank"] = int(rank)
+    return out
+
+
+def render_table(stats: dict, *, by=("op", "algo")) -> str:
+    """Human-readable per-op table (the profile CLI's ``report``)."""
+    cols = ("op", "src", "peer", "algo", "count", "bytes", "p50_us",
+            "p95_us", "p99_us", "wait_frac", "eff_GBps")
+    rows = stats.get("per_op", [])
+    if not rows:
+        return "(no events recorded)"
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    lines = ["  ".join(c.ljust(widths[c]) for c in cols)]
+    lines.append("  ".join("-" * widths[c] for c in cols))
+    for r in rows:
+        lines.append("  ".join(str(r.get(c, "")).ljust(widths[c])
+                               for c in cols))
+    dropped = stats.get("dropped") or {}
+    total_drop = sum(int(v) for v in dropped.values())
+    lines.append(
+        f"{stats.get('total_events', len(rows))} events"
+        + (f", {total_drop} dropped on ring overflow" if total_drop else "")
+    )
+    return "\n".join(lines)
+
+
+def bench_record(*, op, nbytes, seconds, ranks=None, tier=None, algo=None,
+                 reps=None, **extra) -> dict:
+    """The one benchmark-output serializer: ``benchmarks/*.py``,
+    ``obs.stats`` rows, and the profile report all speak these field
+    names, so BENCH_*.json artifacts, sweep curves, and recorded-run
+    reports stay join-able on (op, bytes, seconds).
+
+    ``eff_GBps_per_chip`` uses the ring-effective convention the BENCH
+    artifacts established (``2*(n-1)/n * bytes / seconds`` per rank)
+    when ``ranks`` is given, falling back to plain payload-over-time.
+    """
+    seconds = float(seconds)
+    rec = {
+        "op": str(op),
+        "bytes": int(nbytes),
+        "seconds": round(seconds, 9),
+        "us": round(seconds * 1e6, 3),
+    }
+    if ranks is not None:
+        n = max(int(ranks), 1)
+        factor = 2 * (n - 1) / n if n > 1 else 1.0
+        rec["ranks"] = n
+        rec["eff_GBps_per_chip"] = _sig(
+            factor * int(nbytes) / max(seconds, 1e-12) / 1e9)
+    else:
+        rec["eff_GBps_per_chip"] = _sig(
+            int(nbytes) / max(seconds, 1e-12) / 1e9)
+    if tier is not None:
+        rec["tier"] = str(tier)
+    if algo is not None:
+        rec["algo"] = str(algo)
+    if reps is not None:
+        rec["reps"] = int(reps)
+    rec.update(extra)
+    return rec

@@ -1,0 +1,128 @@
+"""Chrome-trace-event export and the cross-rank merge.
+
+Pure stdlib.  Output is the Trace Event Format's JSON-object form
+(``{"traceEvents": [...]}``) with complete ("X") events, which Perfetto
+and chrome://tracing both load:
+
+- ``pid`` = rank (with a ``process_name`` metadata event per rank),
+- ``tid`` 0 = the native transport, ``tid`` 1 = the ops layer,
+- every op span carries ``args`` with bytes / peer / tag / algorithm /
+  the exact ``wait_us``,
+- each native span additionally gets two nested child slices, ``wait``
+  and ``wire``, rendering the blocked/transfer split visually (the wait
+  share is drawn as the span's prefix — an approximation of its true
+  distribution inside the op; ``args.wait_us`` is exact).
+
+Timestamps are microseconds on the job-global aligned timeline: each
+rank's dump already applied its clock offset (estimated over the
+freshly-bootstrapped transport mesh — see ``runtime/bridge.py``), so
+the merge is a concatenation plus metadata, and cross-rank ordering of
+matched sends/recvs survives the ranks' unsynchronized monotonic
+clocks.
+"""
+
+from __future__ import annotations
+
+TRACE_SCHEMA = "mpi4jax_tpu.obs.trace/1"
+
+_TID_NAMES = {0: "transport (native)", 1: "ops layer (python)"}
+
+
+def rank_trace_events(events, rank: int):
+    """Chrome 'X' events (plus thread metadata) for one rank's canonical
+    event list."""
+    out = []
+    for tid, name in _TID_NAMES.items():
+        out.append({"name": "thread_name", "ph": "M", "pid": int(rank),
+                    "tid": tid, "args": {"name": name}})
+    out.append({"name": "process_name", "ph": "M", "pid": int(rank),
+                "tid": 0, "args": {"name": f"rank {rank}"}})
+    for ev in events:
+        tid = 0 if ev.get("src") == "native" else 1
+        ts = float(ev["ts_us"])
+        dur = max(float(ev.get("dur_us", 0.0)), 0.001)
+        args = {
+            "bytes": int(ev.get("bytes", 0)),
+            "peer": int(ev.get("peer", -1)),
+            "tag": int(ev.get("tag", 0)),
+            "wait_us": round(float(ev.get("wait_us", 0.0)), 3),
+        }
+        if ev.get("algo"):
+            args["algo"] = ev["algo"]
+        out.append({"name": ev.get("name", "?"), "cat": ev.get("src", "?"),
+                    "ph": "X", "pid": int(rank), "tid": tid,
+                    "ts": round(ts, 3), "dur": round(dur, 3), "args": args})
+        wait = float(ev.get("wait_us", 0.0))
+        if tid == 0 and wait > 0.0:
+            # nested child slices: wait prefix, then the wire phase
+            wait = min(wait, dur)
+            out.append({"name": "wait", "cat": "phase", "ph": "X",
+                        "pid": int(rank), "tid": tid, "ts": round(ts, 3),
+                        "dur": round(wait, 3), "args": {}})
+            if dur - wait > 0.0:
+                out.append({"name": "wire", "cat": "phase", "ph": "X",
+                            "pid": int(rank), "tid": tid,
+                            "ts": round(ts + wait, 3),
+                            "dur": round(dur - wait, 3), "args": {}})
+    return out
+
+
+def merge_parts(parts) -> dict:
+    """One Perfetto-loadable trace from per-rank part dicts (the files
+    ranks dump at finalize — see ``_dump.py``).  Parts may arrive in any
+    order; events are globally time-sorted."""
+    trace_events = []
+    world_size = 0
+    dropped = {}
+    for part in parts:
+        rank = int(part.get("rank", 0))
+        world_size = max(world_size, int(part.get("size", rank + 1)))
+        for src, n in (part.get("dropped") or {}).items():
+            dropped[f"rank{rank}.{src}"] = int(n)
+        trace_events.extend(rank_trace_events(part.get("events", ()), rank))
+    meta = [e for e in trace_events if e.get("ph") == "M"]
+    spans = sorted((e for e in trace_events if e.get("ph") != "M"),
+                   key=lambda e: (e.get("ts", 0.0), e.get("pid", 0)))
+    return {
+        "traceEvents": meta + spans,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "schema": TRACE_SCHEMA,
+            "tool": "mpi4jax_tpu.obs",
+            "world_size": world_size,
+            "dropped": dropped,
+        },
+    }
+
+
+def validate_chrome_trace(trace) -> list:
+    """Errors (empty = valid) against the Chrome trace-event JSON-object
+    schema subset this exporter emits; used by the diag ``observability``
+    check and the tests."""
+    errors = []
+    if not isinstance(trace, dict):
+        return ["top level must be a JSON object"]
+    events = trace.get("traceEvents")
+    if not isinstance(events, list):
+        return ["missing traceEvents list"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errors.append(f"{where}: not an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errors.append(f"{where}: missing name")
+        ph = ev.get("ph")
+        if ph not in ("X", "B", "E", "M", "i", "C"):
+            errors.append(f"{where}: bad ph {ph!r}")
+            continue
+        if not isinstance(ev.get("pid"), int):
+            errors.append(f"{where}: pid must be an int")
+        if ph == "X":
+            for field in ("ts", "dur"):
+                v = ev.get(field)
+                if not isinstance(v, (int, float)):
+                    errors.append(f"{where}: {field} must be a number")
+            if isinstance(ev.get("dur"), (int, float)) and ev["dur"] < 0:
+                errors.append(f"{where}: negative dur")
+    return errors

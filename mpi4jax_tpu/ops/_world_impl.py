@@ -861,6 +861,7 @@ def _host_allreduce(x, *, comm, op):
         comm.rank(), "Allreduce",
         lambda: f"op {op.name} algo "
                 f"{_coll_algo_detail(comm, 'allreduce', x.nbytes)}",
+        nbytes=x.nbytes,
     ):
         return bridge.allreduce(comm.handle, x, _OP_CODE[op.name])
 
@@ -868,21 +869,24 @@ def _host_allreduce(x, *, comm, op):
 def _host_reduce(x, *, comm, op, root):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Reduce", f"op {op.name} root {root}"):
+    with tracing.CallTrace(comm.rank(), "Reduce", f"op {op.name} root {root}",
+                           peer=root, nbytes=x.nbytes):
         return bridge.reduce(comm.handle, x, _OP_CODE[op.name], root)
 
 
 def _host_scan(x, *, comm, op):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Scan", f"op {op.name}"):
+    with tracing.CallTrace(comm.rank(), "Scan", f"op {op.name}",
+                           nbytes=x.nbytes):
         return bridge.scan(comm.handle, x, _OP_CODE[op.name])
 
 
 def _host_bcast(x, *, comm, root):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Bcast", f"root {root}"):
+    with tracing.CallTrace(comm.rank(), "Bcast", f"root {root}",
+                           peer=root, nbytes=x.nbytes):
         return bridge.bcast(comm.handle, x, root)
 
 
@@ -892,6 +896,7 @@ def _host_allgather(x, *, comm):
     with tracing.CallTrace(
         comm.rank(), "Allgather",
         lambda: f"algo {_coll_algo_detail(comm, 'allgather', x.nbytes)}",
+        nbytes=x.nbytes,
     ):
         return bridge.allgather(comm.handle, x, comm.size())
 
@@ -899,7 +904,8 @@ def _host_allgather(x, *, comm):
 def _host_gather(x, *, comm, root):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Gather", f"root {root}"):
+    with tracing.CallTrace(comm.rank(), "Gather", f"root {root}",
+                           peer=root, nbytes=x.nbytes):
         # root gets (size, *x.shape); non-root sends and gets x back
         # (exact reference contract, gather.py:86-96,213-226 there)
         return bridge.gather(comm.handle, x, comm.size(), root, comm.rank())
@@ -908,21 +914,23 @@ def _host_gather(x, *, comm, root):
 def _host_scatter(x, *, comm, root):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Scatter", f"root {root}"):
+    with tracing.CallTrace(comm.rank(), "Scatter", f"root {root}",
+                           peer=root, nbytes=x.nbytes):
         return bridge.scatter(comm.handle, x, root)
 
 
 def _host_alltoall(x, *, comm):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Alltoall", ""):
+    with tracing.CallTrace(comm.rank(), "Alltoall", "", nbytes=x.nbytes):
         return bridge.alltoall(comm.handle, x)
 
 
 def _host_shift2(x, *, comm, lo, hi, tag):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Shift2", f"lo {lo} hi {hi}"):
+    with tracing.CallTrace(comm.rank(), "Shift2", f"lo {lo} hi {hi}",
+                           peer=hi, nbytes=x.nbytes, tag=tag):
         return bridge.shift2(comm.handle, x, lo, hi, tag)
 
 
@@ -937,7 +945,8 @@ def _host_barrier(*, comm):
 def _host_send(x, *, comm, dest, tag):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Send", f"to {dest} tag {tag}"):
+    with tracing.CallTrace(comm.rank(), "Send", f"to {dest} tag {tag}",
+                           peer=dest, nbytes=x.nbytes, tag=tag):
         bridge.send(comm.handle, x, dest, tag)
     return np.zeros((), np.int32)
 
@@ -945,7 +954,8 @@ def _host_send(x, *, comm, dest, tag):
 def _host_recv(x, *, comm, source, tag, status=None):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Recv", f"from {source} tag {tag}"):
+    with tracing.CallTrace(comm.rank(), "Recv", f"from {source} tag {tag}",
+                           peer=source, nbytes=x.nbytes, tag=tag):
         if status is None:
             # strict path: arrived size must equal the buffer exactly
             return bridge.recv(comm.handle, x.shape, x.dtype, source, tag)
@@ -960,7 +970,8 @@ def _host_sendrecv(x, *, comm, source, dest, sendtag, recvtag, status=None):
     from ..runtime import bridge
 
     with tracing.CallTrace(
-        comm.rank(), "Sendrecv", f"to {dest} from {source}"
+        comm.rank(), "Sendrecv", f"to {dest} from {source}",
+        peer=dest, nbytes=2 * x.nbytes, tag=sendtag,
     ):
         if status is None and sendtag == recvtag:
             return bridge.sendrecv(

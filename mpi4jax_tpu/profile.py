@@ -1,0 +1,123 @@
+"""Recording inspection CLI for the observability subsystem.
+
+    python -m mpi4jax_tpu.profile report  out.json.rank0.json [...]
+    python -m mpi4jax_tpu.profile report  out.json            # merged trace
+    python -m mpi4jax_tpu.profile merge   --out out.json out.json.rank*.json
+
+``report`` renders the per-op / per-peer / per-algorithm table (count,
+bytes, p50/p95/p99 latency, wait fraction, effective GB/s) from one or
+more recordings — per-rank part files dumped at finalize
+(``MPI4JAX_TPU_TRACE``) or a merged Chrome trace; ``--json`` emits the
+``obs.stats`` object instead.  ``merge`` combines part files into one
+Perfetto-loadable Chrome trace (what ``mpi4jax_tpu.launch --trace``
+does automatically).
+
+The logic is stdlib-only — no jax usage, no native build.  The ``-m``
+form shown above still imports the package (whose ``__init__`` gates on
+the jax version); where that gate blocks (no jax, jax < 0.6), run this
+file directly instead — it loads the obs package by path:
+
+    python path/to/mpi4jax_tpu/profile.py report out.json.rank*.json
+
+See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+try:
+    from . import obs
+except ImportError:  # pragma: no cover - standalone tooling load
+    import importlib.util
+    import os as _os
+
+    _spec = importlib.util.spec_from_file_location(
+        "m4j_obs_standalone",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "obs", "__init__.py"),
+        submodule_search_locations=[
+            _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                          "obs")],
+    )
+    obs = importlib.util.module_from_spec(_spec)
+    sys.modules["m4j_obs_standalone"] = obs
+    _spec.loader.exec_module(obs)
+
+
+def _load_all(paths):
+    """(events, dropped, ranks) across recording files of either kind."""
+    events = []
+    dropped = {}
+    ranks = set()
+    for path in paths:
+        try:
+            part = obs.load_part(path)
+        except (ValueError, json.JSONDecodeError):
+            evs, _ = obs.load_events(path)  # merged chrome trace
+            events.extend(evs)
+            continue
+        rank = int(part.get("rank", 0))
+        ranks.add(rank)
+        for src, n in (part.get("dropped") or {}).items():
+            dropped[f"rank{rank}.{src}"] = int(n)
+        events.extend(part["events"])
+    return events, dropped, sorted(ranks)
+
+
+def cmd_report(args) -> int:
+    events, dropped, ranks = _load_all(args.recordings)
+    stats = obs.summarize(events, dropped=dropped)
+    if args.json:
+        print(json.dumps(stats, indent=1, sort_keys=True))
+        return 0
+    if ranks:
+        print(f"# {len(events)} events from rank(s) "
+              f"{','.join(map(str, ranks))}")
+    print(obs.render_table(stats))
+    return 0
+
+
+def cmd_merge(args) -> int:
+    merged = obs.merge_files(args.recordings)
+    errors = obs.validate_chrome_trace(merged)
+    if errors:
+        print(f"profile: merged trace failed validation: {errors[:3]}",
+              file=sys.stderr, flush=True)
+        return 2
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
+    print(f"profile: merged {len(args.recordings)} recording(s), "
+          f"{spans} spans -> {args.out} (load in https://ui.perfetto.dev)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m mpi4jax_tpu.profile")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    rep = sub.add_parser("report", help="per-op/per-algo table from "
+                                        "recordings")
+    rep.add_argument("recordings", nargs="+",
+                     help="part files (out.json.rank*.json) and/or merged "
+                          "traces")
+    rep.add_argument("--json", action="store_true",
+                     help="emit the obs.stats object instead of the table")
+    rep.set_defaults(fn=cmd_report)
+    mrg = sub.add_parser("merge", help="merge part files into one "
+                                       "Perfetto trace")
+    mrg.add_argument("recordings", nargs="+", help="part files")
+    mrg.add_argument("--out", required=True, help="merged trace path")
+    mrg.set_defaults(fn=cmd_merge)
+    args = ap.parse_args(argv)
+    try:
+        return args.fn(args)
+    except (OSError, ValueError) as e:
+        print(f"profile: {e}", file=sys.stderr, flush=True)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

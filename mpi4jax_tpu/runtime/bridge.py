@@ -345,7 +345,82 @@ def comm_init(rank: int, size: int, coord: str, hosts=None) -> int:
         import warnings
 
         warnings.warn(f"collective algorithm table install failed: {e}")
+    # observability: arm the recorder when MPI4JAX_TPU_TRACE asks for a
+    # dump, or re-arm (now with the native ring + clock alignment) when
+    # the program called obs.start() before any comm existed.  Arming
+    # MUST AGREE ACROSS RANKS (the same contract as DISABLE_SHM /
+    # COLL_ALGO): the alignment handshake below is collective, so a
+    # divergent condition — TRACE exported on one host of a multi-host
+    # job, obs.start() on a subset of ranks — pairs one rank's
+    # handshake against another rank's first user op and aborts on the
+    # transport's schedule checks.  The launcher sets TRACE uniformly.
+    from .. import obs
+
+    if config.trace_path() is not None or obs.enabled():
+        _install_obs(lib, handle, rank, size)
     return handle
+
+
+_obs_dump_registered = False
+
+
+def _install_obs(lib, handle, rank: int, size: int) -> None:
+    """Run the clock-alignment handshake, arm the recorder, and
+    schedule the per-rank dump at interpreter exit.
+
+    The handshake is COLLECTIVE, which is why arming must agree across
+    ranks (see the call site): every armed rank runs it here at the
+    same program position — a barrier, then each rank samples its unix
+    clock inside the same barrier-exit window and allgathers the
+    samples; the median minus the local sample is this rank's offset
+    onto the job-global timeline.  It runs BEFORE recording starts, so
+    its own collectives never pollute the recording.
+
+    Re-arming resets the recorder: spans recorded before the comm
+    existed are dropped in favor of a recording whose every event is on
+    the aligned timeline.
+    """
+    global _obs_dump_registered
+    from .. import obs
+
+    offset_s = 0.0
+    if size > 1:
+        import time
+
+        barrier(handle)
+        t_local = time.time()
+        all_t = np.sort(allgather(handle, np.array([t_local], np.float64),
+                                  size).ravel())
+        offset_s = float(all_t[size // 2]) - t_local
+    obs.start(lib=lib, rank=rank, size=size, clock_offset_s=offset_s)
+    if not _obs_dump_registered:
+        _obs_dump_registered = True
+        import atexit
+
+        atexit.register(_dump_obs_at_exit)
+
+
+def _dump_obs_at_exit() -> None:
+    base = config.trace_path()
+    if base is None:
+        return
+    try:
+        # drain pending async dispatch first: a span recorded for an op
+        # whose effects have not executed yet would be a lie
+        import jax
+
+        jax.effects_barrier()
+    except Exception:
+        pass
+    try:
+        from .. import obs
+
+        path = obs.dump(base)
+        print(f"[obs] recording written to {path}", file=sys.stderr,
+              flush=True)
+    except Exception as e:  # pragma: no cover - defensive teardown path
+        print(f"[obs] recording dump failed: {e}", file=sys.stderr,
+              flush=True)
 
 
 def _contig(a) -> np.ndarray:

@@ -84,6 +84,55 @@ def check_coll_algo_engine():
     return ok, detail
 
 
+def check_observability(port):
+    """The structured recorder end to end, no sockets: a size-1 native
+    comm records loopback ops into the event ring, the recording shows
+    them in ``obs.stats()``, and the exported trace validates against
+    the Chrome trace-event schema."""
+    import ctypes
+
+    import numpy as np
+
+    from .. import obs
+    from ..obs import _native
+    from . import bridge
+
+    lib = bridge.get_lib()
+    if not _native.available(lib):
+        return False, ("native library predates the event ring "
+                       "(no tpucomm_obs_enable); rebuild native/")
+    h = lib.tpucomm_init(0, 1, int(port), b"")
+    if h == 0:
+        return False, "size-1 comm init failed"
+    try:
+        obs.start(lib=lib, rank=0, size=1)
+        x = np.arange(16.0)
+        bridge.send(h, x, 0, 7)           # self-delivery loopback
+        got = bridge.recv(h, x.shape, x.dtype, 0, 7)
+        if not np.allclose(got, x):
+            return False, "loopback payload mismatch"
+        bridge.allreduce(h, x, 0)
+        stats = obs.stats()
+        ops = {row["op"] for row in stats["per_op"]}
+        if not {"Send", "Recv", "Allreduce"} <= ops:
+            return False, f"recorded ops {sorted(ops)} missing Send/Recv/" \
+                          "Allreduce"
+        count = sum(row["count"] for row in stats["per_op"])
+        trace = obs.merge_parts([{
+            "rank": 0, "size": 1, "events": obs.events(),
+            "dropped": obs.dropped(),
+        }])
+        errors = obs.validate_chrome_trace(trace)
+        if errors:
+            return False, f"trace schema errors: {errors[:3]}"
+        return True, (f"{count} loopback events recorded, stats ops "
+                      f"{sorted(ops)}, trace validates "
+                      f"({obs.default_capacity_events()}-event ring)")
+    finally:
+        obs.stop()
+        lib.tpucomm_finalize(ctypes.c_int64(h))
+
+
 def check_transport_loopback(port):
     """2-rank world job over the real launcher + TCP transport."""
     import tempfile
@@ -356,6 +405,7 @@ def main(argv=None):
         ("native_build", check_native_build),
         ("ffi_fast_path", check_ffi),
         ("coll_algo_engine", check_coll_algo_engine),
+        ("observability", lambda: check_observability(args.port + 13)),
         ("static_verify", check_static_verify),
         ("transport_loopback", lambda: check_transport_loopback(args.port)),
         ("failure_detection",

@@ -150,6 +150,48 @@ def _preflight_verify(prog: str, np_: int, prog_args=()) -> int:
     return res.returncode or 2
 
 
+def _merge_trace(out_path: str, np_: int) -> None:
+    """Merge the per-rank recordings into one Perfetto-loadable Chrome
+    trace at ``out_path``.  Best effort — a failed job may have dumped
+    only some parts, and a partial timeline still beats none (the merge
+    reports how many ranks it found)."""
+    import json
+
+    try:
+        from .. import obs  # stdlib-only import (no jax)
+    except ImportError:  # executed as a plain file (no package context)
+        import importlib.util
+
+        _obs_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "obs")
+        _spec = importlib.util.spec_from_file_location(
+            "m4j_obs_launch", os.path.join(_obs_dir, "__init__.py"),
+            submodule_search_locations=[_obs_dir])
+        obs = importlib.util.module_from_spec(_spec)
+        sys.modules["m4j_obs_launch"] = obs
+        _spec.loader.exec_module(obs)
+
+    parts = obs.part_paths(out_path)
+    if not parts:
+        print(f"launch: --trace: no recordings found at "
+              f"{out_path}.rank*.json (did the ranks reach comm init?)",
+              file=sys.stderr, flush=True)
+        return
+    try:
+        merged = obs.merge_files(parts)
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+        spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
+        print(f"launch: --trace: merged {len(parts)}/{np_} rank "
+              f"recording(s), {spans} spans -> {out_path} "
+              "(load in https://ui.perfetto.dev)",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        print(f"launch: --trace: merge failed: {e}", file=sys.stderr,
+              flush=True)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m mpi4jax_tpu.runtime.launch",
@@ -183,6 +225,13 @@ def main(argv=None):
                              "mpi4jax_tpu.analyze) and exit 3 with the "
                              "findings table when it fails — BEFORE any "
                              "rank is spawned")
+    parser.add_argument("--trace", default=None, metavar="OUT.json",
+                        help="record every rank's per-op events "
+                             "(MPI4JAX_TPU_TRACE) and merge them into one "
+                             "Perfetto-loadable Chrome trace at OUT.json "
+                             "after the job ends; per-rank recordings stay "
+                             "next to it as OUT.json.rank<r>.json "
+                             "(docs/observability.md)")
     parser.add_argument("prog", help="python program to run")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -198,6 +247,19 @@ def main(argv=None):
             parser.error(
                 f"--hosts lists {nhosts} entries for {args.np} ranks"
             )
+
+    if args.trace:
+        # stale parts from a previous run at the same path (possibly a
+        # different world size) must not leak into this run's merge or
+        # into tune --from-trace's glob
+        import glob as _glob
+
+        trace_abs = os.path.abspath(args.trace)
+        for stale in _glob.glob(f"{_glob.escape(trace_abs)}.rank*.json"):
+            try:
+                os.unlink(stale)
+            except OSError:
+                pass
 
     base_port = args.port or (40000 + os.getpid() % 20000)
     # job-unique token for /dev/shm arena names: a crashed earlier job
@@ -246,6 +308,8 @@ def main(argv=None):
             env["MPI4JAX_TPU_SIZE"] = str(args.np)
             env["MPI4JAX_TPU_COORD"] = f"127.0.0.1:{base_port}"
             env["MPI4JAX_TPU_JOBID"] = jobid
+            if args.trace:
+                env["MPI4JAX_TPU_TRACE"] = os.path.abspath(args.trace)
             if args.hosts:
                 env["MPI4JAX_TPU_HOSTS"] = args.hosts
             if args.platform:
@@ -336,6 +400,9 @@ def main(argv=None):
         signal.signal(signal.SIGTERM, old_term)
         for pump in pumps:
             pump.join(timeout=2.0)
+
+    if args.trace:
+        _merge_trace(os.path.abspath(args.trace), args.np)
 
     if first_fail is not None:
         rank, rc = first_fail

@@ -295,6 +295,101 @@ def entries_from_measurements(best: Dict[int, str]) -> List[Entry]:
     return entries
 
 
+def measurements_from_events(events) -> Dict[str, Dict[int, Dict[str, float]]]:
+    """Median observed seconds per (op, payload bytes, algorithm) from a
+    recorded run's canonical events (``mpi4jax_tpu.obs`` dumps).
+
+    Only native TCP-path collective events count: the same-host shm
+    arena and the ops-layer spans measure a different thing than the
+    algorithm engine selects for, and events without an algorithm or
+    byte count carry no tuning signal.
+    """
+    samples: Dict[str, Dict[int, Dict[str, List[float]]]] = {}
+    for ev in events:
+        op = str(ev.get("name", "")).lower()
+        algo = ev.get("algo")
+        if (op not in OPS or ev.get("src") != "native"
+                or algo not in ("ring", "rd", "tree")):
+            continue
+        nbytes = int(ev.get("bytes", 0))
+        dur_s = float(ev.get("dur_us", 0.0)) / 1e6
+        if nbytes <= 0 or dur_s <= 0:
+            continue
+        samples.setdefault(op, {}).setdefault(nbytes, {}) \
+            .setdefault(algo, []).append(dur_s)
+    out: Dict[str, Dict[int, Dict[str, float]]] = {}
+    for op, by_size in samples.items():
+        for nbytes, by_algo in by_size.items():
+            for algo, durs in by_algo.items():
+                durs.sort()
+                # interpolated median, identical to numpy / the p50 the
+                # profile report prints for the same recording — the
+                # tuner's "best median" and the operator's table must
+                # name the same winner
+                n = len(durs)
+                med = (durs[(n - 1) // 2] + durs[n // 2]) / 2.0
+                out.setdefault(op, {}).setdefault(nbytes, {})[algo] = med
+    return out
+
+
+def cache_from_trace(paths: Sequence[str], world_size: Optional[int] = None,
+                     cache_path_override: Optional[str] = None) -> str:
+    """Derive the persistent algorithm cache from a recorded real run
+    (the ``python -m mpi4jax_tpu.tune --from-trace`` backend): the
+    winner per (op, size) is the algorithm with the best median observed
+    time, collapsed into bucket entries exactly like the synthetic
+    sweep.  ``paths`` are recording part files and/or merged Chrome
+    traces; ``world_size`` defaults to the recordings' own metadata.
+    Raises ``ValueError`` when the recording carries no usable TCP-path
+    collective timings (e.g. the run rode the shm arena throughout).
+    """
+    try:
+        from ..obs import _dump as obs_dump
+    except ImportError:  # pragma: no cover - standalone tooling load
+        import importlib.util
+
+        _spec = importlib.util.spec_from_file_location(
+            "m4j_obs_dump_standalone",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, "obs", "_dump.py"),
+        )
+        obs_dump = importlib.util.module_from_spec(_spec)
+        _spec.loader.exec_module(obs_dump)
+
+    events: List[dict] = []
+    seen_size = 0
+    for path in paths:
+        evs, size = obs_dump.load_events(path)
+        events.extend(evs)
+        seen_size = max(seen_size, size)
+    n = int(world_size or seen_size)
+    if n < 2:
+        raise ValueError(
+            "cannot tell the recording's world size — pass world_size "
+            "(tune --from-trace --np N)")
+    samples = measurements_from_events(events)
+    best: Dict[str, Dict[int, str]] = {}
+    measurements = []
+    for op, by_size in samples.items():
+        for nbytes, by_algo in sorted(by_size.items()):
+            winner = min(by_algo, key=by_algo.get)
+            best.setdefault(op, {})[nbytes] = winner
+            for algo, dt in sorted(by_algo.items()):
+                measurements.append({
+                    "op": op, "bytes": nbytes, "algo": algo,
+                    "seconds": round(dt, 9), "ranks": n,
+                    "source": "trace",
+                })
+    if not best:
+        raise ValueError(
+            "the recording holds no TCP-path collective timings with "
+            "algorithm labels (shm-arena runs measure the same-host "
+            "fast path, which the engine does not select for)")
+    table = {op: entries_from_measurements(b) for op, b in best.items()}
+    return save_cache(n, table, measurements, path=cache_path_override,
+                      transport="tcp:from-trace")
+
+
 def install(world_size: Optional[int] = None) -> bool:
     """Load the persistent cache (if present) and push the merged
     decision table into the native layer.  Called by

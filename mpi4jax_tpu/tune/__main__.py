@@ -9,7 +9,13 @@ each (op, payload size) on a live job and writes the winners to the
 persistent cache (``tune.cache_path(world_size)``), which is loaded at
 communicator creation on every subsequent run — see ``tune.install``.
 
-Two modes:
+``--from-trace out.json.rank0.json`` (or a glob / the merged trace)
+skips the synthetic sweep entirely and derives the cache from a REAL
+run's recorded per-op timings (``mpi4jax_tpu.launch --trace`` +
+``mpi4jax_tpu/obs`` — docs/observability.md): the winner per (op,
+payload size) is the algorithm with the best median observed time.
+
+Three modes:
 
 - **driver** (the normal invocation, outside a world job): re-executes
   itself under the bundled launcher at ``--np`` ranks with the shm arena
@@ -36,7 +42,22 @@ if __package__ in (None, ""):  # executed as a file by the launcher
             os.path.abspath(__file__))))
     )
 
-from mpi4jax_tpu import tune
+try:
+    from mpi4jax_tpu import tune
+except ImportError:
+    # the package __init__ gates on the jax version; the engine itself
+    # is stdlib-only, so the no-live-job mode (--from-trace) still works
+    # when this file is run directly: python mpi4jax_tpu/tune/__main__.py
+    import importlib.util
+
+    _spec = importlib.util.spec_from_file_location(
+        "m4j_tune_standalone",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "__init__.py"),
+    )
+    tune = importlib.util.module_from_spec(_spec)
+    sys.modules["m4j_tune_standalone"] = tune
+    _spec.loader.exec_module(tune)
 
 # native wire codes (tpucomm.h): dtype f32 = 11, ops SUM = 0 / MAX = 2
 _F32, _F64 = 11, 12
@@ -49,8 +70,10 @@ CANDIDATES = ("ring", "rd", "tree")
 
 def _parse_args(argv=None):
     ap = argparse.ArgumentParser(prog="python -m mpi4jax_tpu.tune")
-    ap.add_argument("--np", type=int, default=4, dest="np_",
-                    help="ranks to tune for (driver mode; default 4)")
+    ap.add_argument("--np", type=int, default=None, dest="np_",
+                    help="ranks to tune for (driver mode; default 4). "
+                         "With --from-trace: override the recording's "
+                         "own world size")
     ap.add_argument("--sizes", default=None,
                     help="comma-separated payload byte sizes "
                          "(default: 1KB..16MB x4 ladder)")
@@ -61,14 +84,48 @@ def _parse_args(argv=None):
                     help="cache file path (default: tune.cache_path(np))")
     ap.add_argument("--port", type=int, default=None,
                     help="launcher base port (driver mode)")
+    ap.add_argument("--from-trace", default=None, metavar="REC[,REC...]",
+                    help="derive the cache from a recorded real run "
+                         "instead of a synthetic sweep: comma-separated "
+                         "recording part files (out.json.rank*.json) "
+                         "and/or merged traces written by `launch --trace` "
+                         "(globs allowed); winners are the best median "
+                         "observed per (op, payload size)")
     return ap.parse_args(argv)
+
+
+def _from_trace(args) -> int:
+    import glob as _glob
+
+    paths = []
+    for piece in args.from_trace.split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        hits = sorted(_glob.glob(piece))
+        if not hits:
+            print(f"tune: --from-trace: no file matches {piece!r}",
+                  file=sys.stderr, flush=True)
+            return 2
+        paths.extend(hits)
+    try:
+        cache = tune.cache_from_trace(
+            paths, world_size=args.np_, cache_path_override=args.cache,
+        )
+    except (ValueError, OSError) as e:
+        print(f"tune: --from-trace: {e}", file=sys.stderr, flush=True)
+        return 2
+    print(f"tune: cache written to {cache} (from {len(paths)} "
+          "recording file(s))")
+    return 0
 
 
 def _driver(args) -> int:
     """Re-exec under the launcher, then report the written cache."""
-    cache = args.cache or tune.cache_path(args.np_)
+    np_ = args.np_ or 4
+    cache = args.cache or tune.cache_path(np_)
     cmd = [sys.executable, "-m", "mpi4jax_tpu.runtime.launch",
-           "-n", str(args.np_)]
+           "-n", str(np_)]
     if args.port:
         cmd += ["--port", str(args.port)]
     cmd += [os.path.abspath(__file__)]
@@ -179,8 +236,16 @@ def _rank(args) -> int:
 
 def main(argv=None) -> int:
     args = _parse_args(argv)
-    from mpi4jax_tpu.runtime import transport
-
+    if args.from_trace:
+        return _from_trace(args)
+    try:
+        from mpi4jax_tpu.runtime import transport
+    except ImportError as e:
+        print(f"tune: the sweep modes need the full package "
+              f"(jax >= 0.6): {e}\n"
+              "tune: --from-trace works standalone on recordings",
+              file=sys.stderr, flush=True)
+        return 2
     if transport.in_world():
         return _rank(args)
     return _driver(args)

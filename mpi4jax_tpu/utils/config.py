@@ -118,6 +118,23 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 (sanitizer builds, cross-build tests;
                                 runtime/bridge.py skips the staleness
                                 rebuild when set).
+- ``MPI4JAX_TPU_TRACE``       — arm the observability recorder and dump
+                                this rank's recording to
+                                ``<value>.rank<r>.json`` at exit.  The
+                                launcher's ``--trace out.json`` sets it
+                                and merges the parts into one
+                                Perfetto-loadable Chrome trace at
+                                ``out.json`` (``mpi4jax_tpu/obs``,
+                                docs/observability.md).  Must agree
+                                across ranks (like the shm knobs): it
+                                arms a collective clock-alignment
+                                handshake at communicator creation.
+- ``MPI4JAX_TPU_TRACE_BUF_KB`` — event-ring size in KB (default 256;
+                                48-byte slots, so ~5400 events), for
+                                both the native transport ring and the
+                                Python span ring.  Overflow keeps the
+                                newest events and counts exactly how
+                                many were dropped.
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels
@@ -167,6 +184,8 @@ KNOBS = {
     "MPI4JAX_TPU_JOBID": "unique token for /dev/shm segment names",
     "MPI4JAX_TPU_COLL_ALGO": "force world-tier collective algorithms",
     "MPI4JAX_TPU_TUNE_CACHE": "persistent autotune cache path",
+    "MPI4JAX_TPU_TRACE": "record per-op events; dump/merge trace here",
+    "MPI4JAX_TPU_TRACE_BUF_KB": "observability event-ring size (KB)",
     "MPI4JAX_TPU_PALLAS_COLLECTIVES": "route mesh collectives via Pallas",
     "MPI4JAX_TPU_ANALYZE_TIMEOUT_S": "static verifier wall deadline",
     "MPI4JAX_TPU_NATIVE_LIB": "override path of the native transport .so",
@@ -252,4 +271,11 @@ def analyze_timeout_s() -> float:
 def native_lib_override():
     """MPI4JAX_TPU_NATIVE_LIB: an explicit transport .so path, or None."""
     raw = os.environ.get("MPI4JAX_TPU_NATIVE_LIB")
+    return raw if raw else None
+
+
+def trace_path():
+    """MPI4JAX_TPU_TRACE: the recording dump/merge base path, or None
+    (observability recorder off)."""
+    raw = os.environ.get("MPI4JAX_TPU_TRACE")
     return raw if raw else None

@@ -251,6 +251,76 @@ int read_all(int fd, void* buf, int64_t n) {
   return 0;
 }
 
+/* ============== observability event ring ==============
+ *
+ * One fixed-size ring of TpuObsEvent per process (see tpucomm.h).  All
+ * entry points append through ObsScope; the blocking sub-phases add
+ * their blocked time to a thread-local accumulator through ObsWaitTimer
+ * so every event carries a wait/transfer split.  Disabled (default):
+ * one relaxed atomic load per op, no clock reads, no ring writes —
+ * g_obs_on is the ONLY thing the hot path touches. */
+
+std::atomic<int> g_obs_on{0};
+std::mutex g_obs_mu;
+std::vector<TpuObsEvent> g_obs_ring;  // fixed capacity once enabled
+int64_t g_obs_total = 0;              // appended since enable (kept + dropped)
+int64_t g_obs_dropped = 0;            // overwritten by overflow
+thread_local double g_obs_wait_acc = 0.0;
+
+void obs_append(const TpuObsEvent& ev) {
+  std::lock_guard<std::mutex> lock(g_obs_mu);
+  if (g_obs_ring.empty()) return;  // disabled raced with the op's scope
+  const int64_t cap = (int64_t)g_obs_ring.size();
+  g_obs_ring[(size_t)(g_obs_total % cap)] = ev;
+  if (g_obs_total >= cap) g_obs_dropped++;
+  g_obs_total++;
+}
+
+/* RAII event record for one transport op.  Constructed after the comm
+ * lock is taken; the destructor stamps duration and the wait share
+ * accumulated by ObsWaitTimer scopes that ran inside the op. */
+struct ObsScope {
+  bool on;
+  double t0 = 0, wait0 = 0;
+  TpuObsEvent ev{};
+  ObsScope(int op, int peer, int tag, int64_t nbytes, int algo = -1) {
+    on = g_obs_on.load(std::memory_order_relaxed) != 0;
+    if (!on) return;
+    ev.op = op;
+    ev.peer = peer;
+    ev.tag = tag;
+    ev.nbytes = nbytes;
+    ev.algo = algo;
+    wait0 = g_obs_wait_acc;
+    t0 = now_s();
+  }
+  void set_algo(int algo) { ev.algo = algo; }
+  ~ObsScope() {
+    if (!on) return;
+    double t1 = now_s();
+    ev.t_start = t0;
+    ev.dur_s = t1 - t0;
+    ev.wait_s = g_obs_wait_acc - wait0;
+    if (ev.wait_s > ev.dur_s) ev.wait_s = ev.dur_s;
+    obs_append(ev);
+  }
+};
+
+/* Accumulates blocked time (header arrival, barrier rendezvous) into
+ * the wait share of the enclosing ObsScope.  Scoped tightly around the
+ * blocking call itself. */
+struct ObsWaitTimer {
+  bool on;
+  double t0 = 0;
+  ObsWaitTimer() {
+    on = g_obs_on.load(std::memory_order_relaxed) != 0;
+    if (on) t0 = now_s();
+  }
+  ~ObsWaitTimer() {
+    if (on) g_obs_wait_acc += now_s() - t0;
+  }
+};
+
 /* ============== failure detection: transport deadlines ==============
  *
  * MPI4JAX_TPU_TIMEOUT_S bounds every blocking wait on the TCP mesh
@@ -789,9 +859,11 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
         header_matches(c, c->self_q.front().first, tag)) {
       source = c->rank;
     } else if (ring_p2p_on(c)) {
+      ObsWaitTimer wt;  // wildcard resolution is pure arrival wait
       if (ring_poll_any(c, tag, &source)) return 1;
-    } else if (poll_any_source(c, tag, &source)) {
-      return 1;
+    } else {
+      ObsWaitTimer wt;
+      if (poll_any_source(c, tag, &source)) return 1;
     }
   }
   if (source < 0 || source >= c->size)
@@ -821,7 +893,13 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
                            out_count);
   if (out_src) *out_src = source;
   MsgHeader h{};
-  int rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  int rc;
+  {
+    /* header arrival is the wait phase: the sender hasn't reached (or
+     * hasn't finished) the matching send until these bytes appear */
+    ObsWaitTimer wt;
+    rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  }
   if (rc) FAIL_IO(c, rc, "recv header from %d", source);
   if (h.tag == kPoisonTag) return poison_fail(c, source, h);
   if (h.comm_id != c->comm_id)
@@ -1332,6 +1410,7 @@ void shm_futex_wake_all(std::atomic<int32_t>* addr) {
 }
 
 int shm_barrier(Comm* c) {
+  ObsWaitTimer wt;  // barrier rendezvous is pure wait (straggler skew)
   ShmHdr* h = c->arena->hdr();
   _mm_sfence();  // drain NT stores before signaling arrival
   int32_t sense = h->bar_sense.load(std::memory_order_acquire);
@@ -1402,6 +1481,7 @@ int shm_barrier(Comm* c) {
 
 int ring_wait_space(Comm* c, int dest, RingHdr* rh, int64_t ring_bytes,
                     int64_t need) {
+  ObsWaitTimer wt;  // blocked on the consumer draining the ring
   double deadline = now_s() + shm_timeout_s();
   int spins = 0;
   for (;;) {
@@ -1471,6 +1551,8 @@ int ring_push(Comm* c, int dst, int32_t tag, int32_t flags,
 
 /* Block until the (src -> me) ring holds a frame; peek it into *out. */
 int ring_wait_frame(Comm* c, int src, RingFrame* out) {
+  ObsWaitTimer wt;  // frame arrival = wait phase (shm twin of the
+                    // TCP header read)
   ShmArena* a = c->arena;
   RingHdr* rh = a->ring_hdr(src, c->rank);
   double deadline = now_s() + shm_timeout_s();
@@ -2077,7 +2159,11 @@ int recv_combine_msg(Comm* c, int source, char* dst, std::vector<char>& tmp,
   const int64_t esize = dtype_size(dtype);
   const int64_t nbytes = count * esize;
   MsgHeader h{};
-  int rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  int rc;
+  {
+    ObsWaitTimer wt;  // header arrival = wait phase (see recv_msg_status)
+    rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  }
   if (rc) FAIL_IO(c, rc, "recv header from %d", source);
   if (h.tag == kPoisonTag) return poison_fail(c, source, h);
   if (h.comm_id != c->comm_id)
@@ -2626,6 +2712,7 @@ int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_SEND, dest, tag, nbytes);
   LogScope log(c->rank, "Send",
                [&] { return "to " + std::to_string(dest) + " (" + std::to_string(nbytes) +
                    " bytes, tag " + std::to_string(tag) + ")"; });
@@ -2642,6 +2729,7 @@ int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag) {
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_RECV, source, tag, nbytes);
   LogScope log(c->rank, "Recv",
                [&] { return "from " + std::to_string(source) + " (" +
                    std::to_string(nbytes) + " bytes, tag " +
@@ -2696,6 +2784,7 @@ int tpucomm_recv_status(int64_t h, void* buf, int64_t nbytes, int source,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_RECV, source, tag, nbytes);
   LogScope log(c->rank, "Recv",
                [&] { return "from " + std::to_string(source) + " (" +
                    std::to_string(nbytes) + " bytes, tag " +
@@ -2712,6 +2801,7 @@ int tpucomm_sendrecv_status(int64_t h, const void* sendbuf,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_SENDRECV, dest, sendtag, send_nbytes + recv_nbytes);
   LogScope log(c->rank, "Sendrecv",
                [&] { return "to " + std::to_string(dest) + " from " +
                    std::to_string(source) + " (status)"; });
@@ -2728,6 +2818,7 @@ int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_SENDRECV, dest, tag, send_nbytes + recv_nbytes);
   LogScope log(c->rank, "Sendrecv",
                [&] { return "to " + std::to_string(dest) + " from " +
                    std::to_string(source); });
@@ -2754,6 +2845,7 @@ int tpucomm_shift2(int64_t h, const void* sendbuf, void* recvbuf,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_SHIFT2, hi, tag, 2 * strip_nbytes);
   LogScope log(c->rank, "Shift2",
                [&] { return std::to_string(strip_nbytes) + " bytes, lo " +
                             std::to_string(lo) + " hi " +
@@ -2804,6 +2896,8 @@ int tpucomm_barrier(int64_t h) {
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_BARRIER, -1, 0, 0,
+               c->arena ? TPU_COLL_SHM : -1);
   LogScope log(c->rank, "Barrier",
                [&] { return std::string(); });
   if (c->arena) return shm_barrier_op(c);
@@ -2825,6 +2919,8 @@ int tpucomm_bcast(int64_t h, void* buf, int64_t nbytes, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_BCAST, root, 0, nbytes,
+               c->arena ? TPU_COLL_SHM : -1);
   LogScope log(c->rank, "Bcast",
                [&] { return std::to_string(nbytes) + " bytes, root " +
                                      std::to_string(root); });
@@ -2837,6 +2933,8 @@ int tpucomm_gather(int64_t h, const void* sendbuf, int64_t nbytes,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_GATHER, root, 0, nbytes,
+               c->arena ? TPU_COLL_SHM : -1);
   LogScope log(c->rank, "Gather",
                [&] { return std::to_string(nbytes) + " bytes, root " +
                                       std::to_string(root); });
@@ -2859,6 +2957,8 @@ int tpucomm_scatter(int64_t h, const void* sendbuf, void* recvbuf,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_SCATTER, root, 0, nbytes,
+               c->arena ? TPU_COLL_SHM : -1);
   LogScope log(c->rank, "Scatter",
                [&] { return std::to_string(nbytes) + " bytes, root " +
                                        std::to_string(root); });
@@ -2882,6 +2982,7 @@ int tpucomm_allgather_algo(int64_t h, const void* sendbuf, int64_t nbytes,
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
   int chosen = resolve_coll_algo(c, TPU_OPKIND_ALLGATHER, nbytes, 0, algo);
+  ObsScope obs(TPU_OBS_ALLGATHER, -1, 0, nbytes, chosen);
   LogScope log(c->rank, "Allgather",
                [&] { return std::to_string(nbytes) + " bytes algo " +
                    coll_algo_name(chosen); });
@@ -2907,6 +3008,8 @@ int tpucomm_alltoall(int64_t h, const void* sendbuf, void* recvbuf,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  ObsScope obs(TPU_OBS_ALLTOALL, -1, 0, chunk * c->size,
+               c->arena ? TPU_COLL_SHM : -1);
   LogScope log(c->rank, "Alltoall",
                [&] { return std::to_string(chunk) + " bytes/chunk"; });
   if (c->arena) return shm_alltoall(c, sendbuf, recvbuf, chunk);
@@ -2939,6 +3042,7 @@ int tpucomm_allreduce_algo(int64_t h, const void* sendbuf, void* recvbuf,
   int64_t nbytes = count * esize;
   int chosen = resolve_coll_algo(c, TPU_OPKIND_ALLREDUCE, nbytes, count,
                                  algo);
+  ObsScope obs(TPU_OBS_ALLREDUCE, -1, 0, nbytes, chosen);
   LogScope log(c->rank, "Allreduce",
                [&] { return std::to_string(count) + " elems dtype " +
                    std::to_string(dtype) + " op " + std::to_string(op) +
@@ -2992,15 +3096,62 @@ int tpucomm_coll_algo_for(int64_t h, int op_kind, int64_t nbytes) {
   return resolve_coll_algo(c, op_kind, nbytes, nbytes / 4, TPU_COLL_AUTO);
 }
 
+void tpucomm_obs_enable(int enabled, int64_t capacity) {
+  std::lock_guard<std::mutex> lock(g_obs_mu);
+  if (enabled) {
+    if (capacity < 16) capacity = 16;
+    g_obs_ring.assign((size_t)capacity, TpuObsEvent{});
+  } else {
+    g_obs_ring.clear();
+    g_obs_ring.shrink_to_fit();
+  }
+  g_obs_total = 0;
+  g_obs_dropped = 0;
+  /* flip the hot-path flag LAST on enable (an op racing this call may
+   * observe on=1 with the ring already sized, never a stale ring) */
+  g_obs_on.store(enabled ? 1 : 0, std::memory_order_release);
+}
+
+void tpucomm_obs_counts(int64_t* out_recorded, int64_t* out_dropped) {
+  std::lock_guard<std::mutex> lock(g_obs_mu);
+  const int64_t cap = (int64_t)g_obs_ring.size();
+  if (out_recorded)
+    *out_recorded = g_obs_total < cap ? g_obs_total : cap;
+  if (out_dropped) *out_dropped = g_obs_dropped;
+}
+
+int64_t tpucomm_obs_drain(TpuObsEvent* out, int64_t max_n) {
+  std::lock_guard<std::mutex> lock(g_obs_mu);
+  const int64_t cap = (int64_t)g_obs_ring.size();
+  if (cap == 0 || max_n <= 0) return 0;
+  int64_t held = g_obs_total < cap ? g_obs_total : cap;
+  int64_t n = held < max_n ? held : max_n;
+  /* oldest-first: when the ring wrapped, the oldest held event sits at
+   * g_obs_total % cap; copy the NEWEST n of the held events in order */
+  int64_t first = g_obs_total - n;  // index of the oldest copied event
+  for (int64_t i = 0; i < n; i++)
+    out[i] = g_obs_ring[(size_t)((first + i) % cap)];
+  /* held events the caller's buffer could not take (e.g. appended
+   * between its count probe and this drain) are COUNTED, never lost
+   * silently — the exact-drop-accounting contract */
+  g_obs_dropped += held - n;
+  g_obs_total = 0;  // drain clears held events; dropped survives
+  return n;
+}
+
+double tpucomm_obs_clock(void) { return now_s(); }
+
 int tpucomm_reduce(int64_t h, const void* sendbuf, void* recvbuf,
                    int64_t count, int dtype, int op, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  int64_t esize = dtype_size(dtype);
+  ObsScope obs(TPU_OBS_REDUCE, root, 0, count * esize,
+               c->arena && c->size > 1 ? TPU_COLL_SHM : -1);
   LogScope log(c->rank, "Reduce",
                [&] { return std::to_string(count) + " elems, root " +
                                       std::to_string(root); });
-  int64_t esize = dtype_size(dtype);
   if (esize == 0) FAIL(c, "bad dtype %d", dtype);
   if (c->arena && c->size > 1) {
     if (c->rank != root && recvbuf != sendbuf)
@@ -3031,9 +3182,11 @@ int tpucomm_scan(int64_t h, const void* sendbuf, void* recvbuf,
   Comm* c = get_comm(h);
   if (!c) return 1;
   std::lock_guard<std::mutex> lock(comm_mu(c));
+  int64_t esize = dtype_size(dtype);
+  ObsScope obs(TPU_OBS_SCAN, -1, 0, count * esize,
+               c->arena && c->size > 1 ? TPU_COLL_SHM : -1);
   LogScope log(c->rank, "Scan",
                [&] { return std::to_string(count) + " elems"; });
-  int64_t esize = dtype_size(dtype);
   if (esize == 0) FAIL(c, "bad dtype %d", dtype);
   if (c->arena && c->size > 1)
     return shm_scan(c, sendbuf, recvbuf, count, dtype, op);

@@ -163,6 +163,56 @@ void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
  * the same-host arena path serves the call.  -1 for a bad handle. */
 int tpucomm_coll_algo_for(int64_t h, int op_kind, int64_t nbytes);
 
+/* ---- observability event ring (mpi4jax_tpu/obs is the owner) ----
+ *
+ * A fixed-size in-memory ring of per-op records: every transport entry
+ * point appends one event (op, peer/root, tag, bytes, algorithm, and a
+ * wait-phase/transfer-phase timing split) when recording is enabled.
+ * Overflow overwrites the OLDEST events and counts every overwrite, so
+ * a drained recording always says exactly how much it is missing.
+ * When disabled (the default) the hot path pays one relaxed atomic
+ * load per op and performs no ring writes and no clock reads. */
+
+/* op codes for TpuObsEvent.op (order is the wire contract with
+ * mpi4jax_tpu/obs/_native.py's OBS_OP_NAMES) */
+enum TpuObsOp {
+  TPU_OBS_SEND = 0, TPU_OBS_RECV, TPU_OBS_SENDRECV, TPU_OBS_SHIFT2,
+  TPU_OBS_BARRIER, TPU_OBS_BCAST, TPU_OBS_GATHER, TPU_OBS_SCATTER,
+  TPU_OBS_ALLGATHER, TPU_OBS_ALLTOALL, TPU_OBS_ALLREDUCE,
+  TPU_OBS_REDUCE, TPU_OBS_SCAN,
+};
+
+struct TpuObsEvent {
+  double t_start;  /* seconds on the recorder clock (tpucomm_obs_clock) */
+  double dur_s;    /* whole-op wall time */
+  double wait_s;   /* blocked share: header arrival waits + barrier waits
+                    * accumulated inside the op (transfer = dur - wait) */
+  int64_t nbytes;  /* payload bytes of this call (0 for barrier) */
+  int32_t op;      /* TpuObsOp */
+  int32_t peer;    /* peer/root rank; -1 when not applicable */
+  int32_t tag;     /* user tag; 0 when not applicable */
+  int32_t algo;    /* TpuCollAlgo that served the call; -1 when n/a */
+};
+
+/* Arm (enabled=1) or disarm (0) recording.  `capacity` is the ring size
+ * in events (clamped to >= 16); re-enabling resizes and clears. */
+void tpucomm_obs_enable(int enabled, int64_t capacity);
+
+/* Totals since the last enable/drain: events currently held, and the
+ * exact number overwritten by overflow. */
+void tpucomm_obs_counts(int64_t* out_recorded, int64_t* out_dropped);
+
+/* Copy up to max_n held events into `out` (the newest max_n, in
+ * oldest-first order), then clear the ring.  Held events that do not
+ * fit `out` are added to the drop counter — never silently lost; the
+ * drop counter survives until re-enable.  Returns the number copied. */
+int64_t tpucomm_obs_drain(struct TpuObsEvent* out, int64_t max_n);
+
+/* The recorder's clock (monotonic seconds, arbitrary per-process
+ * epoch — the same clock TpuObsEvent.t_start uses), so the Python side
+ * can map event times onto the unix epoch by sampling both. */
+double tpucomm_obs_clock(void);
+
 }  /* extern "C" */
 
 #endif  /* TPUCOMM_H */

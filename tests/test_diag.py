@@ -21,7 +21,7 @@ def test_diag_cpu_checks():
     assert data["failed"] == 0
     names = {r["check"] for r in data["results"]}
     assert names == {"native_build", "ffi_fast_path", "coll_algo_engine",
-                     "static_verify", "transport_loopback",
+                     "observability", "static_verify", "transport_loopback",
                      "failure_detection"}
     # the static verifier check proves both verdict directions
     sv = next(r for r in data["results"] if r["check"] == "static_verify")
@@ -36,3 +36,8 @@ def test_diag_cpu_checks():
     fd = next(r for r in data["results"] if r["check"] == "failure_detection")
     assert "timeout_s=" in fd["detail"] and "connect_s=" in fd["detail"]
     assert "detected" in fd["detail"]
+    # the observability probe records a loopback op into the event ring
+    # and proves the export validates against the trace schema
+    ob = next(r for r in data["results"] if r["check"] == "observability")
+    assert "events recorded" in ob["detail"]
+    assert "trace validates" in ob["detail"]
