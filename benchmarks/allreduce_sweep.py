@@ -14,6 +14,13 @@ artifact and the tune package's defaults rest on.  ``--pallas`` benchmarks
 the Pallas RDMA ring collectives (``ops/pallas_collectives.py``) — on TPU
 meshes this times the real inter-chip DMA kernels; off-TPU they run
 interpreted and the numbers only establish correctness-path overhead.
+
+``--latency`` (world tier) switches to the small-message mode: a
+1 B – 64 KiB sweep reporting p50/p95/p99 microseconds per op — both
+in-jit (the serving-traffic shape the async progress engine targets)
+and at the raw transport — instead of GB/s, which hides small-message
+regressions (the BENCH_r05 72 us figure was invisible in the
+bandwidth curves).
 """
 
 import argparse
@@ -234,6 +241,91 @@ def world_tier_rank(max_bytes, sizes=None, algos=None):
     tune.clear_overrides()
 
 
+def world_latency_rank(sizes=None):
+    """Small-message latency mode: p50/p95/p99 microseconds per op over
+    a 1 B – 64 KiB uint8 sweep, in-jit and at the raw transport.
+
+    In-jit per-op samples come from repeated calls of one jitted
+    scan-of-K step (per-op = call / K, one sample per call) — the same
+    amortized-dispatch shape as the GB/s sweep, but keeping the full
+    distribution instead of one median.  Raw samples time every native
+    call individually.
+    """
+    import ctypes
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import mpi4jax_tpu as m4j
+    from mpi4jax_tpu import obs
+    from mpi4jax_tpu.runtime import bridge
+    from mpi4jax_tpu.utils import dtypes as _dtypes
+
+    comm = m4j.get_default_comm()
+    n = comm.size()
+    size_list = sizes or [1, 4, 16, 64, 256, 1024, 4096, 16384, 65536]
+    for size in size_list:
+        K = max(8, min(64, int(4e6 / max(size, 64))))
+
+        @jax.jit
+        def many(v):
+            def step(c, _):
+                return m4j.allreduce(c, op=m4j.SUM, comm=comm), ()
+            out, _ = jax.lax.scan(step, v, None, length=K)
+            return out
+
+        x = jnp.ones((size,), jnp.uint8)
+        calls = 16
+        for _ in range(4):  # warmup: allocator/caches/convoy alignment
+            out = many(x)
+        jax.block_until_ready(out)
+        jit_us = []
+        for _ in range(calls):
+            t0 = time.perf_counter()
+            out = many(x)
+            jax.block_until_ready(out)
+            jit_us.append((time.perf_counter() - t0) / K * 1e6)
+
+        # raw transport: every native call timed individually
+        a = np.ones(size, np.uint8)
+        o = np.empty_like(a)
+        lib = bridge.get_lib()
+        fn = lib.tpucomm_allreduce
+        args = (ctypes.c_int64(comm.handle),
+                a.ctypes.data_as(ctypes.c_void_p),
+                o.ctypes.data_as(ctypes.c_void_p),
+                ctypes.c_int64(a.size),
+                ctypes.c_int(_dtypes.wire_code(a.dtype)),
+                ctypes.c_int(0))
+        raw_reps = calls * K
+        rc = fn(*args)  # align ranks on the same op count
+        raw_us = []
+        for _ in range(raw_reps):
+            t0 = time.perf_counter()
+            rc |= fn(*args)
+            raw_us.append((time.perf_counter() - t0) * 1e6)
+        if rc != 0:
+            raise RuntimeError(f"native allreduce failed (rc={rc})")
+
+        if comm.rank() == 0:
+            rec = obs.bench_record(
+                op="allreduce", nbytes=size,
+                seconds=obs.percentile(jit_us, 50) / 1e6, ranks=n,
+                tier="world", mode="latency", ops_per_jit=K, calls=calls,
+                p50_us=round(obs.percentile(jit_us, 50), 3),
+                p95_us=round(obs.percentile(jit_us, 95), 3),
+                p99_us=round(obs.percentile(jit_us, 99), 3),
+                raw_p50_us=round(obs.percentile(raw_us, 50), 3),
+                raw_p95_us=round(obs.percentile(raw_us, 95), 3),
+                raw_p99_us=round(obs.percentile(raw_us, 99), 3),
+                resolved_algo=comm.coll_algo("allreduce", size),
+            )
+            print(json.dumps(rec), flush=True)
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-mb", type=float, default=64)
@@ -246,13 +338,26 @@ if __name__ == "__main__":
                     help="comma-separated forced collective algorithms to "
                          "sweep (world tier only; e.g. auto,ring,rd,tree — "
                          "one GB/s curve per algorithm)")
+    ap.add_argument("--latency", action="store_true",
+                    help="small-message mode (world tier): 1 B - 64 KiB "
+                         "sweep emitting p50/p95/p99 us per op instead of "
+                         "GB/s")
     args = ap.parse_args()
     if args.world and args.pallas:
         ap.error("--pallas applies to the mesh tier; drop --world")
     if args.algos and not args.world:
         ap.error("--algos applies to the world tier; add --world")
+    if args.latency and not args.world:
+        ap.error("--latency applies to the world tier; add --world")
+    if args.latency and args.algos:
+        ap.error("--latency sweeps the engine-selected algorithm; drop "
+                 "--algos")
     max_bytes = int(args.max_mb * 1e6)
-    if args.world:
+    if args.latency:
+        sizes = ([int(s) for s in args.sizes.split(",")]
+                 if args.sizes else None)
+        world_latency_rank(sizes=sizes)
+    elif args.world:
         sizes = ([int(s) for s in args.sizes.split(",")]
                  if args.sizes else None)
         algos = ([a.strip() for a in args.algos.split(",") if a.strip()]

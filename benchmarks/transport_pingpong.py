@@ -27,11 +27,26 @@ from mpi4jax_tpu.runtime import bridge, transport
 
 
 def timeit(fn, reps):
-    fn()  # warmup
-    t0 = time.perf_counter()
-    for _ in range(reps):
+    """Mean seconds per call plus per-call percentiles, with the warmup
+    iterations EXCLUDED from every reported number.
+
+    The previous implementation warmed up with a single call: at small
+    rep counts (the 16 MiB rows run reps=5) the first measured
+    iterations still carried allocator/page-fault warmup, which
+    polluted the reported figures exactly where there were fewest
+    samples to absorb them.  Warmup scales with reps (at least 2, at
+    most 25) and is reported alongside the measured count.
+    """
+    warmup = max(2, min(25, reps // 20))
+    for _ in range(warmup):
         fn()
-    return (time.perf_counter() - t0) / reps
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    mean = sum(times) / len(times)
+    return mean, times, warmup
 
 
 def main():
@@ -40,6 +55,21 @@ def main():
     assert size == 2, "pingpong wants exactly 2 ranks"
     peer = 1 - rank
     rows = []
+
+    def record(op, nbytes, mean, times, warmup, reps, **extra):
+        # one serializer for every benchmark artifact (obs.bench_record):
+        # BENCH_*.json, sweep curves, and profile reports stay
+        # field-compatible on (op, bytes, seconds); reps is the MEASURED
+        # iteration count (warmup excluded and noted separately)
+        us = [t * 1e6 for t in times]
+        return obs.bench_record(
+            op=op, nbytes=nbytes, seconds=mean, tier="transport",
+            reps=reps, warmup_excluded=warmup,
+            p50_us=round(obs.percentile(us, 50), 3),
+            p95_us=round(obs.percentile(us, 95), 3),
+            p99_us=round(obs.percentile(us, 99), 3),
+            **extra,
+        )
 
     # sendrecv round: each rank sends to the peer and receives back —
     # one full round of the persistent-writer (or eager inline) path
@@ -51,13 +81,9 @@ def main():
             bridge.sendrecv(handle, buf, buf.shape, buf.dtype,
                             peer, peer, 7)
 
-        dt = timeit(round_trip, reps)
-        # one serializer for every benchmark artifact (obs.bench_record):
-        # BENCH_*.json, sweep curves, and profile reports stay
-        # field-compatible on (op, bytes, seconds)
-        rows.append(obs.bench_record(op="sendrecv_round", nbytes=nbytes,
-                                     seconds=dt, tier="transport",
-                                     reps=reps))
+        mean, times, warmup = timeit(round_trip, reps)
+        rows.append(record("sendrecv_round", nbytes, mean, times, warmup,
+                           reps))
 
     # allreduce: the doc table's three sizes
     for nbytes, reps in ((1024, 2000), (65536, 300), (16 << 20, 5)):
@@ -66,10 +92,9 @@ def main():
         def reduce_once():
             bridge.allreduce(handle, buf, 0)  # 0 = SUM
 
-        dt = timeit(reduce_once, reps)
-        rows.append(obs.bench_record(op="allreduce", nbytes=nbytes,
-                                     seconds=dt, ranks=size,
-                                     tier="transport", reps=reps))
+        mean, times, warmup = timeit(reduce_once, reps)
+        rows.append(record("allreduce", nbytes, mean, times, warmup, reps,
+                           ranks=size))
 
     bridge.barrier(handle)
     if rank == 0:

@@ -27,6 +27,7 @@ class TpuObsEvent(ctypes.Structure):
         ("t_start", ctypes.c_double),
         ("dur_s", ctypes.c_double),
         ("wait_s", ctypes.c_double),
+        ("queue_s", ctypes.c_double),
         ("nbytes", ctypes.c_int64),
         ("op", ctypes.c_int32),
         ("peer", ctypes.c_int32),
@@ -41,8 +42,15 @@ EVENT_BYTES = ctypes.sizeof(TpuObsEvent)
 
 def available(lib) -> bool:
     """True when the loaded .so carries the event ring (a stale prebuilt
-    library predating it keeps working, just unobserved)."""
+    library predating it keeps working, just unobserved).
+
+    ``tpucomm_execute`` doubles as the layout probe: a library from
+    before the async progress engine records events WITHOUT the
+    ``queue_s`` field, which this module would misparse — such a
+    library is treated as unobserved rather than decoded wrong."""
     if lib is None or not hasattr(lib, "tpucomm_obs_enable"):
+        return False
+    if not hasattr(lib, "tpucomm_execute"):
         return False
     # idempotent signature setup (works for bridge-loaded and
     # standalone-loaded libraries alike)
@@ -82,9 +90,10 @@ def clock(lib) -> float:
 def drain(lib, max_events: int = 1 << 20):
     """Pull and clear the held events, oldest first, as raw dicts with
     the native clock's timestamps (seconds): op/peer/tag/bytes/algo/
-    t/dur_s/wait_s.  Events the buffer cannot take (appended between
-    the count probe and the drain, or beyond ``max_events``) are
-    counted as dropped by the native side, never silently lost."""
+    t/dur_s/wait_s/queue_s (the dispatch phase: post -> native start,
+    0 for inline execution).  Events the buffer cannot take (appended
+    between the count probe and the drain, or beyond ``max_events``)
+    are counted as dropped by the native side, never silently lost."""
     held, _ = counts(lib)
     # headroom for events appended after the count probe (the native
     # drain clamps to what is actually held)
@@ -102,6 +111,7 @@ def drain(lib, max_events: int = 1 << 20):
             "t": e.t_start,
             "dur_s": e.dur_s,
             "wait_s": e.wait_s,
+            "queue_s": e.queue_s,
             "bytes": e.nbytes,
             "peer": e.peer,
             "tag": e.tag,

@@ -100,7 +100,7 @@ def enabled() -> bool:
 
 def default_capacity_events() -> int:
     """Ring capacity from ``MPI4JAX_TPU_TRACE_BUF_KB`` (default 256 KB
-    of 48-byte native slots ≈ 5400 events; same count on the Python
+    of 56-byte native slots ≈ 4600 events; same count on the Python
     side)."""
     raw = config.setting("MPI4JAX_TPU_TRACE_BUF_KB", "256")
     try:
@@ -174,6 +174,7 @@ def record_span(name: str, t_unix: float, dur_s: float, *, peer=-1,
         "ts_us": t_unix * 1e6 + _state.clock_offset_us,
         "dur_us": dur_s * 1e6,
         "wait_us": 0.0,
+        "dispatch_us": 0.0,
         "bytes": int(nbytes),
         "peer": int(peer),
         "tag": int(tag),
@@ -197,6 +198,7 @@ def _pull_native() -> None:
             "ts_us": (e["t"] + to_unix) * 1e6 + _state.clock_offset_us,
             "dur_us": e["dur_s"] * 1e6,
             "wait_us": e["wait_s"] * 1e6,
+            "dispatch_us": e.get("queue_s", 0.0) * 1e6,
             "bytes": e["bytes"],
             "peer": e["peer"],
             "tag": e["tag"],

@@ -7,8 +7,14 @@ Canonical event shape (every producer — the native ring, the ops-layer
 ``CallTrace`` hook, and part-file loads — normalizes to this):
 
     {"name": "Allreduce", "src": "native" | "ops", "ts_us": float,
-     "dur_us": float, "wait_us": float, "bytes": int, "peer": int,
-     "tag": int, "algo": "ring" | ... | None}
+     "dur_us": float, "wait_us": float, "dispatch_us": float,
+     "bytes": int, "peer": int, "tag": int,
+     "algo": "ring" | ... | None}
+
+``dispatch_us`` is the submission-queue delay of an engine-queued op
+(post -> native execution start; 0 for inline execution) — the host
+dispatch share, separated from the peer-wait share (``wait_us``) and
+the wire share (``dur - dispatch - wait``).
 
 ``ts_us`` is on the job-global aligned timeline (unix microseconds plus
 the rank's estimated clock offset — see ``_trace.py``).
@@ -51,10 +57,11 @@ def summarize(events, dropped=None, rank=None) -> dict:
 
     Returns ``{"schema", "rank", "total_events", "dropped", "per_op"}``
     where ``per_op`` rows carry count, total bytes, p50/p95/p99 latency
-    (microseconds), the wait fraction (share of wall time blocked on
-    peers rather than moving bytes), and effective GB/s
-    (``sum(bytes) / sum(seconds)`` — payload over wall time, no
-    algorithm factor).
+    (microseconds), the dispatch fraction (share of wall time spent in
+    the engine's submission queue — host dispatch, not communication),
+    the wait fraction (share blocked on peers rather than moving
+    bytes), and effective GB/s (``sum(bytes) / sum(seconds)`` —
+    payload over wall time, no algorithm factor).
     """
     groups = {}
     for ev in events:
@@ -68,6 +75,7 @@ def summarize(events, dropped=None, rank=None) -> dict:
     for (op, src, peer, algo), evs in sorted(groups.items()):
         durs = [float(e.get("dur_us", 0.0)) for e in evs]
         waits = [float(e.get("wait_us", 0.0)) for e in evs]
+        disps = [float(e.get("dispatch_us", 0.0)) for e in evs]
         nbytes = sum(int(e.get("bytes", 0)) for e in evs)
         seconds = sum(durs) / 1e6
         rows.append({
@@ -81,6 +89,7 @@ def summarize(events, dropped=None, rank=None) -> dict:
             "p50_us": round(percentile(durs, 50), 3),
             "p95_us": round(percentile(durs, 95), 3),
             "p99_us": round(percentile(durs, 99), 3),
+            "dispatch_frac": round(sum(disps) / max(sum(durs), 1e-12), 4),
             "wait_frac": round(sum(waits) / max(sum(durs), 1e-12), 4),
             "eff_GBps": _sig(nbytes / max(seconds, 1e-12) / 1e9),
         })
@@ -98,7 +107,7 @@ def summarize(events, dropped=None, rank=None) -> dict:
 def render_table(stats: dict, *, by=("op", "algo")) -> str:
     """Human-readable per-op table (the profile CLI's ``report``)."""
     cols = ("op", "src", "peer", "algo", "count", "bytes", "p50_us",
-            "p95_us", "p99_us", "wait_frac", "eff_GBps")
+            "p95_us", "p99_us", "dispatch_frac", "wait_frac", "eff_GBps")
     rows = stats.get("per_op", [])
     if not rows:
         return "(no events recorded)"

@@ -7,11 +7,13 @@ and chrome://tracing both load:
 - ``pid`` = rank (with a ``process_name`` metadata event per rank),
 - ``tid`` 0 = the native transport, ``tid`` 1 = the ops layer,
 - every op span carries ``args`` with bytes / peer / tag / algorithm /
-  the exact ``wait_us``,
-- each native span additionally gets two nested child slices, ``wait``
-  and ``wire``, rendering the blocked/transfer split visually (the wait
-  share is drawn as the span's prefix — an approximation of its true
-  distribution inside the op; ``args.wait_us`` is exact).
+  the exact ``wait_us`` and ``dispatch_us``,
+- each native span additionally gets nested child slices ``dispatch``
+  (submission-queue delay of an engine-queued op), ``wait``, and
+  ``wire``, rendering the host-dispatch/blocked/transfer split
+  visually (dispatch and wait are drawn as the span's prefix — an
+  approximation of their true distribution inside the op;
+  ``args.dispatch_us`` / ``args.wait_us`` are exact).
 
 Timestamps are microseconds on the job-global aligned timeline: each
 rank's dump already applied its clock offset (estimated over the
@@ -46,6 +48,7 @@ def rank_trace_events(events, rank: int):
             "peer": int(ev.get("peer", -1)),
             "tag": int(ev.get("tag", 0)),
             "wait_us": round(float(ev.get("wait_us", 0.0)), 3),
+            "dispatch_us": round(float(ev.get("dispatch_us", 0.0)), 3),
         }
         if ev.get("algo"):
             args["algo"] = ev["algo"]
@@ -53,17 +56,29 @@ def rank_trace_events(events, rank: int):
                     "ph": "X", "pid": int(rank), "tid": tid,
                     "ts": round(ts, 3), "dur": round(dur, 3), "args": args})
         wait = float(ev.get("wait_us", 0.0))
-        if tid == 0 and wait > 0.0:
-            # nested child slices: wait prefix, then the wire phase
-            wait = min(wait, dur)
-            out.append({"name": "wait", "cat": "phase", "ph": "X",
-                        "pid": int(rank), "tid": tid, "ts": round(ts, 3),
-                        "dur": round(wait, 3), "args": {}})
-            if dur - wait > 0.0:
+        disp = float(ev.get("dispatch_us", 0.0))
+        if tid == 0 and (wait > 0.0 or disp > 0.0):
+            # nested child slices: dispatch prefix (submission-queue
+            # delay), then wait, then the wire phase
+            disp = min(max(disp, 0.0), dur)
+            wait = min(max(wait, 0.0), dur - disp)
+            off = 0.0
+            if disp > 0.0:
+                out.append({"name": "dispatch", "cat": "phase", "ph": "X",
+                            "pid": int(rank), "tid": tid, "ts": round(ts, 3),
+                            "dur": round(disp, 3), "args": {}})
+                off += disp
+            if wait > 0.0:
+                out.append({"name": "wait", "cat": "phase", "ph": "X",
+                            "pid": int(rank), "tid": tid,
+                            "ts": round(ts + off, 3),
+                            "dur": round(wait, 3), "args": {}})
+                off += wait
+            if dur - off > 0.0:
                 out.append({"name": "wire", "cat": "phase", "ph": "X",
                             "pid": int(rank), "tid": tid,
-                            "ts": round(ts + wait, 3),
-                            "dur": round(dur - wait, 3), "args": {}})
+                            "ts": round(ts + off, 3),
+                            "dur": round(dur - off, 3), "args": {}})
     return out
 
 

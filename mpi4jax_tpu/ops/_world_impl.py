@@ -854,6 +854,15 @@ def _coll_algo_detail(comm, opname, nbytes):
         return "?"
 
 
+def _reuse_ok() -> bool:
+    """Output-buffer reuse (bridge ``reuse=True``) is safe only on the
+    callback path, where jax copies the result into the XLA output
+    buffer before the (ordered) callback returns.  Staged-eager
+    dispatch device_puts the numpy result — potentially zero-copy — so
+    it must keep fresh buffers."""
+    return not _use_staged_eager()
+
+
 def _host_allreduce(x, *, comm, op):
     from ..runtime import bridge
 
@@ -863,7 +872,8 @@ def _host_allreduce(x, *, comm, op):
                 f"{_coll_algo_detail(comm, 'allreduce', x.nbytes)}",
         nbytes=x.nbytes,
     ):
-        return bridge.allreduce(comm.handle, x, _OP_CODE[op.name])
+        return bridge.allreduce(comm.handle, x, _OP_CODE[op.name],
+                                reuse=_reuse_ok())
 
 
 def _host_reduce(x, *, comm, op, root):
@@ -871,7 +881,8 @@ def _host_reduce(x, *, comm, op, root):
 
     with tracing.CallTrace(comm.rank(), "Reduce", f"op {op.name} root {root}",
                            peer=root, nbytes=x.nbytes):
-        return bridge.reduce(comm.handle, x, _OP_CODE[op.name], root)
+        return bridge.reduce(comm.handle, x, _OP_CODE[op.name], root,
+                             reuse=_reuse_ok())
 
 
 def _host_scan(x, *, comm, op):
@@ -879,7 +890,8 @@ def _host_scan(x, *, comm, op):
 
     with tracing.CallTrace(comm.rank(), "Scan", f"op {op.name}",
                            nbytes=x.nbytes):
-        return bridge.scan(comm.handle, x, _OP_CODE[op.name])
+        return bridge.scan(comm.handle, x, _OP_CODE[op.name],
+                           reuse=_reuse_ok())
 
 
 def _host_bcast(x, *, comm, root):
@@ -898,7 +910,8 @@ def _host_allgather(x, *, comm):
         lambda: f"algo {_coll_algo_detail(comm, 'allgather', x.nbytes)}",
         nbytes=x.nbytes,
     ):
-        return bridge.allgather(comm.handle, x, comm.size())
+        return bridge.allgather(comm.handle, x, comm.size(),
+                                reuse=_reuse_ok())
 
 
 def _host_gather(x, *, comm, root):
@@ -958,7 +971,8 @@ def _host_recv(x, *, comm, source, tag, status=None):
                            peer=source, nbytes=x.nbytes, tag=tag):
         if status is None:
             # strict path: arrived size must equal the buffer exactly
-            return bridge.recv(comm.handle, x.shape, x.dtype, source, tag)
+            return bridge.recv(comm.handle, x.shape, x.dtype, source, tag,
+                               reuse=_reuse_ok())
         out, src, tg, cnt = bridge.recv_status(
             comm.handle, x.shape, x.dtype, source, tag
         )
@@ -975,7 +989,8 @@ def _host_sendrecv(x, *, comm, source, dest, sendtag, recvtag, status=None):
     ):
         if status is None and sendtag == recvtag:
             return bridge.sendrecv(
-                comm.handle, x, x.shape, x.dtype, source, dest, sendtag
+                comm.handle, x, x.shape, x.dtype, source, dest, sendtag,
+                reuse=_reuse_ok()
             )
         out, src, tg, cnt = bridge.sendrecv_status(
             comm.handle, x, x.shape, x.dtype, source, dest, sendtag,

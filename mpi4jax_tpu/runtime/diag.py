@@ -117,6 +117,12 @@ def check_observability(port):
         if not {"Send", "Recv", "Allreduce"} <= ops:
             return False, f"recorded ops {sorted(ops)} missing Send/Recv/" \
                           "Allreduce"
+        # every native row must carry the dispatch-phase split (the
+        # async progress engine's queue-time vs wire-time attribution)
+        native_rows = [r for r in stats["per_op"] if r["src"] == "native"]
+        if not native_rows or any("dispatch_frac" not in r
+                                  for r in native_rows):
+            return False, "native stats rows missing dispatch_frac"
         count = sum(row["count"] for row in stats["per_op"])
         trace = obs.merge_parts([{
             "rank": 0, "size": 1, "events": obs.events(),
@@ -125,9 +131,17 @@ def check_observability(port):
         errors = obs.validate_chrome_trace(trace)
         if errors:
             return False, f"trace schema errors: {errors[:3]}"
+        spans = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+        if not any("dispatch_us" in (e.get("args") or {}) for e in spans):
+            return False, "trace spans missing dispatch_us"
+        from ..utils import config as _config
+
+        engine = ("on" if _config.progress_thread_enabled() else "off")
         return True, (f"{count} loopback events recorded, stats ops "
-                      f"{sorted(ops)}, trace validates "
-                      f"({obs.default_capacity_events()}-event ring)")
+                      f"{sorted(ops)}, dispatch split present, trace "
+                      f"validates ({obs.default_capacity_events()}-event "
+                      f"ring; progress engine {engine}, coalesce "
+                      f"{_config.coalesce_bytes()} B)")
     finally:
         obs.stop()
         lib.tpucomm_finalize(ctypes.c_int64(h))

@@ -130,11 +130,35 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 arms a collective clock-alignment
                                 handshake at communicator creation.
 - ``MPI4JAX_TPU_TRACE_BUF_KB`` — event-ring size in KB (default 256;
-                                48-byte slots, so ~5400 events), for
+                                56-byte slots, so ~4600 events), for
                                 both the native transport ring and the
                                 Python span ring.  Overflow keeps the
                                 newest events and counts exactly how
                                 many were dropped.
+- ``MPI4JAX_TPU_PROGRESS_THREAD`` — async progress engine (default on):
+                                every transport op is a descriptor on a
+                                per-communicator submission queue driven
+                                by a dedicated progress thread — small
+                                sends return immediately (payload
+                                copied, buffered-send semantics), other
+                                ops park on a completion futex while an
+                                earlier op is still in flight, and run
+                                inline when the engine is idle.  ``0``
+                                restores the pre-engine inline
+                                execution bit-for-bit (read natively).
+- ``MPI4JAX_TPU_COALESCE_BYTES`` — sends of at most this many bytes
+                                that are adjacent in posted order to
+                                the same peer merge into ONE wire frame
+                                (split transparently on the receive
+                                side, tags and per-channel order
+                                preserved).  Default 4096; 0 disables
+                                coalescing (read natively; needs the
+                                progress engine).
+- ``MPI4JAX_TPU_QUEUE_DEPTH`` — submission-queue capacity in ops
+                                (default 1024, rounded up to a power of
+                                two; posting parks when full — bounded
+                                memory, never unbounded buffering; read
+                                natively).
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels
@@ -186,6 +210,9 @@ KNOBS = {
     "MPI4JAX_TPU_TUNE_CACHE": "persistent autotune cache path",
     "MPI4JAX_TPU_TRACE": "record per-op events; dump/merge trace here",
     "MPI4JAX_TPU_TRACE_BUF_KB": "observability event-ring size (KB)",
+    "MPI4JAX_TPU_PROGRESS_THREAD": "async progress engine on/off",
+    "MPI4JAX_TPU_COALESCE_BYTES": "small-send coalescing threshold",
+    "MPI4JAX_TPU_QUEUE_DEPTH": "progress-engine submission-queue depth",
     "MPI4JAX_TPU_PALLAS_COLLECTIVES": "route mesh collectives via Pallas",
     "MPI4JAX_TPU_ANALYZE_TIMEOUT_S": "static verifier wall deadline",
     "MPI4JAX_TPU_NATIVE_LIB": "override path of the native transport .so",
@@ -272,6 +299,31 @@ def native_lib_override():
     """MPI4JAX_TPU_NATIVE_LIB: an explicit transport .so path, or None."""
     raw = os.environ.get("MPI4JAX_TPU_NATIVE_LIB")
     return raw if raw else None
+
+
+def progress_thread_enabled() -> bool:
+    """Resolved MPI4JAX_TPU_PROGRESS_THREAD (default True).
+
+    The knob itself is read natively on every op; this mirror is for
+    diagnostics (``runtime.diag``) and documentation tooling."""
+    raw = os.environ.get("MPI4JAX_TPU_PROGRESS_THREAD")
+    if raw is None or not raw.strip():
+        return True
+    return parse_bool(raw, name="MPI4JAX_TPU_PROGRESS_THREAD")
+
+
+def coalesce_bytes() -> int:
+    """Resolved MPI4JAX_TPU_COALESCE_BYTES (default 4096; 0 = off),
+    mirroring the native parser's clamps for diagnostics."""
+    raw = os.environ.get("MPI4JAX_TPU_COALESCE_BYTES")
+    if raw is None or not raw.strip():
+        return 4096
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse MPI4JAX_TPU_COALESCE_BYTES={raw!r} as bytes")
+    return max(0, min(v, 64 * 1024))
 
 
 def trace_path():

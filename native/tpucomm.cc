@@ -154,6 +154,18 @@ struct SendJob {
 
 struct ShmArena;  // same-host shared-memory fast path, defined below
 void arena_destroy(ShmArena* a);
+struct Engine;    // async progress engine (per socket-owning comm)
+void engine_shutdown(Engine* e);
+
+/* A user message staged off the socket: a coalesced wire frame carries
+ * several adjacent small sends from one peer; the receiver lands the
+ * one a posted receive is waiting for directly in the user buffer and
+ * stages the rest here, consumed strictly in arrival order (the same
+ * in-order-channel contract as the wire). */
+struct PendingMsg {
+  MsgHeader hdr;
+  std::vector<char> data;
+};
 
 struct Comm;
 /* shm p2p rings (defined in the arena section below) */
@@ -176,6 +188,11 @@ struct Comm {
    * (MPI allows self-messaging; the reference's exit-flush regression is
    * a sendrecv-to-self, test_common.py:91-114 there).  Guarded by mu. */
   std::deque<std::pair<MsgHeader, std::vector<char>>> self_q;
+  /* coalesced sub-messages staged off the wire, keyed by source rank.
+   * Touched only by the thread executing this comm's ops (the same
+   * single-executor discipline as self_q: either the calling thread
+   * running inline, or the progress thread — never both at once). */
+  std::map<int, std::deque<PendingMsg>> pending;
   int32_t comm_id = 0;     // deterministic across ranks (world = 0)
   bool owns_socks = true;  // split/dup comms borrow the parent's sockets
   int32_t next_split_seq = 1;  // collective-call counter, agrees rank-wide
@@ -196,7 +213,14 @@ struct Comm {
   bool writer_started = false;
   bool wstop = false;
 
+  /* Async progress engine (lives on the socket-owning root comm, like
+   * the writer thread): a dedicated progress thread draining a
+   * lock-free submission queue of op descriptors.  Created lazily on
+   * the first queued post; null while every op has run inline. */
+  Engine* engine = nullptr;
+
   ~Comm() {
+    if (engine) engine_shutdown(engine);  // drains, joins, frees
     if (writer_started) {
       {
         std::lock_guard<std::mutex> lock(wmu);
@@ -276,14 +300,19 @@ void obs_append(const TpuObsEvent& ev) {
   g_obs_total++;
 }
 
-/* RAII event record for one transport op.  Constructed after the comm
- * lock is taken; the destructor stamps duration and the wait share
- * accumulated by ObsWaitTimer scopes that ran inside the op. */
+/* RAII event record for one transport op.  Constructed where the op
+ * starts EXECUTING (inline on the calling thread, or on the progress
+ * thread for queued descriptors); the destructor stamps duration and
+ * the wait share accumulated by ObsWaitTimer scopes inside the op.
+ * `t_post` (>= 0) is the submission time of an engine-queued op: the
+ * event's t_start becomes the post time and queue_s the dispatch
+ * delay (post -> execution start), so dur = queue + wait + wire. */
 struct ObsScope {
   bool on;
-  double t0 = 0, wait0 = 0;
+  double t0 = 0, wait0 = 0, post = -1;
   TpuObsEvent ev{};
-  ObsScope(int op, int peer, int tag, int64_t nbytes, int algo = -1) {
+  ObsScope(int op, int peer, int tag, int64_t nbytes, int algo = -1,
+           double t_post = -1) {
     on = g_obs_on.load(std::memory_order_relaxed) != 0;
     if (!on) return;
     ev.op = op;
@@ -292,16 +321,19 @@ struct ObsScope {
     ev.nbytes = nbytes;
     ev.algo = algo;
     wait0 = g_obs_wait_acc;
+    post = t_post;
     t0 = now_s();
   }
   void set_algo(int algo) { ev.algo = algo; }
   ~ObsScope() {
     if (!on) return;
     double t1 = now_s();
-    ev.t_start = t0;
-    ev.dur_s = t1 - t0;
+    double start = post >= 0 && post <= t0 ? post : t0;
+    ev.t_start = start;
+    ev.dur_s = t1 - start;
+    ev.queue_s = t0 - start;
     ev.wait_s = g_obs_wait_acc - wait0;
-    if (ev.wait_s > ev.dur_s) ev.wait_s = ev.dur_s;
+    if (ev.wait_s > ev.dur_s - ev.queue_s) ev.wait_s = ev.dur_s - ev.queue_s;
     obs_append(ev);
   }
 };
@@ -375,6 +407,15 @@ double connect_timeout_s() {
 thread_local int64_t g_io_done = 0;
 thread_local int64_t g_io_want = 0;
 
+/* Deadline anchor for engine-queued ops: deadlines are measured from
+ * POST time, not execution start — time an op spends behind others in
+ * the submission queue is zero-progress time and must count against
+ * the job deadline.  The progress-thread executor sets this to the
+ * descriptor's post timestamp; the first deadline-bounded transfer of
+ * the op consumes it (anchoring its initial window at the post time),
+ * after which the usual any-progress-resets-the-clock rule applies. */
+thread_local double g_dl_post_anchor = 0;
+
 /* Deadline-bounded read/write of exactly n bytes.  Returns 0 on
  * success, 1 on a socket error (errno describes it), 2 when the
  * deadline passed with zero bytes of progress (g_io_done / g_io_want
@@ -388,6 +429,13 @@ int io_all_deadline(int fd, void* buf, int64_t n, double t = -1.0) {
   char* p = static_cast<char*>(buf);
   int64_t left = n;
   double deadline = now_s() + t;
+  if (g_dl_post_anchor > 0) {
+    /* queued op: the first window is anchored at post time (consumed
+     * once; progress below re-anchors at now as usual) */
+    double anchored = g_dl_post_anchor + t;
+    if (anchored < deadline) deadline = anchored;
+    g_dl_post_anchor = 0;
+  }
   while (left > 0) {
     double remain = deadline - now_s();
     if (remain <= 0) {
@@ -736,6 +784,15 @@ constexpr int kAnySource = -2;
 /* collective-protocol frames (never visible to user receives) */
 constexpr int kCollectiveTag = -7701;
 
+/* Coalesced container frame: several adjacent small sends to one peer
+ * packed into one wire frame by the progress engine (sender side).
+ * Payload = repeated [MsgHeader | payload] sub-messages, each with its
+ * original user tag; the receive side splits them back apart (first
+ * matching sub-message lands directly in the posted user buffer, the
+ * rest stage in Comm::pending), so tags, sizes, and per-channel order
+ * are bit-for-bit what N separate frames would have delivered. */
+constexpr int kCoalescedTag = -7703;
+
 /* True when a frame header is eligible for a wildcard receive on comm
  * `c` with tag filter `tag`: right communicator, and either the exact
  * tag or (under ANY_TAG) any *user* tag — collective-protocol frames
@@ -744,8 +801,89 @@ constexpr int kCollectiveTag = -7701;
 bool header_matches(const Comm* c, const MsgHeader& h, int tag) {
   if (h.tag == kPoisonTag) return false;  // never user data: a peer abort
   if (h.comm_id != c->comm_id) return false;
-  if (tag == kAnyTag) return h.tag != kCollectiveTag;
+  if (tag == kAnyTag)
+    return h.tag != kCollectiveTag && h.tag != kCoalescedTag;
   return h.tag == tag;
+}
+
+/* Read one coalesced container frame (outer header already consumed)
+ * from `source` and split it back into user messages.  When `buf` is a
+ * posted receive (non-null) whose tag filter matches the FIRST
+ * sub-message, that payload lands directly in the user buffer (no
+ * staging copy) and *consumed is set; every other sub-message stages
+ * in c->pending[source] in arrival order. */
+int stage_coalesced(Comm* c, int source, const MsgHeader& outer, int tag,
+                    void* buf, int64_t nbytes, int32_t* out_tag,
+                    int64_t* out_count, bool* consumed) {
+  if (consumed) *consumed = false;
+  int64_t remaining = outer.nbytes;
+  bool first = true;
+  while (remaining > 0) {
+    MsgHeader sh{};
+    if (remaining < (int64_t)sizeof(sh))
+      FAIL(c, "corrupt coalesced frame from rank %d (%lld trailing bytes)",
+           source, (long long)remaining);
+    int rc = read_all_dl(c->socks[source], &sh, sizeof(sh));
+    if (rc) FAIL_IO(c, rc, "recv coalesced header from %d", source);
+    remaining -= sizeof(sh);
+    if (sh.comm_id != c->comm_id || sh.nbytes < 0 || sh.nbytes > remaining)
+      FAIL(c, "corrupt coalesced sub-message from rank %d (comm %d, %lld "
+           "bytes of %lld left)", source, sh.comm_id, (long long)sh.nbytes,
+           (long long)remaining);
+    if (first && consumed && buf && (tag == kAnyTag || sh.tag == tag) &&
+        sh.nbytes <= nbytes) {
+      /* pre-posted receive: land the head message straight in the user
+       * buffer instead of staging it */
+      rc = read_all_dl(c->socks[source], buf, sh.nbytes);
+      if (rc) FAIL_IO(c, rc, "recv coalesced payload from %d", source);
+      if (out_tag) *out_tag = sh.tag;
+      if (out_count) *out_count = sh.nbytes;
+      *consumed = true;
+    } else {
+      PendingMsg m;
+      m.hdr = sh;
+      m.data.resize((size_t)sh.nbytes);
+      if (sh.nbytes > 0) {
+        rc = read_all_dl(c->socks[source], m.data.data(), sh.nbytes);
+        if (rc) FAIL_IO(c, rc, "recv coalesced payload from %d", source);
+      }
+      c->pending[source].push_back(std::move(m));
+    }
+    remaining -= sh.nbytes;
+    first = false;
+  }
+  return 0;
+}
+
+/* Consume the head of c->pending[source] into a posted receive, with
+ * exactly the checks the wire path applies (order violation on a tag
+ * mismatch, truncation on a short buffer). */
+int consume_pending(Comm* c, int source, int tag, void* buf, int64_t nbytes,
+                    int32_t* out_src, int32_t* out_tag, int64_t* out_count) {
+  auto& q = c->pending[source];
+  if (q.empty())
+    FAIL(c, "internal: empty pending queue for rank %d", source);
+  PendingMsg m = std::move(q.front());
+  q.pop_front();
+  if (q.empty()) c->pending.erase(source);
+  if (tag != kAnyTag && m.hdr.tag != tag)
+    FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
+         tag, source, m.hdr.tag);
+  if (m.hdr.nbytes > nbytes)
+    FAIL(c, "message truncated: rank %d sent %lld bytes into a %lld-byte "
+         "buffer", source, (long long)m.hdr.nbytes, (long long)nbytes);
+  std::memcpy(buf, m.data.data(), (size_t)m.hdr.nbytes);
+  if (out_src) *out_src = source;
+  if (out_tag) *out_tag = m.hdr.tag;
+  if (out_count) *out_count = m.hdr.nbytes;
+  return 0;
+}
+
+/* Head of the pending queue for `source`, or null. */
+const MsgHeader* pending_head(Comm* c, int source) {
+  auto it = c->pending.find(source);
+  if (it == c->pending.end() || it->second.empty()) return nullptr;
+  return &it->second.front().hdr;
 }
 
 /* ANY_SOURCE resolution: poll every peer socket until one holds a
@@ -799,6 +937,25 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
           if (h.tag == kPoisonTag) {
             ::recv(fds[i].fd, &h, sizeof(h), MSG_DONTWAIT);  // consume hdr
             return poison_fail(c, ranks[i], h);
+          }
+          if (h.tag == kCoalescedTag && h.comm_id == c->comm_id) {
+            /* a coalesced container at the head: split it into pending
+             * (consuming the frame preserves per-channel order), then
+             * judge the wildcard on the FIRST sub-message's tag */
+            MsgHeader outer{};
+            if (read_all_dl(c->socks[ranks[i]], &outer, sizeof(outer)))
+              FAIL(c, "recv coalesced header from %d failed: %s", ranks[i],
+                   std::strerror(errno));
+            if (stage_coalesced(c, ranks[i], outer, kAnyTag, nullptr, 0,
+                                nullptr, nullptr, nullptr))
+              return 1;
+            const MsgHeader* ph = pending_head(c, ranks[i]);
+            if (ph && (tag == kAnyTag || ph->tag == tag)) {
+              *out_source = ranks[i];
+              return 0;
+            }
+            dead.push_back(i);  // staged head can never match
+            continue;
           }
           if (header_matches(c, h, tag)) {
             *out_source = ranks[i];
@@ -854,10 +1011,20 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
   if (source == kAnySource) {
     /* a queued self-message is already complete — it wins immediately,
      * but only when its header actually matches the tag filter (a
-     * mismatched self head cannot satisfy this wildcard; a peer might) */
+     * mismatched self head cannot satisfy this wildcard; a peer might).
+     * Staged coalesced sub-messages are equally complete and win next. */
+    int pending_src = -1;
+    for (const auto& kv : c->pending)
+      if (!kv.second.empty() &&
+          (tag == kAnyTag || kv.second.front().hdr.tag == tag)) {
+        pending_src = kv.first;
+        break;
+      }
     if (!c->self_q.empty() &&
         header_matches(c, c->self_q.front().first, tag)) {
       source = c->rank;
+    } else if (pending_src >= 0) {
+      source = pending_src;
     } else if (ring_p2p_on(c)) {
       ObsWaitTimer wt;  // wildcard resolution is pure arrival wait
       if (ring_poll_any(c, tag, &source)) return 1;
@@ -888,6 +1055,12 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
     if (out_count) *out_count = h.nbytes;
     return 0;
   }
+  if (pending_head(c, source))
+    /* a previously split coalesced frame already delivered this
+     * channel's next message: consume it in order, same checks as the
+     * wire path */
+    return consume_pending(c, source, tag, buf, nbytes, out_src, out_tag,
+                           out_count);
   if (ring_p2p_on(c))
     return shm_recv_status(c, source, tag, buf, nbytes, out_src, out_tag,
                            out_count);
@@ -907,6 +1080,17 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
          "is comm %d — ops on sibling communicators must run in a "
          "consistent order on both endpoints", source, h.comm_id,
          c->comm_id);
+  if (h.tag == kCoalescedTag) {
+    /* split the container: the first sub-message lands directly in this
+     * posted receive when it matches; the rest stage for later recvs */
+    bool consumed = false;
+    if (stage_coalesced(c, source, h, tag, buf, nbytes, out_tag, out_count,
+                        &consumed))
+      return 1;
+    if (consumed) return 0;
+    return consume_pending(c, source, tag, buf, nbytes, out_src, out_tag,
+                           out_count);
+  }
   if (tag != kAnyTag && h.tag != tag)
     FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
          tag, source, h.tag);
@@ -2156,6 +2340,13 @@ constexpr int64_t kCombineBlockBytes = 128 * 1024;
 int recv_combine_msg(Comm* c, int source, char* dst, std::vector<char>& tmp,
                      int64_t count, int dtype, int op) {
   fault_fire(c, g_job_rank, FP_RECV, "recv");
+  if (pending_head(c, source))
+    /* staged user messages precede this collective on the channel: the
+     * ranks disagree on the schedule (the wire path would read a user
+     * frame here and fail the same way) */
+    FAIL(c, "message order violation: collective frame expected from rank "
+         "%d but user message (tag %d) is pending", source,
+         pending_head(c, source)->tag);
   const int64_t esize = dtype_size(dtype);
   const int64_t nbytes = count * esize;
   MsgHeader h{};
@@ -2366,6 +2557,676 @@ int rd_allgather(Comm* c, const void* sendbuf, int64_t nbytes,
     if (wait_send(c, &job) || rc) return 1;
   }
   return 0;
+}
+
+/* ================= async progress engine =================
+ *
+ * One dedicated progress thread per socket-owning communicator drives
+ * a bounded lock-free (SPSC: posts are serialized by the comm lock,
+ * the progress thread is the only consumer) submission queue of op
+ * descriptors and a per-descriptor completion futex:
+ *
+ * - small sends DETACH: the payload is copied into the descriptor and
+ *   the caller returns immediately — the buffered-send semantics the
+ *   static verifier's match model (analysis/_match.py) already
+ *   assumes.  Ordering is preserved because the queue drains strictly
+ *   in posted order, exactly the serialization the comm lock gave the
+ *   inline path;
+ * - every other op posts and PARKS on its completion futex when the
+ *   queue is non-empty (an earlier op is still in flight — running it
+ *   inline would reorder the channel), and runs INLINE on the calling
+ *   thread when the engine is idle (no context-switch tax on the
+ *   latency path; bit-for-bit the historic behavior);
+ * - adjacent detached sends to the same peer coalesce into one
+ *   kCoalescedTag wire frame (threshold MPI4JAX_TPU_COALESCE_BYTES;
+ *   the receive side splits transparently, tags preserved);
+ * - deadlines are measured from POST time (g_dl_post_anchor): time
+ *   spent queued behind a wedged op counts against the job deadline,
+ *   and abort poison is consumed on the progress thread exactly as it
+ *   was inline (the bodies are the same code).
+ *
+ * MPI4JAX_TPU_PROGRESS_THREAD=0 disables the engine entirely: every
+ * op executes inline under the comm lock, the pre-engine behavior. */
+
+bool progress_thread_on() {
+  static bool v = [] {
+    const char* e = std::getenv("MPI4JAX_TPU_PROGRESS_THREAD");
+    if (!e || !e[0]) return true;
+    if (!std::strcmp(e, "0") || !std::strcmp(e, "false") ||
+        !std::strcmp(e, "off") || !std::strcmp(e, "no"))
+      return false;
+    if (!std::strcmp(e, "1") || !std::strcmp(e, "true") ||
+        !std::strcmp(e, "on") || !std::strcmp(e, "yes"))
+      return true;
+    std::fprintf(stderr,
+                 "tpucomm: cannot parse MPI4JAX_TPU_PROGRESS_THREAD=%s\n", e);
+    std::exit(2);
+  }();
+  return v;
+}
+
+int64_t parse_env_bytes(const char* name, int64_t dflt, int64_t lo,
+                        int64_t hi) {
+  const char* e = std::getenv(name);
+  if (!e || !e[0]) return dflt;
+  char* end = nullptr;
+  long long v = std::strtoll(e, &end, 10);
+  if (end == e || *end) {
+    std::fprintf(stderr, "tpucomm: cannot parse %s=%s\n", name, e);
+    std::exit(2);  // a typo'd knob must not silently change behavior
+  }
+  if (v < lo) v = lo;
+  if (v > hi) v = hi;
+  return (int64_t)v;
+}
+
+/* sends <= this coalesce when adjacent in posted order (0 = off) */
+int64_t coalesce_bytes() {
+  static int64_t v =
+      parse_env_bytes("MPI4JAX_TPU_COALESCE_BYTES", 4096, 0, 64 * 1024);
+  return v;
+}
+
+/* submission-queue capacity in descriptors */
+int64_t queue_depth() {
+  static int64_t v = [] {
+    int64_t d = parse_env_bytes("MPI4JAX_TPU_QUEUE_DEPTH", 1024, 16,
+                                1 << 16);
+    int64_t p = 16;
+    while (p < d) p <<= 1;
+    return p;
+  }();
+  return v;
+}
+
+/* sends up to this size are copied into the descriptor and detached */
+int64_t detach_threshold() {
+  static int64_t v = std::max<int64_t>(kEagerBytes, coalesce_bytes());
+  return v;
+}
+
+constexpr int kCoalesceMaxRun = 32;   // sends merged into one frame, max
+constexpr uint32_t kOpStatus = 1;     // flags: status-reporting variant
+
+struct EngineOp {
+  int32_t kind = 0;            // TpuObsOp code
+  uint32_t flags = 0;
+  Comm* comm = nullptr;
+  const void* sbuf = nullptr;
+  void* rbuf = nullptr;
+  int64_t snb = 0, rnb = 0;    // payload bytes (send / recv side)
+  int64_t count = 0;           // elements (reductions)
+  int dtype = 0, rop = 0;
+  int peer = -1, peer2 = -1;   // dest/root/lo , source/hi
+  int tag = 0, tag2 = 0;
+  int algo = TPU_COLL_AUTO;
+  int32_t* out_src = nullptr;  // status out-params (parked ops only)
+  int32_t* out_tag = nullptr;
+  int64_t* out_count = nullptr;
+  double t_post = -1;
+  bool detached = false;
+  std::vector<char> owned;     // copied payload of a detached send
+  std::atomic<int32_t> state{0};  // 0 = queued, 1 = done (futex word)
+  int rc = 0;
+};
+
+struct Engine {
+  std::vector<EngineOp*> slots;
+  uint64_t cap = 0;
+  std::atomic<uint64_t> head{0};   // produced (posting side)
+  std::atomic<uint64_t> tail{0};   // consumed (progress thread)
+  std::atomic<int32_t> hseq{0};    // futex: progress thread parks here
+  std::atomic<int32_t> tseq{0};    // futex: full-queue posters park here
+  std::atomic<int64_t> inflight{0};
+  std::atomic<int32_t> stop{0};
+  std::atomic<int32_t> sticky{0};  // a detached op failed
+  std::thread thr;
+  std::vector<char> scratch;       // coalesced frame assembly
+};
+
+/* Execute one descriptor: the op bodies, verbatim from the pre-engine
+ * public entry points, wrapped in the same ObsScope/LogScope (now fed
+ * the post timestamp so events carry the dispatch split). */
+int engine_run_body(EngineOp* o) {
+  Comm* c = o->comm;
+  const double tp = o->t_post;
+  switch (o->kind) {
+    case TPU_OBS_SEND: {
+      ObsScope obs(TPU_OBS_SEND, o->peer, o->tag, o->snb, -1, tp);
+      LogScope log(c->rank, "Send", [&] {
+        return "to " + std::to_string(o->peer) + " (" +
+               std::to_string(o->snb) + " bytes, tag " +
+               std::to_string(o->tag) + ")";
+      });
+      if (ring_p2p_on(c) && o->peer != c->rank && o->peer >= 0 &&
+          o->peer < c->size) {
+        bool inlined = false;
+        if (shm_try_send(c, o->peer, o->tag, o->sbuf, o->snb, &inlined))
+          return 1;
+        if (inlined) return 0;
+        return send_msg_tcp(c, o->peer, o->tag, o->sbuf, o->snb);
+      }
+      return send_msg(c, o->peer, o->tag, o->sbuf, o->snb);
+    }
+    case TPU_OBS_RECV: {
+      ObsScope obs(TPU_OBS_RECV, o->peer2, o->tag, o->rnb, -1, tp);
+      LogScope log(c->rank, "Recv", [&] {
+        return "from " + std::to_string(o->peer2) + " (" +
+               std::to_string(o->rnb) + " bytes, tag " +
+               std::to_string(o->tag) +
+               ((o->flags & kOpStatus) ? ", status)" : ")");
+      });
+      if (o->flags & kOpStatus)
+        return recv_msg_status(c, o->peer2, o->tag, o->rbuf, o->rnb,
+                               o->out_src, o->out_tag, o->out_count);
+      return recv_msg(c, o->peer2, o->tag, o->rbuf, o->rnb);
+    }
+    case TPU_OBS_SENDRECV: {
+      ObsScope obs(TPU_OBS_SENDRECV, o->peer, o->tag, o->snb + o->rnb, -1,
+                   tp);
+      LogScope log(c->rank, "Sendrecv", [&] {
+        return "to " + std::to_string(o->peer) + " from " +
+               std::to_string(o->peer2) +
+               ((o->flags & kOpStatus) ? " (status)" : "");
+      });
+      SendJob job;
+      if (async_send(c, &job, o->peer, o->tag, o->sbuf, o->snb)) return 1;
+      int recv_rc =
+          (o->flags & kOpStatus)
+              ? recv_msg_status(c, o->peer2, o->tag2, o->rbuf, o->rnb,
+                                o->out_src, o->out_tag, o->out_count)
+              : recv_msg(c, o->peer2, o->tag2, o->rbuf, o->rnb);
+      return wait_send(c, &job) || recv_rc;
+    }
+    case TPU_OBS_SHIFT2: {
+      ObsScope obs(TPU_OBS_SHIFT2, o->peer2, o->tag, 2 * o->snb, -1, tp);
+      LogScope log(c->rank, "Shift2", [&] {
+        return std::to_string(o->snb) + " bytes, lo " +
+               std::to_string(o->peer) + " hi " + std::to_string(o->peer2);
+      });
+      const int lo = o->peer, hi = o->peer2;
+      const int64_t strip_nbytes = o->snb;
+      const int tag = o->tag;
+      const char* in = static_cast<const char*>(o->sbuf);
+      char* out = static_cast<char*>(o->rbuf);
+      const char* to_lo = in;
+      const char* to_hi = in + strip_nbytes;
+      char* from_lo = out;
+      char* from_hi = out + strip_nbytes;
+      if (lo == c->rank && hi == c->rank) {
+        std::memcpy(from_lo, to_hi, strip_nbytes);
+        std::memcpy(from_hi, to_lo, strip_nbytes);
+        return 0;
+      }
+      SendJob jlo, jhi;
+      bool sent_lo = false, sent_hi = false;
+      if (lo >= 0) {
+        if (async_send(c, &jlo, lo, tag, to_lo, strip_nbytes)) return 1;
+        sent_lo = true;
+      } else {
+        std::memcpy(from_lo, to_hi, strip_nbytes);  // wall: passthrough
+      }
+      if (hi >= 0) {
+        if (async_send(c, &jhi, hi, tag + 1, to_hi, strip_nbytes)) {
+          if (sent_lo) wait_send(c, &jlo);
+          return 1;
+        }
+        sent_hi = true;
+      } else {
+        std::memcpy(from_hi, to_lo, strip_nbytes);
+      }
+      int rc = 0;
+      if (hi >= 0) rc |= recv_msg(c, hi, tag, from_hi, strip_nbytes);
+      if (lo >= 0) rc |= recv_msg(c, lo, tag + 1, from_lo, strip_nbytes);
+      if (sent_lo) rc |= wait_send(c, &jlo);
+      if (sent_hi) rc |= wait_send(c, &jhi);
+      return rc;
+    }
+    case TPU_OBS_BARRIER: {
+      ObsScope obs(TPU_OBS_BARRIER, -1, 0, 0, c->arena ? TPU_COLL_SHM : -1,
+                   tp);
+      LogScope log(c->rank, "Barrier", [&] { return std::string(); });
+      if (c->arena) return shm_barrier_op(c);
+      uint8_t token = 1;
+      for (int dist = 1; dist < c->size; dist *= 2) {
+        int dest = (c->rank + dist) % c->size;
+        int src = (c->rank - dist + c->size) % c->size;
+        uint8_t got = 0;
+        SendJob job;
+        if (async_send(c, &job, dest, kCollectiveTag, &token, 1)) return 1;
+        int recv_rc = recv_msg(c, src, kCollectiveTag, &got, 1);
+        if (wait_send(c, &job) || recv_rc) return 1;
+      }
+      return 0;
+    }
+    case TPU_OBS_BCAST: {
+      ObsScope obs(TPU_OBS_BCAST, o->peer, 0, o->rnb,
+                   c->arena ? TPU_COLL_SHM : -1, tp);
+      LogScope log(c->rank, "Bcast", [&] {
+        return std::to_string(o->rnb) + " bytes, root " +
+               std::to_string(o->peer);
+      });
+      if (c->arena) return shm_bcast(c, o->rbuf, o->rnb, o->peer);
+      return bcast_internal(c, o->rbuf, o->rnb, o->peer);
+    }
+    case TPU_OBS_GATHER: {
+      ObsScope obs(TPU_OBS_GATHER, o->peer, 0, o->snb,
+                   c->arena ? TPU_COLL_SHM : -1, tp);
+      LogScope log(c->rank, "Gather", [&] {
+        return std::to_string(o->snb) + " bytes, root " +
+               std::to_string(o->peer);
+      });
+      const int root = o->peer;
+      if (c->arena)
+        return shm_allgather(c, o->sbuf, o->snb, o->rbuf, root, false);
+      if (c->rank == root) {
+        char* out = static_cast<char*>(o->rbuf);
+        std::memcpy(out + (int64_t)root * o->snb, o->sbuf, o->snb);
+        for (int r = 0; r < c->size; r++) {
+          if (r == root) continue;
+          if (recv_msg(c, r, kCollectiveTag, out + (int64_t)r * o->snb,
+                       o->snb))
+            return 1;
+        }
+        return 0;
+      }
+      return send_msg(c, root, kCollectiveTag, o->sbuf, o->snb);
+    }
+    case TPU_OBS_SCATTER: {
+      ObsScope obs(TPU_OBS_SCATTER, o->peer, 0, o->rnb,
+                   c->arena ? TPU_COLL_SHM : -1, tp);
+      LogScope log(c->rank, "Scatter", [&] {
+        return std::to_string(o->rnb) + " bytes, root " +
+               std::to_string(o->peer);
+      });
+      const int root = o->peer;
+      if (c->arena) return shm_scatter(c, o->sbuf, o->rbuf, o->rnb, root);
+      if (c->rank == root) {
+        const char* in = static_cast<const char*>(o->sbuf);
+        std::memcpy(o->rbuf, in + (int64_t)root * o->rnb, o->rnb);
+        for (int r = 0; r < c->size; r++) {
+          if (r == root) continue;
+          if (send_msg(c, r, kCollectiveTag, in + (int64_t)r * o->rnb,
+                       o->rnb))
+            return 1;
+        }
+        return 0;
+      }
+      return recv_msg(c, root, kCollectiveTag, o->rbuf, o->rnb);
+    }
+    case TPU_OBS_ALLGATHER: {
+      int chosen =
+          resolve_coll_algo(c, TPU_OPKIND_ALLGATHER, o->snb, 0, o->algo);
+      ObsScope obs(TPU_OBS_ALLGATHER, -1, 0, o->snb, chosen, tp);
+      LogScope log(c->rank, "Allgather", [&] {
+        return std::to_string(o->snb) + " bytes algo " +
+               coll_algo_name(chosen);
+      });
+      if (chosen == TPU_COLL_SHM)
+        return shm_allgather(c, o->sbuf, o->snb, o->rbuf, 0, true);
+      switch (chosen) {
+        case TPU_COLL_TREE:
+          return tree_allgather(c, o->sbuf, o->snb, o->rbuf);
+        case TPU_COLL_RD:
+          return rd_allgather(c, o->sbuf, o->snb, o->rbuf);
+        default:
+          return ring_allgather(c, o->sbuf, o->snb, o->rbuf);
+      }
+    }
+    case TPU_OBS_ALLTOALL: {
+      const int64_t chunk = o->snb;
+      ObsScope obs(TPU_OBS_ALLTOALL, -1, 0, chunk * c->size,
+                   c->arena ? TPU_COLL_SHM : -1, tp);
+      LogScope log(c->rank, "Alltoall",
+                   [&] { return std::to_string(chunk) + " bytes/chunk"; });
+      if (c->arena) return shm_alltoall(c, o->sbuf, o->rbuf, chunk);
+      const char* in = static_cast<const char*>(o->sbuf);
+      char* out = static_cast<char*>(o->rbuf);
+      std::memcpy(out + (int64_t)c->rank * chunk,
+                  in + (int64_t)c->rank * chunk, chunk);
+      for (int round = 1; round < c->size; round++) {
+        int dest = (c->rank + round) % c->size;
+        int src = (c->rank - round + c->size) % c->size;
+        SendJob job;
+        if (async_send(c, &job, dest, kCollectiveTag,
+                       in + (int64_t)dest * chunk, chunk))
+          return 1;
+        int recv_rc = recv_msg(c, src, kCollectiveTag,
+                               out + (int64_t)src * chunk, chunk);
+        if (wait_send(c, &job) || recv_rc) return 1;
+      }
+      return 0;
+    }
+    case TPU_OBS_ALLREDUCE: {
+      int64_t esize = dtype_size(o->dtype);
+      if (esize == 0) FAIL(c, "bad dtype %d", o->dtype);
+      int64_t nbytes = o->count * esize;
+      int chosen = resolve_coll_algo(c, TPU_OPKIND_ALLREDUCE, nbytes,
+                                     o->count, o->algo);
+      ObsScope obs(TPU_OBS_ALLREDUCE, -1, 0, nbytes, chosen, tp);
+      LogScope log(c->rank, "Allreduce", [&] {
+        return std::to_string(o->count) + " elems dtype " +
+               std::to_string(o->dtype) + " op " + std::to_string(o->rop) +
+               " algo " + coll_algo_name(chosen);
+      });
+      if (c->size == 1) {
+        if (o->rbuf != o->sbuf) std::memcpy(o->rbuf, o->sbuf, nbytes);
+        return 0;
+      }
+      if (chosen == TPU_COLL_SHM)
+        return shm_allreduce_like(c, o->sbuf, o->rbuf, o->count, o->dtype,
+                                  o->rop, 0, true);
+      if (o->rbuf != o->sbuf) std::memcpy(o->rbuf, o->sbuf, nbytes);
+      switch (chosen) {
+        case TPU_COLL_RING:
+          return ring_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
+        case TPU_COLL_RD:
+          return rd_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
+        default:
+          return tree_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
+      }
+    }
+    case TPU_OBS_REDUCE: {
+      int64_t esize = dtype_size(o->dtype);
+      ObsScope obs(TPU_OBS_REDUCE, o->peer, 0, o->count * esize,
+                   c->arena && c->size > 1 ? TPU_COLL_SHM : -1, tp);
+      LogScope log(c->rank, "Reduce", [&] {
+        return std::to_string(o->count) + " elems, root " +
+               std::to_string(o->peer);
+      });
+      if (esize == 0) FAIL(c, "bad dtype %d", o->dtype);
+      const int root = o->peer;
+      if (c->arena && c->size > 1) {
+        if (c->rank != root && o->rbuf != o->sbuf)
+          std::memcpy(o->rbuf, o->sbuf, o->count * esize);
+        return shm_allreduce_like(c, o->sbuf, o->rbuf, o->count, o->dtype,
+                                  o->rop, root, false);
+      }
+      int64_t nbytes = o->count * esize;
+      if (c->rank == root) {
+        if (o->rbuf != o->sbuf) std::memcpy(o->rbuf, o->sbuf, nbytes);
+        std::vector<char> tmp(nbytes);
+        for (int r = 0; r < c->size; r++) {
+          if (r == root) continue;
+          if (recv_msg(c, r, kCollectiveTag, tmp.data(), nbytes)) return 1;
+          if (combine(o->rbuf, tmp.data(), o->count, o->dtype, o->rop, c))
+            return 1;
+        }
+        return 0;
+      }
+      if (o->rbuf != o->sbuf) std::memcpy(o->rbuf, o->sbuf, nbytes);
+      return send_msg(c, root, kCollectiveTag, o->rbuf, nbytes);
+    }
+    case TPU_OBS_SCAN: {
+      int64_t esize = dtype_size(o->dtype);
+      ObsScope obs(TPU_OBS_SCAN, -1, 0, o->count * esize,
+                   c->arena && c->size > 1 ? TPU_COLL_SHM : -1, tp);
+      LogScope log(c->rank, "Scan",
+                   [&] { return std::to_string(o->count) + " elems"; });
+      if (esize == 0) FAIL(c, "bad dtype %d", o->dtype);
+      if (c->arena && c->size > 1)
+        return shm_scan(c, o->sbuf, o->rbuf, o->count, o->dtype, o->rop);
+      int64_t nbytes = o->count * esize;
+      if (o->rbuf != o->sbuf) std::memcpy(o->rbuf, o->sbuf, nbytes);
+      if (c->rank > 0) {
+        std::vector<char> tmp(nbytes);
+        if (recv_msg(c, c->rank - 1, kCollectiveTag, tmp.data(), nbytes))
+          return 1;
+        std::vector<char> mine(nbytes);
+        std::memcpy(mine.data(), o->rbuf, nbytes);
+        std::memcpy(o->rbuf, tmp.data(), nbytes);
+        if (combine(o->rbuf, mine.data(), o->count, o->dtype, o->rop, c))
+          return 1;
+      }
+      if (c->rank < c->size - 1) {
+        if (send_msg(c, c->rank + 1, kCollectiveTag, o->rbuf, nbytes))
+          return 1;
+      }
+      return 0;
+    }
+    default:
+      FAIL(c, "unknown engine op kind %d", o->kind);
+  }
+}
+
+/* True when this descriptor may merge into a coalesced frame. */
+bool coalescible(const EngineOp* o) {
+  return o->kind == TPU_OBS_SEND && o->detached && coalesce_bytes() > 0 &&
+         o->snb <= coalesce_bytes() && o->peer != o->comm->rank &&
+         o->peer >= 0 && o->peer < o->comm->size &&
+         !ring_p2p_on(o->comm) && o->comm->socks[o->peer] >= 0;
+}
+
+/* Write a run of adjacent detached sends (same comm, same peer) as ONE
+ * kCoalescedTag frame.  Tags and sizes ride as per-message sub-headers;
+ * the receive side splits them back apart.  Returns the shared rc. */
+int engine_write_coalesced(Engine* e, EngineOp** ops, int n) {
+  Comm* c = ops[0]->comm;
+  const int dest = ops[0]->peer;
+  int64_t total = 0;
+  for (int i = 0; i < n; i++) total += (int64_t)sizeof(MsgHeader) + ops[i]->snb;
+  e->scratch.resize((size_t)total);
+  char* p = e->scratch.data();
+  for (int i = 0; i < n; i++) {
+    /* one injector hit per LOGICAL send: MPI4JAX_TPU_FAULT's after=N
+     * counts user sends, not wire frames, so a fault lands at the same
+     * op index with coalescing on or off */
+    fault_fire(c, g_job_rank, FP_SEND, "send");
+    MsgHeader sh{ops[i]->snb, ops[i]->tag, c->comm_id};
+    std::memcpy(p, &sh, sizeof(sh));
+    p += sizeof(sh);
+    std::memcpy(p, ops[i]->sbuf, (size_t)ops[i]->snb);
+    p += ops[i]->snb;
+  }
+  LogScope log(c->rank, "SendCoalesced", [&] {
+    return "to " + std::to_string(dest) + " (" + std::to_string(n) +
+           " msgs, " + std::to_string(total) + " bytes)";
+  });
+  g_dl_post_anchor = ops[0]->t_post;
+  double tw0 = now_s();
+  MsgHeader outer{total, kCoalescedTag, c->comm_id};
+  int fd = c->socks[dest];
+  int io = write_all_dl(fd, &outer, sizeof(outer));
+  if (!io) io = write_all_dl(fd, e->scratch.data(), total);
+  g_dl_post_anchor = 0;
+  int rc = 0;
+  if (io) {
+    char why[160];
+    if (io == 2)
+      std::snprintf(why, sizeof(why),
+                    "timed out after %.0f s with %lld/%lld bytes moved "
+                    "(MPI4JAX_TPU_TIMEOUT_S)",
+                    transport_timeout_s(), (long long)g_io_done,
+                    (long long)g_io_want);
+    else
+      std::snprintf(why, sizeof(why), "%s", std::strerror(errno));
+    std::fprintf(stderr,
+                 "tpucomm r%d: coalesced send to %d (%d msgs) failed: %s\n",
+                 c->rank, dest, n, why);
+    set_last_error(c->rank, "coalesced send to %d failed: %s", dest, why);
+    rc = 1;
+  }
+  if (g_obs_on.load(std::memory_order_relaxed)) {
+    double tw1 = now_s();
+    for (int i = 0; i < n; i++) {
+      TpuObsEvent ev{};
+      ev.op = TPU_OBS_SEND;
+      ev.peer = dest;
+      ev.tag = ops[i]->tag;
+      ev.nbytes = ops[i]->snb;
+      ev.algo = -1;
+      ev.t_start = ops[i]->t_post;
+      ev.dur_s = tw1 - ops[i]->t_post;
+      ev.queue_s = tw0 - ops[i]->t_post;
+      if (ev.queue_s < 0) ev.queue_s = 0;
+      if (ev.queue_s > ev.dur_s) ev.queue_s = ev.dur_s;
+      obs_append(ev);
+    }
+  }
+  return rc;
+}
+
+void engine_loop(Comm* root) {
+  Engine* e = root->engine;
+  for (;;) {
+    uint64_t t = e->tail.load(std::memory_order_relaxed);
+    uint64_t h = e->head.load(std::memory_order_acquire);
+    if (h == t) {
+      if (e->stop.load(std::memory_order_acquire)) return;
+      int32_t seq = e->hseq.load(std::memory_order_acquire);
+      if (e->head.load(std::memory_order_acquire) != t) continue;
+      shm_futex_wait(&e->hseq, seq, 100);
+      continue;
+    }
+    EngineOp* op = e->slots[t % e->cap];
+    int run = 1;
+    if (coalescible(op)) {
+      while (t + run < h && run < kCoalesceMaxRun) {
+        EngineOp* nxt = e->slots[(t + run) % e->cap];
+        if (!coalescible(nxt) || nxt->comm != op->comm ||
+            nxt->peer != op->peer)
+          break;
+        run++;
+      }
+    }
+    if (run > 1) {
+      EngineOp* batch[kCoalesceMaxRun];
+      for (int i = 0; i < run; i++) batch[i] = e->slots[(t + i) % e->cap];
+      int rc = engine_write_coalesced(e, batch, run);
+      e->tail.store(t + run, std::memory_order_release);
+      e->tseq.fetch_add(1, std::memory_order_release);
+      shm_futex_wake_all(&e->tseq);
+      e->inflight.fetch_sub(run, std::memory_order_release);
+      if (rc) e->sticky.store(1, std::memory_order_release);
+      for (int i = 0; i < run; i++) delete batch[i];
+      continue;
+    }
+    g_dl_post_anchor = op->t_post;
+    op->rc = engine_run_body(op);
+    g_dl_post_anchor = 0;
+    e->tail.store(t + 1, std::memory_order_release);
+    e->tseq.fetch_add(1, std::memory_order_release);
+    shm_futex_wake_all(&e->tseq);
+    e->inflight.fetch_sub(1, std::memory_order_release);
+    if (op->detached) {
+      if (op->rc) e->sticky.store(1, std::memory_order_release);
+      delete op;
+    } else {
+      /* the waiter owns the descriptor and may destroy it the moment
+       * it observes state == 1.  The wake AFTER the store is still
+       * safe: FUTEX_WAKE keys on the address only (never dereferences
+       * it) — the standard condvar-internal idiom — and the waiter's
+       * futex wait is timed (100 ms), so even a wake landing on a
+       * recycled stack address costs at most one spurious wakeup. */
+      op->state.store(1, std::memory_order_release);
+      shm_futex_wake_all(&op->state);
+    }
+  }
+}
+
+/* Post under the comm lock; the queue itself is lock-free SPSC. */
+void engine_post(Comm* root, EngineOp* op) {
+  Engine* e = root->engine;
+  if (e == nullptr) {
+    e = new Engine;
+    e->cap = (uint64_t)queue_depth();
+    e->slots.assign((size_t)e->cap, nullptr);
+    root->engine = e;  // published before the thread starts
+    e->thr = std::thread(engine_loop, root);
+  }
+  uint64_t h = e->head.load(std::memory_order_relaxed);
+  while (h - e->tail.load(std::memory_order_acquire) >= e->cap) {
+    /* bounded queue: park for space (backpressure, not allocation) */
+    int32_t seq = e->tseq.load(std::memory_order_acquire);
+    if (h - e->tail.load(std::memory_order_acquire) < e->cap) break;
+    shm_futex_wait(&e->tseq, seq, 100);
+  }
+  e->slots[h % e->cap] = op;
+  e->inflight.fetch_add(1, std::memory_order_release);
+  e->head.store(h + 1, std::memory_order_release);
+  e->hseq.fetch_add(1, std::memory_order_release);
+  shm_futex_wake_all(&e->hseq);
+}
+
+/* Wait (under the comm lock) until the progress thread has drained and
+ * completed everything posted so far.  Required before any direct
+ * socket I/O outside the engine (split's arena bootstrap). */
+void engine_quiesce(Comm* root) {
+  Engine* e = root->engine;
+  if (!e) return;
+  while (e->inflight.load(std::memory_order_acquire) > 0) {
+    int32_t seq = e->tseq.load(std::memory_order_acquire);
+    if (e->inflight.load(std::memory_order_acquire) <= 0) break;
+    shm_futex_wait(&e->tseq, seq, 50);
+  }
+}
+
+/* The single entry point every public op goes through.  Holds the comm
+ * lock for the duration of an INLINE op (the historic exclusivity), or
+ * only for the post + park of a queued one (the progress thread never
+ * takes the lock — queue order is the serialization). */
+int engine_submit(Comm* c, EngineOp* op) {
+  op->comm = c;
+  Comm* root = c->lock_root;
+  std::lock_guard<std::mutex> lock(comm_mu(c));
+  Engine* e = root->engine;
+  if (e && e->sticky.load(std::memory_order_acquire))
+    FAIL(c, "an earlier asynchronously posted send failed — see the "
+         "diagnostic above (async progress engine)");
+  const bool engine_on = progress_thread_on();
+  const bool detach = engine_on && op->kind == TPU_OBS_SEND &&
+                      op->snb <= detach_threshold() && op->peer >= 0 &&
+                      op->peer < c->size;
+  const bool busy =
+      e && e->inflight.load(std::memory_order_acquire) > 0;
+  if (!engine_on || (!detach && !busy)) {
+    /* idle engine (or engine off): run inline on this thread — no
+     * context switch on the latency path, bit-for-bit the historic
+     * behavior */
+    op->t_post = g_obs_on.load(std::memory_order_relaxed) ? now_s() : -1;
+    return engine_run_body(op);
+  }
+  op->t_post = now_s();
+  if (detach) {
+    auto* hop = new EngineOp;
+    hop->kind = op->kind;
+    hop->flags = op->flags;
+    hop->comm = c;
+    hop->snb = op->snb;
+    hop->peer = op->peer;
+    hop->tag = op->tag;
+    hop->t_post = op->t_post;
+    hop->detached = true;
+    const char* src = static_cast<const char*>(op->sbuf);
+    hop->owned.assign(src, src + op->snb);
+    hop->sbuf = hop->owned.data();
+    engine_post(root, hop);
+    return 0;  // buffered-send semantics: completion is asynchronous
+  }
+  engine_post(root, op);
+  while (op->state.load(std::memory_order_acquire) == 0)
+    shm_futex_wait(&op->state, 0, 100);
+  return op->rc;
+}
+
+/* Drain the queue (the loop finishes everything posted before stop is
+ * observed with an empty queue), join the thread, free the engine.
+ * Declared near the top: Comm's destructor and finalize call it. */
+void engine_shutdown(Engine* e) {
+  e->stop.store(1, std::memory_order_release);
+  e->hseq.fetch_add(1, std::memory_order_release);
+  shm_futex_wake_all(&e->hseq);
+  if (e->thr.joinable()) e->thr.join();
+  if (e->sticky.load(std::memory_order_acquire))
+    /* a detached send failed and no later op surfaced it (each failure
+     * already printed its own diagnostic at the moment it happened):
+     * say so once more at teardown so a job whose LAST op was the
+     * failing buffered send cannot drain silently */
+    std::fprintf(stderr,
+                 "tpucomm: asynchronously posted send(s) failed before "
+                 "finalize; data may be undelivered (see diagnostics "
+                 "above)\n");
+  delete e;
 }
 
 }  // namespace
@@ -2597,10 +3458,29 @@ void tpucomm_finalize(int64_t h) {
   std::lock_guard<std::mutex> lock(g_comms_mu);
   auto it = g_comms.find(h);
   if (it == g_comms.end()) return;
-  if (it->second->owns_socks)
-    for (int fd : it->second->socks)
+  Comm* c = it->second;
+  /* drain the progress engine BEFORE closing sockets or freeing the
+   * comm: detached sends still in the queue must reach the wire (the
+   * buffered-send flush MPI_Finalize performs).  A split/dup comm's
+   * descriptors live on the socket owner's engine — quiesce it, or a
+   * queued send would dereference this comm after the delete below. */
+  if (c->engine) {
+    engine_shutdown(c->engine);
+    c->engine = nullptr;
+  } else if (c->lock_root != c) {
+    /* the parent may itself have been finalized already (legal call
+     * order before the engine existed): only touch lock_root while it
+     * is still registered — we hold g_comms_mu, so this is race-free */
+    for (const auto& kv : g_comms)
+      if (kv.second == c->lock_root) {
+        if (kv.second->engine) engine_quiesce(kv.second);
+        break;
+      }
+  }
+  if (c->owns_socks)
+    for (int fd : c->socks)
       if (fd >= 0) ::close(fd);
-  delete it->second;
+  delete c;
   g_comms.erase(it);
 }
 
@@ -2664,6 +3544,10 @@ int64_t tpucomm_split(int64_t h, int color, int key) {
   nc->shm_prefix = c->shm_prefix;
   if (c->arena) {
     std::lock_guard<std::mutex> lock(comm_mu(nc));
+    /* arena bootstrap writes the shared sockets directly (nonce bcast):
+     * the progress thread must be idle first — two writers on one
+     * socket would interleave frames */
+    engine_quiesce(nc->lock_root);
     arena_init(nc);
   }
 
@@ -2711,30 +3595,25 @@ int tpucomm_send(int64_t h, const void* buf, int64_t nbytes, int dest,
                  int tag) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_SEND, dest, tag, nbytes);
-  LogScope log(c->rank, "Send",
-               [&] { return "to " + std::to_string(dest) + " (" + std::to_string(nbytes) +
-                   " bytes, tag " + std::to_string(tag) + ")"; });
-  if (ring_p2p_on(c) && dest != c->rank && dest >= 0 && dest < c->size) {
-    bool inlined = false;
-    if (shm_try_send(c, dest, tag, buf, nbytes, &inlined)) return 1;
-    if (inlined) return 0;
-    return send_msg_tcp(c, dest, tag, buf, nbytes);  // stub's payload
-  }
-  return send_msg(c, dest, tag, buf, nbytes);
+  EngineOp op;
+  op.kind = TPU_OBS_SEND;
+  op.sbuf = buf;
+  op.snb = nbytes;
+  op.peer = dest;
+  op.tag = tag;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_RECV, source, tag, nbytes);
-  LogScope log(c->rank, "Recv",
-               [&] { return "from " + std::to_string(source) + " (" +
-                   std::to_string(nbytes) + " bytes, tag " +
-                   std::to_string(tag) + ")"; });
-  return recv_msg(c, source, tag, buf, nbytes);
+  EngineOp op;
+  op.kind = TPU_OBS_RECV;
+  op.rbuf = buf;
+  op.rnb = nbytes;
+  op.peer2 = source;
+  op.tag = tag;
+  return engine_submit(c, &op);
 }
 
 const char* tpucomm_last_error(void) {
@@ -2783,14 +3662,17 @@ int tpucomm_recv_status(int64_t h, void* buf, int64_t nbytes, int source,
                         int64_t* out_count) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_RECV, source, tag, nbytes);
-  LogScope log(c->rank, "Recv",
-               [&] { return "from " + std::to_string(source) + " (" +
-                   std::to_string(nbytes) + " bytes, tag " +
-                   std::to_string(tag) + ", status)"; });
-  return recv_msg_status(c, source, tag, buf, nbytes, out_src, out_tag,
-                         out_count);
+  EngineOp op;
+  op.kind = TPU_OBS_RECV;
+  op.flags = kOpStatus;
+  op.rbuf = buf;
+  op.rnb = nbytes;
+  op.peer2 = source;
+  op.tag = tag;
+  op.out_src = out_src;
+  op.out_tag = out_tag;
+  op.out_count = out_count;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_sendrecv_status(int64_t h, const void* sendbuf,
@@ -2800,16 +3682,21 @@ int tpucomm_sendrecv_status(int64_t h, const void* sendbuf,
                             int64_t* out_count) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_SENDRECV, dest, sendtag, send_nbytes + recv_nbytes);
-  LogScope log(c->rank, "Sendrecv",
-               [&] { return "to " + std::to_string(dest) + " from " +
-                   std::to_string(source) + " (status)"; });
-  SendJob job;
-  if (async_send(c, &job, dest, sendtag, sendbuf, send_nbytes)) return 1;
-  int recv_rc = recv_msg_status(c, source, recvtag, recvbuf, recv_nbytes,
-                                out_src, out_tag, out_count);
-  return wait_send(c, &job) || recv_rc;
+  EngineOp op;
+  op.kind = TPU_OBS_SENDRECV;
+  op.flags = kOpStatus;
+  op.sbuf = sendbuf;
+  op.snb = send_nbytes;
+  op.peer = dest;
+  op.rbuf = recvbuf;
+  op.rnb = recv_nbytes;
+  op.peer2 = source;
+  op.tag = sendtag;
+  op.tag2 = recvtag;
+  op.out_src = out_src;
+  op.out_tag = out_tag;
+  op.out_count = out_count;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
@@ -2817,17 +3704,17 @@ int tpucomm_sendrecv(int64_t h, const void* sendbuf, int64_t send_nbytes,
                      int tag) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_SENDRECV, dest, tag, send_nbytes + recv_nbytes);
-  LogScope log(c->rank, "Sendrecv",
-               [&] { return "to " + std::to_string(dest) + " from " +
-                   std::to_string(source); });
-  /* concurrent send (persistent writer) avoids head-of-line deadlock for
-   * large payloads when both directions target the same pair */
-  SendJob job;
-  if (async_send(c, &job, dest, tag, sendbuf, send_nbytes)) return 1;
-  int recv_rc = recv_msg(c, source, tag, recvbuf, recv_nbytes);
-  return wait_send(c, &job) || recv_rc;
+  EngineOp op;
+  op.kind = TPU_OBS_SENDRECV;
+  op.sbuf = sendbuf;
+  op.snb = send_nbytes;
+  op.peer = dest;
+  op.rbuf = recvbuf;
+  op.rnb = recv_nbytes;
+  op.peer2 = source;
+  op.tag = tag;
+  op.tag2 = tag;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_shift2(int64_t h, const void* sendbuf, void* recvbuf,
@@ -2844,158 +3731,73 @@ int tpucomm_shift2(int64_t h, const void* sendbuf, void* recvbuf,
    * unambiguous even when both neighbors are one peer (ring of 2). */
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_SHIFT2, hi, tag, 2 * strip_nbytes);
-  LogScope log(c->rank, "Shift2",
-               [&] { return std::to_string(strip_nbytes) + " bytes, lo " +
-                            std::to_string(lo) + " hi " +
-                            std::to_string(hi); });
-  const char* in = static_cast<const char*>(sendbuf);
-  char* out = static_cast<char*>(recvbuf);
-  const char* to_lo = in;
-  const char* to_hi = in + strip_nbytes;
-  char* from_lo = out;
-  char* from_hi = out + strip_nbytes;
-  if (lo == c->rank && hi == c->rank) {
-    /* self-wrap: my to_hi strip wraps to my low side and vice versa */
-    std::memcpy(from_lo, to_hi, strip_nbytes);
-    std::memcpy(from_hi, to_lo, strip_nbytes);
-    return 0;
-  }
-  SendJob jlo, jhi;
-  bool sent_lo = false, sent_hi = false;
-  if (lo >= 0) {
-    if (async_send(c, &jlo, lo, tag, to_lo, strip_nbytes)) return 1;
-    sent_lo = true;
-  } else {
-    std::memcpy(from_lo, to_hi, strip_nbytes);  // wall: passthrough
-  }
-  if (hi >= 0) {
-    if (async_send(c, &jhi, hi, tag + 1, to_hi, strip_nbytes)) {
-      // the first send may already be queued: it must complete before
-      // jlo (stack) and the caller's buffer go away
-      if (sent_lo) wait_send(c, &jlo);
-      return 1;
-    }
-    sent_hi = true;
-  } else {
-    std::memcpy(from_hi, to_lo, strip_nbytes);
-  }
-  int rc = 0;
-  /* from_hi carries the hi neighbor's to-LO frame (tag), from_lo the lo
-   * neighbor's to-HI frame (tag+1).  A mixed self/other neighbor pair
-   * cannot arise on a 1-D ring (self-wrap means size 1 = both). */
-  if (hi >= 0) rc |= recv_msg(c, hi, tag, from_hi, strip_nbytes);
-  if (lo >= 0) rc |= recv_msg(c, lo, tag + 1, from_lo, strip_nbytes);
-  if (sent_lo) rc |= wait_send(c, &jlo);
-  if (sent_hi) rc |= wait_send(c, &jhi);
-  return rc;
+  EngineOp op;
+  op.kind = TPU_OBS_SHIFT2;
+  op.sbuf = sendbuf;
+  op.rbuf = recvbuf;
+  op.snb = strip_nbytes;
+  op.peer = lo;
+  op.peer2 = hi;
+  op.tag = tag;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_barrier(int64_t h) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_BARRIER, -1, 0, 0,
-               c->arena ? TPU_COLL_SHM : -1);
-  LogScope log(c->rank, "Barrier",
-               [&] { return std::string(); });
-  if (c->arena) return shm_barrier_op(c);
-  /* dissemination barrier: log2(size) rounds of token exchange */
-  uint8_t token = 1;
-  for (int dist = 1; dist < c->size; dist *= 2) {
-    int dest = (c->rank + dist) % c->size;
-    int src = (c->rank - dist + c->size) % c->size;
-    uint8_t got = 0;
-    SendJob job;
-    if (async_send(c, &job, dest, kCollectiveTag, &token, 1)) return 1;
-    int recv_rc = recv_msg(c, src, kCollectiveTag, &got, 1);
-    if (wait_send(c, &job) || recv_rc) return 1;
-  }
-  return 0;
+  EngineOp op;
+  op.kind = TPU_OBS_BARRIER;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_bcast(int64_t h, void* buf, int64_t nbytes, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_BCAST, root, 0, nbytes,
-               c->arena ? TPU_COLL_SHM : -1);
-  LogScope log(c->rank, "Bcast",
-               [&] { return std::to_string(nbytes) + " bytes, root " +
-                                     std::to_string(root); });
-  if (c->arena) return shm_bcast(c, buf, nbytes, root);
-  return bcast_internal(c, buf, nbytes, root);
+  EngineOp op;
+  op.kind = TPU_OBS_BCAST;
+  op.rbuf = buf;
+  op.rnb = nbytes;
+  op.peer = root;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_gather(int64_t h, const void* sendbuf, int64_t nbytes,
                    void* recvbuf, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_GATHER, root, 0, nbytes,
-               c->arena ? TPU_COLL_SHM : -1);
-  LogScope log(c->rank, "Gather",
-               [&] { return std::to_string(nbytes) + " bytes, root " +
-                                      std::to_string(root); });
-  if (c->arena) return shm_allgather(c, sendbuf, nbytes, recvbuf, root, false);
-  if (c->rank == root) {
-    char* out = static_cast<char*>(recvbuf);
-    std::memcpy(out + (int64_t)root * nbytes, sendbuf, nbytes);
-    for (int r = 0; r < c->size; r++) {
-      if (r == root) continue;
-      if (recv_msg(c, r, kCollectiveTag, out + (int64_t)r * nbytes, nbytes))
-        return 1;
-    }
-    return 0;
-  }
-  return send_msg(c, root, kCollectiveTag, sendbuf, nbytes);
+  EngineOp op;
+  op.kind = TPU_OBS_GATHER;
+  op.sbuf = sendbuf;
+  op.snb = nbytes;
+  op.rbuf = recvbuf;
+  op.peer = root;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_scatter(int64_t h, const void* sendbuf, void* recvbuf,
                     int64_t nbytes, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_SCATTER, root, 0, nbytes,
-               c->arena ? TPU_COLL_SHM : -1);
-  LogScope log(c->rank, "Scatter",
-               [&] { return std::to_string(nbytes) + " bytes, root " +
-                                       std::to_string(root); });
-  if (c->arena) return shm_scatter(c, sendbuf, recvbuf, nbytes, root);
-  if (c->rank == root) {
-    const char* in = static_cast<const char*>(sendbuf);
-    std::memcpy(recvbuf, in + (int64_t)root * nbytes, nbytes);
-    for (int r = 0; r < c->size; r++) {
-      if (r == root) continue;
-      if (send_msg(c, r, kCollectiveTag, in + (int64_t)r * nbytes, nbytes))
-        return 1;
-    }
-    return 0;
-  }
-  return recv_msg(c, root, kCollectiveTag, recvbuf, nbytes);
+  EngineOp op;
+  op.kind = TPU_OBS_SCATTER;
+  op.sbuf = sendbuf;
+  op.rbuf = recvbuf;
+  op.rnb = nbytes;
+  op.peer = root;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_allgather_algo(int64_t h, const void* sendbuf, int64_t nbytes,
                            void* recvbuf, int algo) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  int chosen = resolve_coll_algo(c, TPU_OPKIND_ALLGATHER, nbytes, 0, algo);
-  ObsScope obs(TPU_OBS_ALLGATHER, -1, 0, nbytes, chosen);
-  LogScope log(c->rank, "Allgather",
-               [&] { return std::to_string(nbytes) + " bytes algo " +
-                   coll_algo_name(chosen); });
-  if (chosen == TPU_COLL_SHM)
-    return shm_allgather(c, sendbuf, nbytes, recvbuf, 0, true);
-  switch (chosen) {
-    case TPU_COLL_TREE:
-      return tree_allgather(c, sendbuf, nbytes, recvbuf);
-    case TPU_COLL_RD:
-      return rd_allgather(c, sendbuf, nbytes, recvbuf);
-    default:
-      return ring_allgather(c, sendbuf, nbytes, recvbuf);
-  }
+  EngineOp op;
+  op.kind = TPU_OBS_ALLGATHER;
+  op.sbuf = sendbuf;
+  op.snb = nbytes;
+  op.rbuf = recvbuf;
+  op.algo = algo;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_allgather(int64_t h, const void* sendbuf, int64_t nbytes,
@@ -3007,65 +3809,27 @@ int tpucomm_alltoall(int64_t h, const void* sendbuf, void* recvbuf,
                      int64_t chunk) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  ObsScope obs(TPU_OBS_ALLTOALL, -1, 0, chunk * c->size,
-               c->arena ? TPU_COLL_SHM : -1);
-  LogScope log(c->rank, "Alltoall",
-               [&] { return std::to_string(chunk) + " bytes/chunk"; });
-  if (c->arena) return shm_alltoall(c, sendbuf, recvbuf, chunk);
-  const char* in = static_cast<const char*>(sendbuf);
-  char* out = static_cast<char*>(recvbuf);
-  std::memcpy(out + (int64_t)c->rank * chunk, in + (int64_t)c->rank * chunk,
-              chunk);
-  /* size-1 rounds of pairwise exchange with rotating partners */
-  for (int round = 1; round < c->size; round++) {
-    int dest = (c->rank + round) % c->size;
-    int src = (c->rank - round + c->size) % c->size;
-    SendJob job;
-    if (async_send(c, &job, dest, kCollectiveTag,
-                   in + (int64_t)dest * chunk, chunk))
-      return 1;
-    int recv_rc =
-        recv_msg(c, src, kCollectiveTag, out + (int64_t)src * chunk, chunk);
-    if (wait_send(c, &job) || recv_rc) return 1;
-  }
-  return 0;
+  EngineOp op;
+  op.kind = TPU_OBS_ALLTOALL;
+  op.sbuf = sendbuf;
+  op.rbuf = recvbuf;
+  op.snb = chunk;
+  return engine_submit(c, &op);
 }
 
 int tpucomm_allreduce_algo(int64_t h, const void* sendbuf, void* recvbuf,
                            int64_t count, int dtype, int op, int algo) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  int64_t esize = dtype_size(dtype);
-  if (esize == 0) FAIL(c, "bad dtype %d", dtype);
-  int64_t nbytes = count * esize;
-  int chosen = resolve_coll_algo(c, TPU_OPKIND_ALLREDUCE, nbytes, count,
-                                 algo);
-  ObsScope obs(TPU_OBS_ALLREDUCE, -1, 0, nbytes, chosen);
-  LogScope log(c->rank, "Allreduce",
-               [&] { return std::to_string(count) + " elems dtype " +
-                   std::to_string(dtype) + " op " + std::to_string(op) +
-                   " algo " + coll_algo_name(chosen); });
-  if (c->size == 1) {
-    if (recvbuf != sendbuf) std::memcpy(recvbuf, sendbuf, nbytes);
-    return 0;
-  }
-  if (chosen == TPU_COLL_SHM)
-    return shm_allreduce_like(c, sendbuf, recvbuf, count, dtype, op, 0, true);
-  if (recvbuf != sendbuf) std::memcpy(recvbuf, sendbuf, nbytes);
-  /* ring: bandwidth-optimal, 2*(n-1)/n * bytes on the wire per rank;
-   * rd: log2(n) full-buffer exchanges; tree: binomial reduce + bcast,
-   * 2*log2(n) serial hops (every serial hop is a scheduler round-trip
-   * when ranks share cores) */
-  switch (chosen) {
-    case TPU_COLL_RING:
-      return ring_allreduce(c, recvbuf, count, dtype, op);
-    case TPU_COLL_RD:
-      return rd_allreduce(c, recvbuf, count, dtype, op);
-    default:
-      return tree_allreduce(c, recvbuf, count, dtype, op);
-  }
+  EngineOp eop;
+  eop.kind = TPU_OBS_ALLREDUCE;
+  eop.sbuf = sendbuf;
+  eop.rbuf = recvbuf;
+  eop.count = count;
+  eop.dtype = dtype;
+  eop.rop = op;
+  eop.algo = algo;
+  return engine_submit(c, &eop);
 }
 
 int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
@@ -3145,69 +3909,51 @@ int tpucomm_reduce(int64_t h, const void* sendbuf, void* recvbuf,
                    int64_t count, int dtype, int op, int root) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  int64_t esize = dtype_size(dtype);
-  ObsScope obs(TPU_OBS_REDUCE, root, 0, count * esize,
-               c->arena && c->size > 1 ? TPU_COLL_SHM : -1);
-  LogScope log(c->rank, "Reduce",
-               [&] { return std::to_string(count) + " elems, root " +
-                                      std::to_string(root); });
-  if (esize == 0) FAIL(c, "bad dtype %d", dtype);
-  if (c->arena && c->size > 1) {
-    if (c->rank != root && recvbuf != sendbuf)
-      // non-root out = input passthrough, as on TCP
-      std::memcpy(recvbuf, sendbuf, count * esize);
-    return shm_allreduce_like(c, sendbuf, recvbuf, count, dtype, op, root,
-                              false);
-  }
-  int64_t nbytes = count * esize;
-  /* chain-reduce into root's copy: gather at root, combining in rank order
-   * for deterministic results */
-  if (c->rank == root) {
-    if (recvbuf != sendbuf) std::memcpy(recvbuf, sendbuf, nbytes);
-    std::vector<char> tmp(nbytes);
-    for (int r = 0; r < c->size; r++) {
-      if (r == root) continue;
-      if (recv_msg(c, r, kCollectiveTag, tmp.data(), nbytes)) return 1;
-      if (combine(recvbuf, tmp.data(), count, dtype, op, c)) return 1;
-    }
-    return 0;
-  }
-  if (recvbuf != sendbuf) std::memcpy(recvbuf, sendbuf, nbytes);
-  return send_msg(c, root, kCollectiveTag, recvbuf, nbytes);
+  EngineOp eop;
+  eop.kind = TPU_OBS_REDUCE;
+  eop.sbuf = sendbuf;
+  eop.rbuf = recvbuf;
+  eop.count = count;
+  eop.dtype = dtype;
+  eop.rop = op;
+  eop.peer = root;
+  return engine_submit(c, &eop);
 }
 
 int tpucomm_scan(int64_t h, const void* sendbuf, void* recvbuf,
                  int64_t count, int dtype, int op) {
   Comm* c = get_comm(h);
   if (!c) return 1;
-  std::lock_guard<std::mutex> lock(comm_mu(c));
-  int64_t esize = dtype_size(dtype);
-  ObsScope obs(TPU_OBS_SCAN, -1, 0, count * esize,
-               c->arena && c->size > 1 ? TPU_COLL_SHM : -1);
-  LogScope log(c->rank, "Scan",
-               [&] { return std::to_string(count) + " elems"; });
-  if (esize == 0) FAIL(c, "bad dtype %d", dtype);
-  if (c->arena && c->size > 1)
-    return shm_scan(c, sendbuf, recvbuf, count, dtype, op);
-  int64_t nbytes = count * esize;
-  if (recvbuf != sendbuf) std::memcpy(recvbuf, sendbuf, nbytes);
-  /* inclusive prefix along the rank chain */
-  if (c->rank > 0) {
-    std::vector<char> tmp(nbytes);
-    if (recv_msg(c, c->rank - 1, kCollectiveTag, tmp.data(), nbytes))
-      return 1;
-    /* combine(prefix_of_below, mine): order matters for non-commutative
-     * semantics; we fold below-prefix into our accumulator on the left */
-    std::vector<char> mine(nbytes);
-    std::memcpy(mine.data(), recvbuf, nbytes);
-    std::memcpy(recvbuf, tmp.data(), nbytes);
-    if (combine(recvbuf, mine.data(), count, dtype, op, c)) return 1;
-  }
-  if (c->rank < c->size - 1) {
-    if (send_msg(c, c->rank + 1, kCollectiveTag, recvbuf, nbytes)) return 1;
-  }
-  return 0;
+  EngineOp eop;
+  eop.kind = TPU_OBS_SCAN;
+  eop.sbuf = sendbuf;
+  eop.rbuf = recvbuf;
+  eop.count = count;
+  eop.dtype = dtype;
+  eop.rop = op;
+  return engine_submit(c, &eop);
+}
+
+/* ---- batched dispatch entry (the Python bridge's descriptor hop) ---- */
+
+int tpucomm_execute(int64_t h, const struct TpuOpExec* d) {
+  Comm* c = get_comm(h);
+  if (!c || !d) return 1;
+  EngineOp op;
+  op.kind = d->kind;
+  op.sbuf = d->sbuf;
+  op.rbuf = d->rbuf;
+  op.snb = d->snbytes;
+  op.rnb = d->rnbytes;
+  op.count = d->count;
+  op.dtype = d->dtype;
+  op.rop = d->rop;
+  op.peer = d->peer;
+  op.peer2 = d->peer2;
+  op.tag = d->tag;
+  op.tag2 = d->tag2;  // sendrecv: the bridge sets tag2 == tag
+  op.algo = d->algo;
+  return engine_submit(c, &op);
 }
 
 }  /* extern "C" */

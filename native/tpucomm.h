@@ -183,10 +183,15 @@ enum TpuObsOp {
 };
 
 struct TpuObsEvent {
-  double t_start;  /* seconds on the recorder clock (tpucomm_obs_clock) */
-  double dur_s;    /* whole-op wall time */
+  double t_start;  /* seconds on the recorder clock (tpucomm_obs_clock);
+                    * for engine-queued ops this is the POST time, so the
+                    * event covers dispatch + wait + wire */
+  double dur_s;    /* whole-op wall time, post -> completion */
   double wait_s;   /* blocked share: header arrival waits + barrier waits
-                    * accumulated inside the op (transfer = dur - wait) */
+                    * accumulated inside the op */
+  double queue_s;  /* dispatch share: post -> native execution start (the
+                    * submission-queue delay; 0 for inline execution).
+                    * wire = dur - queue - wait */
   int64_t nbytes;  /* payload bytes of this call (0 for barrier) */
   int32_t op;      /* TpuObsOp */
   int32_t peer;    /* peer/root rank; -1 when not applicable */
@@ -212,6 +217,67 @@ int64_t tpucomm_obs_drain(struct TpuObsEvent* out, int64_t max_n);
  * epoch — the same clock TpuObsEvent.t_start uses), so the Python side
  * can map event times onto the unix epoch by sampling both. */
 double tpucomm_obs_clock(void);
+
+/* ---- async progress engine (batched dispatch entry) ----
+ *
+ * One descriptor-driven entry point serving every transport op: the
+ * Python bridge packs a TpuOpExec once per op (a cached struct, no
+ * per-call ctypes marshalling of 6-8 scalar arguments) and calls
+ * tpucomm_execute.  Internally every op — this entry AND the classic
+ * per-op entries above — routes through the progress engine: a
+ * dedicated per-communicator progress thread drives a lock-free
+ * submission queue, so the caller either returns immediately (small
+ * sends: payload copied, completion asynchronous — the buffered-send
+ * semantics the static verifier's match model already assumes) or
+ * parks on a single completion futex while the progress thread runs
+ * the socket I/O.  Small adjacent sends to one peer coalesce into one
+ * wire frame (split transparently on the receive side, tags
+ * preserved).
+ *
+ * Engine knobs (read natively, registered in utils/config.py):
+ *   MPI4JAX_TPU_PROGRESS_THREAD  1 (default) = engine on; 0 = every op
+ *                                executes inline on the calling thread
+ *                                (the historic behavior, bit-for-bit)
+ *   MPI4JAX_TPU_COALESCE_BYTES   sends <= this many bytes that are
+ *                                adjacent in posted order to the same
+ *                                peer merge into one frame (default
+ *                                4096; 0 disables coalescing)
+ *   MPI4JAX_TPU_QUEUE_DEPTH     submission-queue capacity in ops
+ *                                (default 1024; posting parks when
+ *                                full) */
+
+/* op kinds reuse the TpuObsOp codes; scalar roles per kind:
+ *   SEND       sbuf,snbytes -> peer(dest), tag
+ *   RECV       rbuf,rnbytes <- peer2(source), tag   (strict size)
+ *   SENDRECV   sbuf,snbytes -> peer(dest); rbuf,rnbytes <- peer2, tag
+ *   SHIFT2     sbuf=[to_lo|to_hi], rbuf, snbytes=strip, peer(lo),
+ *              peer2(hi), tag
+ *   BARRIER    (no buffers)
+ *   BCAST      rbuf,rnbytes in place, peer(root)
+ *   GATHER     sbuf,snbytes -> rbuf (root only), peer(root)
+ *   SCATTER    sbuf -> rbuf,rnbytes per rank, peer(root)
+ *   ALLGATHER  sbuf,snbytes -> rbuf (size*snbytes); algo
+ *   ALLTOALL   sbuf -> rbuf, snbytes = per-peer chunk
+ *   ALLREDUCE  sbuf -> rbuf, count/dtype/rop; algo
+ *   REDUCE     sbuf -> rbuf, count/dtype/rop, peer(root)
+ *   SCAN       sbuf -> rbuf, count/dtype/rop */
+struct TpuOpExec {
+  int32_t kind;      /* TpuObsOp code */
+  int32_t algo;      /* forced TpuCollAlgo (collectives; 0 = selection) */
+  const void* sbuf;
+  void* rbuf;
+  int64_t snbytes;
+  int64_t rnbytes;
+  int64_t count;     /* elements (reductions) */
+  int32_t dtype;
+  int32_t rop;
+  int32_t peer;      /* dest / root / lo */
+  int32_t peer2;     /* source / hi */
+  int32_t tag;
+  int32_t tag2;      /* reserved (distinct recv tag) */
+};
+
+int tpucomm_execute(int64_t h, const struct TpuOpExec* d);
 
 }  /* extern "C" */
 

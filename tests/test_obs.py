@@ -417,6 +417,48 @@ def test_native_ring_records_ops_with_fields(native_lib):
     assert all(e["t"] <= n["t"] for e, n in zip(events, events[1:]))
 
 
+def test_native_events_carry_dispatch_phase(native_lib):
+    """Every drained event reports queue_s (the post -> native-start
+    dispatch delay); a detached self-send (queued on the progress
+    engine) records a positive one, and the phases always fit inside
+    the op: queue + wait <= dur."""
+    lib, h = native_lib
+    nat = _native_mod()
+    nat.enable(lib, 64)
+    for tag in range(60, 64):
+        _self_send_recv(lib, h, tag)
+    events = nat.drain(lib)
+    nat.disable(lib)
+    assert events and all("queue_s" in e for e in events)
+    for e in events:
+        assert 0.0 <= e["queue_s"] <= e["dur_s"] + 1e-12, e
+        assert e["queue_s"] + e["wait_s"] <= e["dur_s"] + 1e-9, e
+    sends = [e for e in events if e["name"] == "Send"]
+    assert any(e["queue_s"] > 0.0 for e in sends), (
+        "no queued (detached) send recorded a dispatch delay")
+
+
+def test_stats_and_trace_carry_dispatch_split():
+    """dispatch_us flows from canonical events into obs.stats rows
+    (dispatch_frac) and the Chrome trace (args + a nested dispatch
+    phase slice ahead of wait/wire)."""
+    ev = _ev("Send", 100, dur_us=50, wait_us=10, peer=1)
+    ev["dispatch_us"] = 15.0
+    stats = obs.summarize([ev])
+    row = stats["per_op"][0]
+    assert row["dispatch_frac"] == pytest.approx(0.3)
+    assert row["wait_frac"] == pytest.approx(0.2)
+    trace = obs.merge_parts([{"rank": 0, "size": 1, "events": [ev],
+                              "dropped": {}}])
+    assert obs.validate_chrome_trace(trace) == []
+    spans = {e["name"]: e for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert spans["Send"]["args"]["dispatch_us"] == pytest.approx(15.0)
+    assert spans["dispatch"]["dur"] == pytest.approx(15.0)
+    assert spans["wait"]["ts"] == pytest.approx(spans["dispatch"]["ts"]
+                                                + 15.0)
+    assert spans["wire"]["dur"] == pytest.approx(25.0)
+
+
 def test_native_ring_overflow_keeps_newest_exact_drops(native_lib):
     lib, h = native_lib
     nat = _native_mod()

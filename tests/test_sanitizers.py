@@ -156,6 +156,119 @@ def test_tsan_loopback_pair(shm):
     )
 
 
+# ---- async progress engine under TSan ------------------------------
+#
+# The progress thread is the first truly concurrent writer the
+# transport has had (descriptors cross the lock-free submission queue,
+# completions cross a futex, coalesced frames are assembled off the
+# posting thread), so it gets its own sanitized battery: a slow
+# loopback pingpong with send BURSTS (forcing the coalescing path) plus
+# a 3-rank allreduce/barrier loop, queue armed, failing on any report.
+
+_ENGINE_RANK_SRC = r"""
+import ctypes, os, sys
+import numpy as np
+
+so = os.environ["SAN_SO"]
+rank = int(os.environ["SAN_RANK"])
+size = int(os.environ["SAN_SIZE"])
+port = int(os.environ["SAN_PORT"])
+
+lib = ctypes.CDLL(so)
+lib.tpucomm_init.restype = ctypes.c_int64
+lib.tpucomm_init.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                             ctypes.c_char_p]
+h = lib.tpucomm_init(rank, size, port, b"")
+assert h > 0, "tpucomm_init failed"
+
+F32, SUM = 11, 0  # wire codes (tpucomm.h)
+n = 256
+buf = np.arange(n, dtype=np.float32) + rank
+out = np.zeros_like(buf)
+p = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+dest = (rank + 1) % size
+src = (rank - 1 + size) % size
+for it in range(12):
+    # burst of detached small sends: the engine queues them and the
+    # progress thread coalesces adjacent ones into container frames
+    for i in range(8):
+        rc = lib.tpucomm_send(h, p(buf), buf.nbytes, dest, it * 8 + i)
+        assert rc == 0, f"send failed at iter {it}.{i}"
+    for i in range(8):
+        rc = lib.tpucomm_recv(h, p(out), out.nbytes, src, it * 8 + i)
+        assert rc == 0, f"recv failed at iter {it}.{i}"
+    assert out[3] == 3.0 + src, out[3]
+    rc = lib.tpucomm_allreduce(
+        h, p(buf), p(out), n, F32, SUM)
+    assert rc == 0, f"allreduce failed at iter {it}"
+    assert out[0] == sum(range(size)), out[0]
+    assert lib.tpucomm_barrier(h) == 0
+lib.tpucomm_finalize(ctypes.c_int64(h))
+print("san-rank-ok", rank, flush=True)
+"""
+
+
+def _run_group(src, n_ranks, so_path, preload, san_env, port, extra_env):
+    env = {
+        **os.environ,
+        "SAN_SO": so_path,
+        "SAN_PORT": str(port),
+        "SAN_SIZE": str(n_ranks),
+        "LD_PRELOAD": preload,
+        **san_env,
+        **extra_env,
+    }
+    procs = []
+    for rank in range(n_ranks):
+        env_r = {**env, "SAN_RANK": str(rank)}
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", src],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env_r,
+        ))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, err = p.communicate()
+            pytest.fail(f"sanitized rank hung: {out[-500:]} {err[-500:]}")
+        outs.append((p.returncode, out, err))
+    for rank, (rc, out, err) in enumerate(outs):
+        blob = out + err
+        for marker in _REPORT_MARKERS:
+            assert marker not in blob, (
+                f"sanitizer report from rank {rank}:\n{blob[-4000:]}"
+            )
+        assert rc == 0, (
+            f"rank {rank} exited {rc} (sanitizer exitcode=66 means a "
+            f"report fired):\n{(out + err)[-2000:]}"
+        )
+        assert f"san-rank-ok {rank}" in out, out
+
+
+@pytest.mark.parametrize("shm", ["on", "off"])
+def test_tsan_progress_engine_three_ranks(shm):
+    _build("tsan")
+    preload = _preload_path("libtsan.so")
+    so = os.path.join(SO_DIR, "libtpucomm_tsan.so")
+    extra = {
+        "MPI4JAX_TPU_JOBID": f"tsaneng{shm}{os.getpid()}",
+        "MPI4JAX_TPU_PROGRESS_THREAD": "1",
+        "MPI4JAX_TPU_COALESCE_BYTES": "4096",
+    }
+    if shm == "off":
+        # TCP path: this is where detached sends coalesce on the wire
+        extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    _run_group(
+        _ENGINE_RANK_SRC, 3, so, preload,
+        {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"},
+        48200 + (os.getpid() + (13 if shm == "on" else 0)) % 900,
+        extra,
+    )
+
+
 @pytest.mark.parametrize("shm", ["on", "off"])
 def test_asan_loopback_pair(shm):
     _build("asan")
