@@ -1,0 +1,24 @@
+# Top-level convenience targets.  The native transport's own build
+# lives in native/Makefile (make -C native ...); this file carries the
+# repo-wide CI gates.
+
+PY ?= python
+
+# Analyzer + schedule-compiler gate over tests/world_programs/: every
+# manifest program must verify with exactly its expected finding kinds,
+# compile to a PROVED execution plan, and (where a golden is checked
+# in) match it byte-for-byte.  Wired as a tier-1 test
+# (tests/test_verify_corpus.py); run it directly after changing the
+# analyzer, the planner, or any corpus program.  After an INTENTIONAL
+# plan-semantics change: make update-goldens, review the diff, commit.
+verify-corpus:
+	$(PY) tools/verify_corpus.py
+
+update-goldens:
+	$(PY) tools/verify_corpus.py --update-goldens
+
+# sanitizer builds of the native transport (tests/test_sanitizers.py)
+tsan asan:
+	$(MAKE) -C native $@
+
+.PHONY: verify-corpus update-goldens tsan asan

@@ -1,0 +1,228 @@
+"""Schedule-plan overlap microbenchmarks (bridge level, no JAX dispatch).
+
+Measures the two wins the schedule compiler (docs/analysis.md § "From
+verifier to compiler") unlocks, each with the plan ON vs OFF so the
+delta is the plan's doing:
+
+1. **sendrecv pipeline** — a CHAIN of ranks (the pipeline-parallel
+   stage-boundary stream: rank r sends activations downstream to r+1,
+   computes, and receives from r-1).  The chain is acyclic, so blocks
+   larger than the kernel's socket buffering are safe — and that is
+   exactly where plan-off hurts: the caller's blocking send
+   rendezvous-waits until the downstream rank finishes computing and
+   reaches its recv.  Plan-on posts the send as a deferred ticket and
+   pre-posts the recv at the send's post point, so the progress thread
+   moves the wire while the host computes.  (A ring at these sizes
+   would rendezvous-deadlock without the plan — the hazard the
+   recalibrated ``order_critical_exchange`` describes — so the chain is
+   also the shape that keeps the plan-off baseline finishable.)
+2. **bucketed allreduce** — a backward-pass-shaped run of many small
+   gradient allreduces vs the same bytes fused into buckets
+   (``MPI4JAX_TPU_PLAN_BUCKET_KB`` semantics): fewer, larger wire
+   messages amortize per-op latency.
+
+Run under the launcher (rank 0 prints one ``obs.bench_record`` JSON row
+per configuration):
+
+    python -m mpi4jax_tpu.runtime.launch -n 3 benchmarks/schedule_overlap.py
+
+With ``--trace out.json`` the merged Perfetto timeline shows the
+overlap directly: plan-on recv spans start at their POST time (inside
+the compute window) with the wait share attributed by the dispatch/
+wait/wire split.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+try:
+    from mpi4jax_tpu import obs
+except ImportError:
+    # bridge-level bench by design: on hosts where the package's jax
+    # version gate blocks the normal import, a parent-package shim
+    # exposes the jax-free submodules (obs/analysis/runtime)
+    import types
+
+    _pkg = types.ModuleType("mpi4jax_tpu")
+    _pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+    sys.modules["mpi4jax_tpu"] = _pkg
+    from mpi4jax_tpu import obs
+
+from mpi4jax_tpu.analysis import _events, _plan
+from mpi4jax_tpu.runtime import bridge, planrt, transport
+
+
+def _compute(seconds, spin=False):
+    """Stand-in for the work between a send and its paired recv.
+
+    Default: ``time.sleep`` — the host thread idles, which is exactly
+    the TPU shape (world-tier comm runs on the HOST; the device computes
+    while the host waits on it), and what gives the progress thread the
+    core it reads the wire with.  ``--spin`` burns the CPU instead
+    (host-bound compute): on machines with spare cores the overlap
+    still wins; on oversubscribed CI boxes the progress thread then
+    competes with the spin and the delta shrinks — measure both."""
+    if not spin:
+        time.sleep(seconds)
+        return 0.0
+    end = time.perf_counter() + seconds
+    x = 0.0
+    while time.perf_counter() < end:
+        x += 1.0
+    return x
+
+
+def _pipeline_schedule(n, rounds, shape):
+    """Chain: rank r sends to r+1 (r < n-1) and receives from r-1
+    (r > 0), ``rounds`` times."""
+    events = {}
+    for rank in range(n):
+        evs = []
+        for k in range(rounds):
+            if rank < n - 1:
+                evs.append(_events.CommEvent(rank, len(evs), "send",
+                                             dest=rank + 1, tag=k,
+                                             dtype="float32", shape=shape))
+            if rank > 0:
+                evs.append(_events.CommEvent(rank, len(evs), "recv",
+                                             source=rank - 1, tag=k,
+                                             dtype="float32", shape=shape))
+        events[rank] = evs
+    return events, {(0,): tuple(range(n))}
+
+
+def bench_pipeline(comm, rounds, shape, compute_s, use_plan, spin=False):
+    h, rank, n = comm.handle, comm.rank(), comm.size()
+    rt = None
+    if use_plan:
+        events, comms = _pipeline_schedule(n, rounds, shape)
+        plan = _plan.compile_schedules(events, comms)
+        assert plan.proved and plan.rewritten, plan.reasons
+        assert planrt.install(h, plan, rank)
+        rt = planrt.get(comm)
+    payload = np.arange(int(np.prod(shape)), dtype=np.float32)
+    bridge.barrier(h)
+    t0 = time.perf_counter()
+    for k in range(rounds):
+        if rank < n - 1:
+            if rt is not None:
+                # owned=True (the MPI_Isend contract): `payload` is this
+                # loop's long-lived buffer, valid past the drain point,
+                # so the runner skips the safety copy the XLA-callback
+                # path needs
+                assert rt.run_send(payload, rank + 1, k, owned=True)
+            else:
+                bridge.send(h, payload, rank + 1, k)
+        _compute(compute_s, spin)
+        if rank > 0:
+            if rt is not None:
+                # reuse=True: the payload is consumed inside this loop
+                # iteration, so the buffer may recycle at the next op
+                got = rt.run_recv(shape, np.float32, rank - 1, k,
+                                  reuse=True)
+                assert got is not None
+            else:
+                got = bridge.recv(h, shape, np.float32, rank - 1, k)
+    dt = time.perf_counter() - t0
+    if rt is not None:
+        rt.flush()
+        assert rt.stats["mismatches"] == 0, rt.stats
+        planrt.detach(h)
+    bridge.barrier(h)
+    return dt
+
+
+def bench_bucketed_allreduce(comm, n_grads, grad_elems, bucket_elems):
+    """Per-leaf vs bucketed gradient allreduce (same total bytes)."""
+    h = comm.handle
+    grads = [np.full((grad_elems,), 1.0, np.float32)
+             for _ in range(n_grads)]
+    bridge.barrier(h)
+    t0 = time.perf_counter()
+    for g in grads:
+        bridge.allreduce(h, g, 0)
+    per_leaf = time.perf_counter() - t0
+
+    per_bucket = max(1, bucket_elems // grad_elems)
+    bridge.barrier(h)
+    t0 = time.perf_counter()
+    for i in range(0, n_grads, per_bucket):
+        chunk = np.concatenate(grads[i:i + per_bucket])
+        bridge.allreduce(h, chunk, 0)
+    bucketed = time.perf_counter() - t0
+    bridge.barrier(h)
+    return per_leaf, bucketed
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=15)
+    ap.add_argument("--block-kb", type=int, default=4096,
+                    help="pipeline block size per message (KB); sizes "
+                         "past the kernel's socket buffering are where "
+                         "the blocking send rendezvous-waits and the "
+                         "plan's overlap pays")
+    ap.add_argument("--compute-ms", type=float, default=3.0,
+                    help="compute window between send and recv (ms)")
+    ap.add_argument("--spin", action="store_true",
+                    help="burn the host CPU during the compute window "
+                         "instead of idling (device-compute shape); see "
+                         "_compute's docstring")
+    ap.add_argument("--grads", type=int, default=64)
+    ap.add_argument("--grad-kb", type=int, default=8)
+    ap.add_argument("--bucket-kb", type=int, default=512)
+    args = ap.parse_args()
+
+    comm = transport.get_world_comm()
+    rank, n = comm.rank(), comm.size()
+    assert n >= 2, "run at np >= 2"
+    shape = (args.block_kb * 256,)  # KB -> f32 elements
+    rows = []
+
+    for use_plan, label in ((False, "off"), (True, "on")):
+        dt = bench_pipeline(comm, args.rounds, shape,
+                            args.compute_ms / 1e3, use_plan,
+                            spin=args.spin)
+        if rank == 0:
+            rows.append(obs.bench_record(
+                op="plan_pipeline", nbytes=args.block_kb * 1024,
+                seconds=dt / args.rounds, ranks=n, tier="plan",
+                reps=args.rounds, plan=label,
+                compute_ms=args.compute_ms,
+                compute_kind="spin" if args.spin else "idle",
+            ))
+
+    per_leaf, bucketed = bench_bucketed_allreduce(
+        comm, args.grads, args.grad_kb * 256, args.bucket_kb * 256)
+    if rank == 0:
+        total = args.grads * args.grad_kb * 1024
+        rows.append(obs.bench_record(
+            op="plan_bucketed_allreduce", nbytes=total,
+            seconds=per_leaf, ranks=n, tier="plan", plan="off",
+            n_allreduce=args.grads,
+        ))
+        n_buckets = -(-args.grads // max(1, args.bucket_kb // args.grad_kb))
+        rows.append(obs.bench_record(
+            op="plan_bucketed_allreduce", nbytes=total,
+            seconds=bucketed, ranks=n, tier="plan", plan="on",
+            n_allreduce=n_buckets,
+        ))
+        for row in rows:
+            print(json.dumps(row), flush=True)
+        pipe = {r["plan"]: r for r in rows if r["op"] == "plan_pipeline"}
+        speedup = pipe["off"]["seconds"] / max(pipe["on"]["seconds"], 1e-9)
+        print(f"# pipeline round: plan off {pipe['off']['us']:.0f} us -> "
+              f"plan on {pipe['on']['us']:.0f} us  ({speedup:.2f}x)",
+              file=sys.stderr, flush=True)
+
+
+if __name__ == "__main__":
+    main()

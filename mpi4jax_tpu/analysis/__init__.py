@@ -31,13 +31,23 @@ from __future__ import annotations
 import inspect
 
 from ._events import (  # noqa: F401
+    ANALYZER_VERSION,
     CommEvent,
     FINDING_KINDS,
     Finding,
     Report,
+    schedule_cache_key,
 )
 from ._fake import AbstractComm, AnalysisError  # noqa: F401
 from ._match import match_schedules  # noqa: F401
+from ._plan import (  # noqa: F401
+    ExecutionPlan,
+    cached_plan,
+    compile_schedules,
+    diff_plans,
+    load_plan,
+    save_plan,
+)
 from ._schedule import trace_rank_schedule  # noqa: F401
 from ._sim import SimAbort, VirtualWorld  # noqa: F401
 
@@ -73,25 +83,31 @@ def check(fn, *args, world_size: int = 2, **kwargs) -> Report:
             and "comm" not in kwargs
     except (TypeError, ValueError):
         pass
-    schedules, findings = {}, []
+    schedules, findings, value_deps = {}, [], {}
     for rank in range(world_size):
         comm = AbstractComm(rank, world_size)
         kw = dict(kwargs)
         if takes_comm:
             kw["comm"] = comm
-        events, fnds = trace_rank_schedule(
+        events, fnds, vdeps = trace_rank_schedule(
             fn, args, kw, rank, world_size, comm=comm)
         schedules[rank] = events
+        value_deps[rank] = vdeps
         findings.extend(fnds)
     comms = {(0,): tuple(range(world_size))}
     findings.extend(match_schedules(schedules, comms))
-    return Report(
+    report = Report(
         world_size=world_size,
         target=getattr(fn, "__name__", repr(fn)),
         findings=_dedupe(findings),
         schedules={r: [e.describe() for e in evs]
                    for r, evs in schedules.items()},
+        events=schedules,
+        comms=comms,
+        cache_key=schedule_cache_key(schedules, world_size),
     )
+    report.value_deps = value_deps
+    return report
 
 
 def check_program(path: str, world_size: int, timeout_s=None,
@@ -104,3 +120,32 @@ def check_program(path: str, world_size: int, timeout_s=None,
     report = world.run()
     report.findings = _dedupe(report.findings)
     return report
+
+
+def plan_report(report: Report, **kwargs) -> ExecutionPlan:
+    """Compile the report's extracted schedules into a verified
+    execution plan (see :mod:`._plan`): dependence analysis splits true
+    data dependence from token serialization, the rewrite emits
+    concurrency groups / hoisted recv posts / coalescing and bucket
+    marks, and the equivalence prover replays both schedules through the
+    match simulator before the plan may execute.  Attaches the plan to
+    ``report.plan`` and returns it."""
+    plan = compile_schedules(
+        report.events,
+        report.comms or {(0,): tuple(range(report.world_size))},
+        findings=report.findings,
+        world_size=report.world_size,
+        value_deps_by_rank=getattr(report, "value_deps", None),
+        **kwargs,
+    )
+    report.plan = plan
+    return plan
+
+
+def plan_for(fn, *args, world_size: int = 2, **kwargs) -> ExecutionPlan:
+    """:func:`check` + :func:`plan_report` in one step: statically
+    verify ``fn`` and compile its verified execution plan.  The plan of
+    an unverifiable schedule is the trivial (unrewritten) one, with the
+    blocking findings recorded in ``plan.reasons``."""
+    report = check(fn, *args, world_size=world_size, **kwargs)
+    return plan_report(report)

@@ -1,15 +1,24 @@
-"""CLI for the static communication verifier.
+"""CLI for the static communication verifier and schedule compiler.
 
     python -m mpi4jax_tpu.analyze program.py --np 4 [--json]
                                              [--timeout S] [--schedules]
+                                             [--optimize]
+                                             [--emit-plan OUT.json]
+                                             [--diff-plan GOLDEN.json]
 
 Runs ``program.py`` once per simulated rank inside one process (virtual
 world: threads, in-memory matching, real values — no processes spawned,
 no live communication), and prints the findings table with the finding
 kind, the rank pair, and the source line/equation of every involved op.
+``--optimize`` additionally compiles the verified schedule into an
+execution plan (docs/analysis.md § "From verifier to compiler") gated
+by the equivalence prover; ``--json`` always reports the schedule/plan
+``cache_key`` and ``analyzer_version`` so plan caches invalidate and CI
+diffs stay stable.
 
-Exit codes: 0 clean, 3 findings reported, 2 usage or analyzer error —
-the same contract ``mpi4jax_tpu.launch --verify`` relies on.
+Exit codes: 0 clean, 3 findings reported (or plan drift under
+``--diff-plan``), 2 usage or analyzer error — the same contract
+``mpi4jax_tpu.launch --verify`` relies on.
 """
 
 from __future__ import annotations
@@ -47,6 +56,21 @@ def main(argv=None) -> int:
                          "warnings are still printed (the launch "
                          "--verify gate uses this: a warning documents "
                          "an assumption, it does not block a job)")
+    ap.add_argument("--optimize", action="store_true",
+                    help="also run the schedule compiler: dependence "
+                         "analysis + verified rewrite (concurrency "
+                         "groups, hoisted recv posts, coalesce/bucket "
+                         "marks); prints the plan and the equivalence-"
+                         "prover verdict (docs/analysis.md § From "
+                         "verifier to compiler)")
+    ap.add_argument("--emit-plan", metavar="OUT.json", default=None,
+                    help="write the verified execution plan as JSON "
+                         "(implies --optimize); consumable via "
+                         "MPI4JAX_TPU_PLAN=OUT.json or launch --plan")
+    ap.add_argument("--diff-plan", metavar="GOLDEN.json", default=None,
+                    help="diff the compiled plan against a golden plan "
+                         "file (implies --optimize); exits 3 on drift — "
+                         "the verify-corpus CI contract")
     # anything the analyzer doesn't recognize is the PROGRAM's argv
     # (its sys.argv, exactly as under the launcher); a leading "--"
     # separates explicitly when a program flag collides with ours
@@ -75,15 +99,51 @@ def main(argv=None) -> int:
         print(f"analyzer error on {args.prog}: {err}", file=sys.stderr)
         return EXIT_ERROR
 
+    optimize = args.optimize or args.emit_plan or args.diff_plan
+    plan_drift = None
+    if optimize:
+        from . import diff_plans, load_plan, plan_report, save_plan
+
+        try:
+            plan = plan_report(report)
+        except ValueError as err:
+            # e.g. a typo'd MPI4JAX_TPU_PLAN_BUCKET_KB: keep the CLI's
+            # documented exit contract (2 = analyzer/usage error), not
+            # a raw traceback the launch gate cannot classify
+            print(f"schedule compiler error: {err}", file=sys.stderr)
+            return EXIT_ERROR
+        if args.emit_plan:
+            save_plan(plan, args.emit_plan)
+        if args.diff_plan:
+            try:
+                golden = load_plan(args.diff_plan)
+            except (OSError, ValueError, KeyError) as err:
+                print(f"cannot load golden plan {args.diff_plan}: {err}",
+                      file=sys.stderr)
+                return EXIT_ERROR
+            plan_drift = diff_plans(golden, plan)
+            if plan_drift and not args.json:
+                print("PLAN DRIFT against "
+                      f"{args.diff_plan}:\n{plan_drift}", file=sys.stderr)
+
     if args.json:
-        print(json.dumps(report.to_json()))
+        blob = report.to_json()
+        if plan_drift is not None:
+            # CI consumers must be able to tell drift from findings —
+            # and see WHAT drifted — from the JSON alone
+            blob["plan_drift"] = plan_drift
+        print(json.dumps(blob))
     else:
         print(report.format_table(show_schedules=args.schedules))
+        if optimize:
+            print(report.plan.format())
         if args.show_output and report.output:
             print("-- program output (captured) --")
             print(report.output, end="")
     flagged = report.errors if args.errors_only else report.findings
-    return EXIT_FINDINGS if flagged else EXIT_CLEAN
+    if flagged:
+        return EXIT_FINDINGS
+    return EXIT_FINDINGS if plan_drift else EXIT_CLEAN
 
 
 if __name__ == "__main__":

@@ -24,6 +24,12 @@ from typing import Optional, Tuple
 ANY_TAG = -1
 ANY_SOURCE = -2
 
+#: Version of the analyzer's extraction + matching + planning semantics.
+#: Bumped whenever schedules, finding kinds, or the plan format change
+#: meaning — cached plans and CI golden files key on it, so a semantic
+#: change invalidates them instead of silently drifting.
+ANALYZER_VERSION = "7.0"
+
 #: Event kinds that move data point-to-point.
 P2P_KINDS = frozenset({"send", "recv", "sendrecv", "shift2"})
 
@@ -38,6 +44,32 @@ REDUCING_KINDS = frozenset({"allreduce", "reduce", "scan"})
 
 #: Collective kinds with a root parameter.
 ROOTED_KINDS = frozenset({"reduce", "bcast", "gather", "scatter"})
+
+_DTYPE_BYTES = {
+    "bool": 1, "bfloat16": 2, "float16": 2, "float32": 4, "float64": 8,
+    "int8": 1, "int16": 2, "int32": 4, "int64": 8,
+    "uint8": 1, "uint16": 2, "uint32": 4, "uint64": 8,
+    "complex64": 8, "complex128": 16,
+}
+
+
+def event_nbytes(dtype, shape) -> Optional[int]:
+    """Payload bytes from a (dtype string, shape tuple) pair, or None
+    when either is unknown.  Kept numpy-free so the matcher and planner
+    stay importable anywhere (the tier-1 standalone-loading contract)."""
+    if dtype is None or shape is None:
+        return None
+    itemsize = _DTYPE_BYTES.get(str(dtype))
+    if itemsize is None:  # "float32" styles covered above; parse "f4"/"<f8"
+        digits = "".join(ch for ch in str(dtype) if ch.isdigit())
+        if not digits:
+            return None
+        bits = int(digits)
+        itemsize = bits // 8 if bits >= 8 else 1
+    n = itemsize
+    for d in shape:
+        n *= int(d)
+    return n
 
 
 @dataclass
@@ -61,8 +93,14 @@ class CommEvent:
     dtype: Optional[str] = None
     shape: Optional[Tuple[int, ...]] = None
     site: str = ""                 # "file.py:123 (eqn 4 mpi4jax_tpu_send)"
+    status: bool = False           # recv/sendrecv fills an MPI-style Status
     # internal matcher state (not part of identity)
     _sent: bool = field(default=False, repr=False, compare=False)
+
+    @property
+    def nbytes(self) -> Optional[int]:
+        """Payload bytes of this event, or None when unknown."""
+        return event_nbytes(self.dtype, self.shape)
 
     def describe(self) -> str:
         bits = [self.kind]
@@ -101,6 +139,31 @@ class CommEvent:
             sig.append(("dtype", self.dtype))
             sig.append(("shape", self.shape))
         return tuple(sig)
+
+
+def canonical_event(ev: "CommEvent") -> tuple:
+    """The semantic identity of one event: every field that affects
+    matching or planning, none of the presentation (site strings).  The
+    schedule cache key and the golden-plan corpus hash these, so a
+    comment shifting line numbers does not invalidate a cached plan."""
+    return (ev.kind, tuple(ev.comm), ev.dest, ev.source, ev.lo, ev.hi,
+            ev.root, ev.tag, ev.sendtag, ev.recvtag, ev.reduce_op,
+            ev.dtype, None if ev.shape is None else tuple(ev.shape),
+            bool(ev.status))
+
+
+def schedule_cache_key(events_by_rank: dict, world_size: int) -> str:
+    """sha256 over the canonical schedules + world size + analyzer
+    version — the plan/schedule cache key ``analyze --json`` reports."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(f"analyzer={ANALYZER_VERSION};np={world_size}".encode())
+    for rank in sorted(events_by_rank):
+        h.update(f";rank={rank}".encode())
+        for ev in events_by_rank[rank]:
+            h.update(repr(canonical_event(ev)).encode())
+    return h.hexdigest()[:32]
 
 
 # ---------------------------------------------------------------------------
@@ -189,6 +252,19 @@ class Report:
     findings: list
     schedules: dict = field(default_factory=dict)  # rank -> [event str]
     output: str = ""               # captured program stdout/stderr (sim)
+    #: raw CommEvent lists (rank -> [CommEvent]) — the schedule compiler's
+    #: input; not serialized (the string form above is the JSON view)
+    events: dict = field(default_factory=dict, repr=False)
+    #: comm key -> ordered world-rank member tuple, as matched
+    comms: dict = field(default_factory=dict, repr=False)
+    #: schedule/plan cache key: a hash of the canonical per-rank schedules
+    #: + world size + ANALYZER_VERSION.  Plan caches and CI diffs key on
+    #: it — same program, same analyzer ⇒ same key.
+    cache_key: str = ""
+    analyzer_version: str = ANALYZER_VERSION
+    #: attached by the schedule compiler (analysis._plan) when --optimize
+    #: runs: a PlanResult, or None
+    plan: object = field(default=None, repr=False)
 
     @property
     def ok(self) -> bool:
@@ -221,12 +297,17 @@ class Report:
         return "\n".join(lines)
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "target": self.target,
             "world_size": self.world_size,
             "ok": self.ok,
+            "analyzer_version": self.analyzer_version,
+            "cache_key": self.cache_key,
             "findings": [f.to_json() for f in self.findings],
             "schedules": {
                 str(r): list(v) for r, v in self.schedules.items()
             },
         }
+        if self.plan is not None:
+            out["plan"] = self.plan.to_json()
+        return out

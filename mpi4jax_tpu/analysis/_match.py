@@ -27,8 +27,9 @@ so the verifier reports the hazard as a warning with both call sites.
 
 from __future__ import annotations
 
+import os
 from collections import deque
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ._events import (
     ANY_SOURCE,
@@ -36,9 +37,49 @@ from ._events import (
     COLLECTIVE_KINDS,
     CommEvent,
     Finding,
+    event_nbytes,
 )
 
 MAX_FINDINGS = 200
+
+#: mirror of native/tpucomm.cc kEagerBytes: the floor of the progress
+#: engine's detached-send threshold (detach_threshold() there is
+#: max(32 KB, MPI4JAX_TPU_COALESCE_BYTES))
+ENGINE_DETACH_FLOOR = 32 * 1024
+
+
+def default_coalesce_bytes() -> int:
+    """Resolved MPI4JAX_TPU_COALESCE_BYTES with the native parser's
+    clamps (default 4096; 0 = off).  The ONE analysis-side reading of
+    the knob — the detach threshold below and the plan compiler's
+    coalesce marks both derive from it, so they cannot drift apart.
+
+    Read from the environment directly (not utils.config) so the match
+    model stays standalone-loadable, the same contract as the wildcard
+    sentinels above; the knob is declared in ``config.KNOBS``."""
+    raw = os.environ.get("MPI4JAX_TPU_COALESCE_BYTES", "").strip()
+    if raw:
+        try:
+            return max(0, min(int(raw), 64 * 1024))
+        except ValueError:
+            pass  # the native parser rejects it loudly; keep the default
+    return 4096
+
+
+def default_detach_threshold() -> int:
+    """Bytes up to which a send is truly buffered (detached) at run time.
+
+    Mirrors the native engine's rules: with the async progress engine on
+    (MPI4JAX_TPU_PROGRESS_THREAD, default on) sends up to
+    max(32 KB, MPI4JAX_TPU_COALESCE_BYTES) copy their payload and return
+    immediately, so they can never rendezvous-block.  With the engine
+    off every send writes inline and the historic conservative model
+    (any send may block) applies — threshold 0.
+    """
+    raw = os.environ.get("MPI4JAX_TPU_PROGRESS_THREAD", "").strip().lower()
+    if raw in ("0", "false", "off", "no"):
+        return 0
+    return max(ENGINE_DETACH_FLOOR, default_coalesce_bytes())
 
 
 def _site_pair(a: CommEvent, b: CommEvent) -> Tuple[str, ...]:
@@ -70,6 +111,15 @@ def compare_p2p(send: CommEvent, recv: CommEvent) -> List[Finding]:
         ))
     elif send.shape is not None and recv.shape is not None \
             and send.shape != recv.shape:
+        if recv.status:
+            # a Status-filling receive accepts SHORT messages (the
+            # native recv_status contract: the actual byte count lands
+            # in the Status); only truncation is a program error
+            send_nb = event_nbytes(send.dtype, send.shape)
+            recv_nb = event_nbytes(recv.dtype, recv.shape)
+            if send_nb is not None and recv_nb is not None \
+                    and send_nb <= recv_nb:
+                return found
         found.append(Finding(
             "shape_mismatch",
             f"rank {send.rank} sends shape {send.shape} but rank "
@@ -124,6 +174,7 @@ def compare_collective(events: Sequence[CommEvent]) -> List[Finding]:
 def order_critical_findings(
     schedules: Dict[int, List[CommEvent]],
     comms: Dict[Tuple, Tuple[int, ...]] = None,
+    detach_threshold: Optional[int] = None,
 ) -> List[Finding]:
     """Warn on cyclic raw send<->recv traffic between rank pairs.
 
@@ -132,8 +183,25 @@ def order_critical_findings(
     op executing exactly in program order.  Combined ``sendrecv``/
     ``shift2`` ops are exempt — they are the reorder-safe way to express
     the same exchange.
+
+    Calibrated against the async progress engine's buffered sends: a
+    send at or below ``detach_threshold`` bytes (default: the engine's
+    detach threshold, see :func:`default_detach_threshold`) copies its
+    payload and returns immediately, so an exchange whose sends on
+    EITHER side all fit the threshold cannot rendezvous-block — the
+    small side's send always completes, its recv then drains the peer,
+    and the cycle is broken.  Only exchanges where both directions can
+    actually block are flagged; unknown payload sizes stay conservative.
     """
     comms = comms or {}
+    if detach_threshold is None:
+        detach_threshold = default_detach_threshold()
+
+    def can_block(send_ev: CommEvent) -> bool:
+        nbytes = event_nbytes(send_ev.dtype, send_ev.shape)
+        if nbytes is None:
+            return True  # unknown payload: stay conservative
+        return nbytes > detach_threshold
 
     def to_world(comm, local_rank):
         members = comms.get(comm)
@@ -141,11 +209,15 @@ def order_critical_findings(
 
     sends: Dict[Tuple, CommEvent] = {}
     recvs: Dict[Tuple, CommEvent] = {}
+    # whether ANY send on a direction can block: a small first send must
+    # not mask a later above-threshold one on the same direction
+    dir_blocks: Dict[Tuple, bool] = {}
     for rank, events in schedules.items():
         for ev in events:
             if ev.kind == "send":
-                sends.setdefault(
-                    (ev.comm, rank, to_world(ev.comm, ev.dest)), ev)
+                key = (ev.comm, rank, to_world(ev.comm, ev.dest))
+                sends.setdefault(key, ev)
+                dir_blocks[key] = dir_blocks.get(key) or can_block(ev)
             elif ev.kind == "recv" and ev.source != ANY_SOURCE:
                 recvs.setdefault(
                     (ev.comm, rank, to_world(ev.comm, ev.source)), ev)
@@ -165,12 +237,21 @@ def order_critical_findings(
         if recv_ab is None or send_ba is None or recv_ba is None:
             continue
         seen.add(key)
+        if not (dir_blocks.get((comm, a, b))
+                and dir_blocks.get((comm, b, a))):
+            # EVERY send of at least one direction is a detached
+            # buffered send at run time: that rank can never stall
+            # before its recvs, so the exchange cannot deadlock under
+            # any reordering
+            continue
         found.append(Finding(
             "order_critical_exchange",
             f"ranks {a} and {b} exchange messages in both directions "
-            "through separate send/recv calls: the schedule matches only "
-            "under strict program-order execution (tokens/ordered effects "
-            "intact); any reordering deadlocks. Prefer sendrecv() for "
+            "through separate send/recv calls, and both directions exceed "
+            f"the buffered-send threshold ({detach_threshold} bytes): the "
+            "schedule matches only under strict program-order execution "
+            "(tokens/ordered effects intact); any reordering can "
+            "rendezvous-block and deadlock. Prefer sendrecv() for "
             "bidirectional exchanges.",
             ranks=(a, b), comm=comm,
             sites=(
@@ -297,15 +378,50 @@ class _Channels:
 def match_schedules(
     schedules: Dict[int, List[CommEvent]],
     comms: Dict[Tuple, Tuple[int, ...]],
+    deliveries: Optional[dict] = None,
+    service_order: Optional[Sequence[int]] = None,
 ) -> List[Finding]:
     """Simulate matching of all rank schedules; return the findings.
 
     ``comms`` maps each comm key to its ordered world-rank member tuple
     (sub-rank i of the comm is world rank members[i]).
+
+    ``deliveries``, when a dict is passed, is filled with the exact
+    matching outcome — ``deliveries["p2p"][(comm, src, dst)]`` is the
+    in-order list of ``(send_rank, send_idx, tag, recv_rank, recv_idx)``
+    matches on that channel and ``deliveries["coll"][comm]`` the ordered
+    collective rendezvous — so the schedule compiler's equivalence
+    prover can assert a rewritten schedule delivers the same messages in
+    the same per-channel order (payload content rides sends unchanged,
+    so per-channel send identity ⇒ value identity).
+
+    ``service_order`` overrides the deterministic rank-advance order
+    (default: ascending) — the prover varies it to expose matches that
+    depend on which rank the simulator happens to serve first
+    (ANY_SOURCE races).
     """
     findings: List[Finding] = []
     pcs = {r: 0 for r in schedules}
     chans = _Channels()
+    if deliveries is not None:
+        deliveries.setdefault("p2p", {})
+        deliveries.setdefault("coll", {})
+
+    def _rec_p2p(comm, src, dst, send_ev, recv_ev):
+        if deliveries is None:
+            return
+        deliveries["p2p"].setdefault((comm, src, dst), []).append(
+            (send_ev.rank, send_ev.idx, send_ev.tag,
+             recv_ev.rank, recv_ev.idx)
+        )
+
+    def _rec_coll(comm, arrived):
+        if deliveries is None:
+            return
+        deliveries["coll"].setdefault(comm, []).append(
+            (arrived[0].kind,
+             tuple(sorted((e.rank, e.idx) for e in arrived)))
+        )
     total = sum(len(v) for v in schedules.values())
     for events in schedules.values():  # make reruns idempotent
         for ev in events:
@@ -362,7 +478,9 @@ def match_schedules(
             if any(chans.head(ev.comm, p, me) is None for p in needed):
                 return False
             for p in needed:
-                findings.extend(compare_p2p(chans.pop(ev.comm, p, me), ev))
+                sent = chans.pop(ev.comm, p, me)
+                _rec_p2p(ev.comm, p, me, sent, ev)
+                findings.extend(compare_p2p(sent, ev))
             pcs[r] += 1
             return True
         if ev.kind == "recv":
@@ -377,6 +495,7 @@ def match_schedules(
                     return False
                 arrived.append(cur)
             findings.extend(compare_collective(arrived))
+            _rec_coll(ev.comm, arrived)
             for m in members:
                 pcs[m] += 1
             return True
@@ -387,8 +506,9 @@ def match_schedules(
             for src, head in chans.heads_for(ev.comm, me):
                 head_tag = head.tag
                 if tag in (None, ANY_TAG) or head_tag == tag:
-                    findings.extend(
-                        compare_p2p(chans.pop(ev.comm, src, me), ev))
+                    sent = chans.pop(ev.comm, src, me)
+                    _rec_p2p(ev.comm, src, me, sent, ev)
+                    findings.extend(compare_p2p(sent, ev))
                     pcs[r] += 1
                     return True
             return False
@@ -397,13 +517,17 @@ def match_schedules(
             return False
         # strict in-order channel: the head is THE match; field
         # disagreements are findings (the native transport aborts here)
-        findings.extend(compare_p2p(chans.pop(ev.comm, source, me), ev))
+        sent = chans.pop(ev.comm, source, me)
+        _rec_p2p(ev.comm, source, me, sent, ev)
+        findings.extend(compare_p2p(sent, ev))
         pcs[r] += 1
         return True
 
+    service = (list(service_order) if service_order is not None
+               else sorted(schedules))
     for _ in range(2 * total + 2):
         progressed = False
-        for r in sorted(schedules):
+        for r in service:
             while try_advance(r):
                 progressed = True
                 if len(findings) > MAX_FINDINGS:

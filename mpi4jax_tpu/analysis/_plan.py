@@ -1,0 +1,773 @@
+"""Schedule compiler: verified execution plans over extracted schedules.
+
+Closes the ROADMAP's "schedule compilation à la GC3" loop: PR 3 extracts
+the exact per-rank communication schedule from a jaxpr, PR 5 built the
+execution substrate (detached buffered sends, per-peer coalescing,
+pre-postable descriptors on the async progress engine) — this module
+compiles the schedule into an :class:`ExecutionPlan` the runtime
+(``runtime/planrt.py``) can execute with overlap:
+
+- **concurrency groups** — consecutive, mutually-independent ops (per
+  the ``_deps`` dependence DAG) whose completions may be outstanding
+  together; the runner waits at the group boundary, not per op;
+- **hoisted receives** — each eligible recv carries its earliest safe
+  *post* point, so the progress engine reads the wire while the host is
+  still computing (``post_at < idx`` in the plan);
+- **coalescing marks** — adjacent small sends to one peer that the PR 5
+  engine will merge into one wire frame;
+- **gradient buckets** — runs of small same-op/dtype allreduces marked
+  for fusion into bucketed allreduces (consumed by ``parallel.dp``).
+
+Every plan is gated by an **equivalence prover** before anything may
+execute it: the original and rewritten schedules both replay through the
+PR 3 match simulator (``_match.match_schedules``), with every
+interleaving inside each concurrency group explored, and the plan is
+rejected unless (a) no finding kind appears that the original schedule
+did not produce, (b) the per-channel delivery order — and therefore the
+delivered values, since payload content rides sends unchanged — is
+identical, and (c) no interleaving can deadlock.  Programs whose
+schedules carry true cross-rank ordering dependence (the recalibrated
+``order_critical_exchange``) or statically-unresolvable control flow are
+left unrewritten, with the reason recorded.
+
+Import-light and jax-free like ``_match``/``_deps``: the tier-1 suite
+loads this standalone on any host.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import _deps, _match
+from ._events import (
+    ANALYZER_VERSION,
+    COLLECTIVE_KINDS,
+    CommEvent,
+    Finding,
+    event_nbytes,
+    schedule_cache_key,
+)
+
+#: plan wire-format version (bumped with ANALYZER_VERSION on semantic
+#: changes; loaders reject mismatches instead of misreading)
+PLAN_FORMAT = 1
+
+#: default gradient-bucket ceiling; MPI4JAX_TPU_PLAN_BUCKET_KB overrides
+DEFAULT_BUCKET_BYTES = 1 << 20
+
+#: equivalence-prover budget: total simulations across the base run, the
+#: per-group interleavings, and the reversed config
+MAX_INTERLEAVINGS = 256
+
+#: finding kinds that make a schedule unplannable — the static schedule
+#: is not the (only) runtime schedule, so no rewrite can be proven
+UNPLANNABLE_KINDS = frozenset({
+    "control_divergence", "comm_in_while", "token_violation",
+    "analysis_timeout", "rank_error",
+})
+
+
+#: one analysis-side reading of the coalesce knob (native-clamp mirror)
+default_coalesce_bytes = _match.default_coalesce_bytes
+
+
+def default_bucket_bytes() -> int:
+    raw = os.environ.get("MPI4JAX_TPU_PLAN_BUCKET_KB", "").strip()
+    if raw:
+        try:
+            return max(0, int(raw)) * 1024
+        except ValueError:
+            # same strictness as utils.config.plan_bucket_bytes: a
+            # typo'd knob must not silently change the plan's buckets
+            raise ValueError(
+                f"cannot parse MPI4JAX_TPU_PLAN_BUCKET_KB={raw!r} as KB")
+    return DEFAULT_BUCKET_BYTES
+
+
+@dataclass
+class PlanOp:
+    """One scheduled op in one rank's execution plan.
+
+    ``idx`` is the op's position in the original (token-order) schedule;
+    ``group`` its concurrency group; ``post_at`` the position the op is
+    *posted* at (< idx only for hoisted receives); ``deferred`` marks
+    ops whose completion wait moves to the group boundary (sends);
+    ``coalesce`` marks members of a small-send run the engine merges;
+    ``bucket`` is the gradient-bucket id, or None.
+    """
+
+    idx: int
+    kind: str
+    comm: Tuple = (0,)
+    dest: Optional[int] = None
+    source: Optional[int] = None
+    lo: Optional[int] = None
+    hi: Optional[int] = None
+    root: Optional[int] = None
+    tag: Optional[int] = None
+    sendtag: Optional[int] = None
+    recvtag: Optional[int] = None
+    reduce_op: Optional[str] = None
+    dtype: Optional[str] = None
+    shape: Optional[Tuple[int, ...]] = None
+    status: bool = False
+    nbytes: Optional[int] = None
+    group: int = 0
+    post_at: int = 0
+    deferred: bool = False
+    coalesce: bool = False
+    bucket: Optional[int] = None
+
+    @classmethod
+    def from_event(cls, ev: CommEvent) -> "PlanOp":
+        return cls(
+            idx=ev.idx, kind=ev.kind, comm=tuple(ev.comm), dest=ev.dest,
+            source=ev.source, lo=ev.lo, hi=ev.hi, root=ev.root, tag=ev.tag,
+            sendtag=ev.sendtag, recvtag=ev.recvtag, reduce_op=ev.reduce_op,
+            dtype=ev.dtype,
+            shape=None if ev.shape is None else tuple(ev.shape),
+            status=bool(ev.status),
+            nbytes=event_nbytes(ev.dtype, ev.shape),
+            post_at=ev.idx,
+        )
+
+    @property
+    def hoisted(self) -> bool:
+        return self.post_at < self.idx
+
+    def describe(self) -> str:
+        bits = [self.kind]
+        if self.kind == "send":
+            bits.append(f"to {self.dest} tag {self.tag}")
+        elif self.kind == "recv":
+            bits.append(f"from {self.source} tag {self.tag}")
+        elif self.kind == "sendrecv":
+            bits.append(f"to {self.dest} from {self.source}")
+        elif self.kind == "shift2":
+            bits.append(f"lo {self.lo} hi {self.hi}")
+        elif self.root is not None:
+            bits.append(f"root {self.root}")
+        if self.reduce_op:
+            bits.append(f"op {self.reduce_op}")
+        if self.dtype:
+            shape = "x".join(map(str, self.shape or ()))
+            bits.append(f"{self.dtype}[{shape}]")
+        marks = []
+        if self.hoisted:
+            marks.append(f"post@{self.post_at}")
+        if self.deferred:
+            marks.append("deferred")
+        if self.coalesce:
+            marks.append("coalesce")
+        if self.bucket is not None:
+            marks.append(f"bucket {self.bucket}")
+        if marks:
+            bits.append("(" + ", ".join(marks) + ")")
+        return " ".join(bits)
+
+    def to_json(self) -> dict:
+        out = {"idx": self.idx, "kind": self.kind, "comm": list(self.comm),
+               "group": self.group, "post_at": self.post_at}
+        for name in ("dest", "source", "lo", "hi", "root", "tag",
+                     "sendtag", "recvtag", "reduce_op", "dtype", "nbytes",
+                     "bucket"):
+            v = getattr(self, name)
+            if v is not None:
+                out[name] = v
+        if self.shape is not None:
+            out["shape"] = list(self.shape)
+        for flag in ("status", "deferred", "coalesce"):
+            if getattr(self, flag):
+                out[flag] = True
+        return out
+
+    @classmethod
+    def from_json(cls, data: dict) -> "PlanOp":
+        kw = dict(data)
+        kw["comm"] = tuple(kw.get("comm", (0,)))
+        if kw.get("shape") is not None:
+            kw["shape"] = tuple(kw["shape"])
+        return cls(**kw)
+
+
+@dataclass
+class RankPlan:
+    rank: int
+    ops: List[PlanOp] = field(default_factory=list)
+    groups: List[List[int]] = field(default_factory=list)
+
+    @property
+    def n_hoisted(self) -> int:
+        return sum(1 for op in self.ops if op.hoisted)
+
+    @property
+    def n_deferred(self) -> int:
+        return sum(1 for op in self.ops if op.deferred)
+
+    @property
+    def n_grouped(self) -> int:
+        return sum(len(g) for g in self.groups if len(g) > 1)
+
+    def to_json(self) -> dict:
+        return {"rank": self.rank,
+                "ops": [op.to_json() for op in self.ops],
+                "groups": [list(g) for g in self.groups]}
+
+    @classmethod
+    def from_json(cls, data: dict) -> "RankPlan":
+        return cls(rank=int(data["rank"]),
+                   ops=[PlanOp.from_json(o) for o in data["ops"]],
+                   groups=[list(g) for g in data.get("groups", [])])
+
+
+@dataclass
+class ExecutionPlan:
+    """A verified (or verifiably rejected) whole-program execution plan."""
+
+    world_size: int
+    cache_key: str = ""
+    analyzer_version: str = ANALYZER_VERSION
+    detach_threshold: int = 0
+    coalesce_bytes: int = 0
+    bucket_bytes: int = 0
+    ranks: Dict[int, RankPlan] = field(default_factory=dict)
+    proved: bool = False
+    proof: dict = field(default_factory=dict)
+    reasons: List[str] = field(default_factory=list)
+
+    @property
+    def rewritten(self) -> bool:
+        """True when the plan changes anything relative to token order."""
+        return any(
+            rp.n_hoisted or rp.n_grouped or rp.n_deferred
+            or any(op.bucket is not None or op.coalesce for op in rp.ops)
+            for rp in self.ranks.values()
+        )
+
+    def summary(self) -> str:
+        hoisted = sum(rp.n_hoisted for rp in self.ranks.values())
+        deferred = sum(rp.n_deferred for rp in self.ranks.values())
+        grouped = sum(rp.n_grouped for rp in self.ranks.values())
+        buckets = len({(r, op.bucket) for r, rp in self.ranks.items()
+                       for op in rp.ops if op.bucket is not None})
+        coalesce = sum(1 for rp in self.ranks.values()
+                       for op in rp.ops if op.coalesce)
+        verdict = "proved" if self.proved else "NOT PROVED"
+        state = "rewritten" if self.rewritten else "unrewritten"
+        return (f"plan {self.cache_key or '?'} np={self.world_size}: "
+                f"{state}, {verdict} "
+                f"({self.proof.get('interleavings', 0)} interleavings); "
+                f"{hoisted} hoisted recv(s), {grouped} grouped op(s), "
+                f"{deferred} deferred send(s), {coalesce} coalesce "
+                f"mark(s), {buckets} bucket(s)")
+
+    def format(self) -> str:
+        lines = [self.summary()]
+        for reason in self.reasons:
+            lines.append(f"  note: {reason}")
+        for rank in sorted(self.ranks):
+            rp = self.ranks[rank]
+            lines.append(f"-- rank {rank}: {len(rp.ops)} op(s), "
+                         f"{len(rp.groups)} group(s) --")
+            for op in rp.ops:
+                lines.append(f"   g{op.group:<3d}[{op.idx}] {op.describe()}")
+        return "\n".join(lines)
+
+    def to_json(self) -> dict:
+        return {
+            "format": PLAN_FORMAT,
+            "analyzer_version": self.analyzer_version,
+            "cache_key": self.cache_key,
+            "world_size": self.world_size,
+            "detach_threshold": self.detach_threshold,
+            "coalesce_bytes": self.coalesce_bytes,
+            "bucket_bytes": self.bucket_bytes,
+            "proved": self.proved,
+            "rewritten": self.rewritten,  # derived; for JSON consumers
+            "proof": self.proof,
+            "reasons": list(self.reasons),
+            "ranks": {str(r): rp.to_json()
+                      for r, rp in sorted(self.ranks.items())},
+        }
+
+    @classmethod
+    def from_json(cls, data: dict) -> "ExecutionPlan":
+        if data.get("format") != PLAN_FORMAT:
+            raise ValueError(
+                f"plan format {data.get('format')!r} is not {PLAN_FORMAT}"
+            )
+        plan = cls(
+            world_size=int(data["world_size"]),
+            cache_key=data.get("cache_key", ""),
+            analyzer_version=data.get("analyzer_version", ""),
+            detach_threshold=int(data.get("detach_threshold", 0)),
+            coalesce_bytes=int(data.get("coalesce_bytes", 0)),
+            bucket_bytes=int(data.get("bucket_bytes", 0)),
+            proved=bool(data.get("proved", False)),
+            proof=dict(data.get("proof", {})),
+            reasons=list(data.get("reasons", [])),
+        )
+        for r, rp in data.get("ranks", {}).items():
+            plan.ranks[int(r)] = RankPlan.from_json(rp)
+        return plan
+
+
+def diff_plans(a: ExecutionPlan, b: ExecutionPlan,
+               a_name: str = "expected", b_name: str = "actual") -> str:
+    """Unified diff of two plans' canonical JSON (empty = identical).
+
+    Proof statistics are excluded: the *schedule rewrite* is the golden
+    contract, prover timing/budget details are not.
+    """
+    import difflib
+
+    def canon(p: ExecutionPlan) -> List[str]:
+        data = p.to_json()
+        data.pop("proof", None)
+        return json.dumps(data, indent=1, sort_keys=True).splitlines()
+
+    return "\n".join(difflib.unified_diff(
+        canon(a), canon(b), fromfile=a_name, tofile=b_name, lineterm=""))
+
+
+# ---------------------------------------------------------------------------
+# plan construction
+
+
+def _mark_coalesce(ops: List[PlanOp], coalesce_bytes: int) -> None:
+    run: List[int] = []
+
+    def flush():
+        if len(run) >= 2:
+            for i in run:
+                ops[i].coalesce = True
+        run.clear()
+
+    prev_key = None
+    for i, op in enumerate(ops):
+        key = None
+        if (op.kind == "send" and op.nbytes is not None
+                and coalesce_bytes > 0 and op.nbytes <= coalesce_bytes):
+            key = (op.comm, op.dest)
+        if key is None or key != prev_key:
+            flush()
+        if key is not None:
+            run.append(i)
+        prev_key = key
+    flush()
+
+
+def _mark_buckets(ops: List[PlanOp], bucket_bytes: int) -> None:
+    if bucket_bytes <= 0:
+        return
+    next_bucket = 0
+    run: List[int] = []
+
+    def flush():
+        nonlocal next_bucket
+        if len(run) >= 2:
+            for i in run:
+                ops[i].bucket = next_bucket
+            next_bucket += 1
+        run.clear()
+
+    prev_key = None
+    filled = 0
+    for i, op in enumerate(ops):
+        key = None
+        if (op.kind == "allreduce" and op.nbytes is not None
+                and op.nbytes <= bucket_bytes):
+            key = (op.comm, op.reduce_op, op.dtype)
+        if key is None or key != prev_key or filled + (op.nbytes or 0) > \
+                bucket_bytes:
+            flush()
+            filled = 0
+        if key is not None:
+            run.append(i)
+            filled += op.nbytes or 0
+        prev_key = key
+    flush()
+
+
+def build_plan(
+    events_by_rank: Dict[int, List[CommEvent]],
+    comms: Dict[Tuple, Tuple[int, ...]],
+    *,
+    world_size: Optional[int] = None,
+    findings: Sequence[Finding] = (),
+    value_deps_by_rank: Optional[Dict[int, set]] = None,
+    detach_threshold: Optional[int] = None,
+    coalesce_bytes: Optional[int] = None,
+    bucket_bytes: Optional[int] = None,
+    max_group: int = _deps.MAX_GROUP,
+    aggressive: bool = True,
+    force_trivial: bool = False,
+) -> ExecutionPlan:
+    """Compile per-rank schedules into an (unproven) execution plan.
+
+    ``findings`` is the verification report's finding list: error-level
+    findings and statically-unresolvable schedules (control divergence,
+    comm-in-while, token violations) make the program unplannable, and a
+    recalibrated ``order_critical_exchange`` — true cross-rank ordering
+    dependence — leaves the schedule unrewritten (trivial plan).
+
+    ``aggressive=False`` builds the fallback plan: groups and marks but
+    no recv hoisting (used when the prover rejects the hoisted plan).
+    """
+    if world_size is None:
+        world_size = len(events_by_rank)
+    if detach_threshold is None:
+        detach_threshold = _match.default_detach_threshold()
+    if coalesce_bytes is None:
+        coalesce_bytes = default_coalesce_bytes()
+    if bucket_bytes is None:
+        bucket_bytes = default_bucket_bytes()
+    plan = ExecutionPlan(
+        world_size=world_size,
+        cache_key=schedule_cache_key(events_by_rank, world_size),
+        detach_threshold=detach_threshold,
+        coalesce_bytes=coalesce_bytes,
+        bucket_bytes=bucket_bytes,
+    )
+
+    blockers = sorted(
+        {f.kind for f in findings
+         if f.severity == "error" or f.kind in UNPLANNABLE_KINDS}
+    )
+    pinned = any(f.kind == "order_critical_exchange" for f in findings)
+    # the runtime runner serves the WORLD communicator only: a schedule
+    # that communicates on sub-comms would desync its cursor (sub-comm
+    # ops bypass the world runner), so such programs stay unrewritten
+    world_key = (0,)
+    subcomms = any(
+        tuple(ev.comm) != world_key
+        for events in events_by_rank.values() for ev in events
+    )
+    trivial = bool(blockers) or pinned or subcomms or force_trivial
+    if blockers:
+        plan.reasons.append(
+            "unplannable schedule: " + ", ".join(blockers)
+        )
+    if pinned:
+        plan.reasons.append(
+            "order-critical exchange: true cross-rank ordering "
+            "dependence — schedule left unrewritten"
+        )
+    if subcomms and not (blockers or pinned or force_trivial):
+        plan.reasons.append(
+            "sub-communicator schedule: plan execution serves the "
+            "world communicator only — schedule left unrewritten"
+        )
+
+    for rank, events in sorted(events_by_rank.items()):
+        ops = [PlanOp.from_event(ev) for ev in events]
+        for pos, op in enumerate(ops):
+            # positions are the plan's coordinate system; re-number so a
+            # truncated/merged extraction cannot desync the groups
+            op.idx = pos
+            op.post_at = pos
+        if trivial:
+            groups = [[i] for i in range(len(ops))]
+        else:
+            vdeps = (value_deps_by_rank or {}).get(rank)
+            deps = _deps.build_rank_deps(events, value_deps=vdeps)
+            groups = _deps.concurrency_groups(events, deps,
+                                              max_group=max_group)
+            # never hoist on a channel that ANYWHERE in the schedule
+            # also carries a Status or wildcard receive: a pre-posted
+            # strict descriptor owns the next wire message on its
+            # channel, and mixing it with flexible receives is exactly
+            # the reconciliation the runtime fallback cannot do safely
+            wild_comms = set()
+            status_channels = set()
+            for ev in events:
+                if ev.source == _deps.ANY_SOURCE:
+                    wild_comms.add(ev.comm)
+                elif ev.status and ev.kind in ("recv", "sendrecv"):
+                    status_channels.add((ev.comm, ev.source))
+            for pos, op in enumerate(ops):
+                if op.kind == "send":
+                    op.deferred = True
+                if (aggressive and op.kind == "recv"
+                        and op.comm not in wild_comms
+                        and (op.comm, op.source) not in status_channels):
+                    op.post_at = _deps.recv_post_point(events, deps, pos)
+            _mark_coalesce(ops, min(coalesce_bytes, detach_threshold))
+            _mark_buckets(ops, bucket_bytes)
+        for gid, members in enumerate(groups):
+            for pos in members:
+                ops[pos].group = gid
+        plan.ranks[rank] = RankPlan(rank=rank, ops=ops, groups=groups)
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# equivalence prover
+
+
+def _planned_order(events: List[CommEvent], rp: RankPlan) -> List[int]:
+    """Positions of ``events`` in planned wire order.
+
+    A hoisted recv (``post_at = p < idx``) is posted immediately after
+    op ``p``'s own post, so its wire slot sits between ``p`` and
+    ``p + 1``; the FIFO progress engine makes post order the wire order.
+    For the common temporal hoist (``p == idx - 1``) the order is
+    unchanged — only the *time* of the post moves earlier, into the
+    host-compute gap.  Multiple hoists to one point keep their original
+    relative order."""
+    keys = []
+    for pos in range(len(events)):
+        op = rp.ops[pos]
+        if op.hoisted:
+            keys.append((op.post_at + 0.5, pos))
+        else:
+            keys.append((float(pos), pos))
+    return [pos for _, pos in sorted(keys)]
+
+
+def _apply_perm(order: List[int], members: List[int],
+                perm: Tuple[int, ...]) -> List[int]:
+    """Reorder ``members`` (original positions) within ``order`` slots."""
+    slots = [order.index(m) for m in members]
+    out = list(order)
+    for slot, m in zip(sorted(slots), perm):
+        out[slot] = m
+    return out
+
+
+def _simulate(events_by_rank, comms, orders,
+              service_order=None) -> Tuple[set, dict]:
+    schedules = {
+        r: [events_by_rank[r][pos] for pos in orders[r]]
+        for r in events_by_rank
+    }
+    deliv: dict = {}
+    findings = _match.match_schedules(schedules, comms, deliveries=deliv,
+                                      service_order=service_order)
+    return {f.kind for f in findings}, deliv
+
+
+def _group_interleavings(events, members: List[int]) -> List[Tuple[int, ...]]:
+    """Every completion order a concurrency group can exhibit at run
+    time.  The FIFO progress engine pins the relative wire order of
+    same-engine members to post order, so the realizable orders are the
+    riffles of the per-engine-root subsequences (identity excluded).
+
+    NOTE: today ``build_plan`` leaves sub-communicator schedules
+    unrewritten, so every compilable plan's events share one engine
+    root and this returns [] — the realizable set is the singleton post
+    order, and the proof reduces to planned order + rank-service
+    rotations.  The riffle machinery is the contract a future
+    multi-engine (or out-of-order-engine) planner must re-enter, and
+    the unit tests pin it with hand-built foreign-engine events."""
+    by_root: Dict[Tuple, List[int]] = {}
+    for m in members:
+        by_root.setdefault(_deps._engine_root(events[m].comm), []).append(m)
+    seqs = list(by_root.values())
+    if len(seqs) == 1:
+        return []  # one engine: post order IS the only realizable order
+
+    def riffle(parts: List[List[int]]):
+        if all(not p for p in parts):
+            yield ()
+            return
+        for i, p in enumerate(parts):
+            if not p:
+                continue
+            rest = [list(q) for q in parts]
+            head = rest[i].pop(0)
+            for tail in riffle(rest):
+                yield (head,) + tail
+
+    return [perm for perm in riffle([list(s) for s in seqs])
+            if list(perm) != members]
+
+
+def prove_plan(
+    events_by_rank: Dict[int, List[CommEvent]],
+    comms: Dict[Tuple, Tuple[int, ...]],
+    plan: ExecutionPlan,
+    max_interleavings: int = MAX_INTERLEAVINGS,
+) -> bool:
+    """Replay original and planned schedules through the match simulator.
+
+    Configurations explored:
+
+    - the planned wire order itself (hoists applied);
+    - for every concurrency group, every completion order the execution
+      substrate can realize (the FIFO progress engine pins same-engine
+      members to post order; members on different engine roots riffle
+      freely), with all other groups at planned order;
+    - every rotation of the simulator's rank-service order, which
+      exposes matches that depend on which rank happens to progress
+      first (ANY_SOURCE races).
+
+    The plan is accepted only if every replay (a) produces no finding
+    kind the original schedule did not, and (b) delivers the same
+    messages in the same per-channel order — which pins delivered
+    values, since payload content rides sends unchanged.  A replay that
+    stalls shows up as (a): deadlock/unmatched kinds.  Sets
+    ``plan.proved`` and ``plan.proof``.
+    """
+    ranks = sorted(events_by_rank)
+    base_orders = {r: list(range(len(v)))
+                   for r, v in events_by_rank.items()}
+    base_kinds, base_deliv = _simulate(events_by_rank, comms, base_orders)
+    planned = {r: _planned_order(events_by_rank[r], plan.ranks[r])
+               for r in events_by_rank}
+
+    # (orders, service_order) configurations
+    configs: List[Tuple[Dict[int, List[int]], Optional[List[int]]]] = [
+        (planned, None)
+    ]
+    for rank in ranks:
+        rp = plan.ranks[rank]
+        for members in rp.groups:
+            if len(members) < 2:
+                continue
+            for perm in _group_interleavings(events_by_rank[rank],
+                                             members):
+                orders = dict(planned)
+                orders[rank] = _apply_perm(planned[rank], members, perm)
+                configs.append((orders, None))
+    for shift in range(1, len(ranks)):
+        rotated = ranks[shift:] + ranks[:shift]
+        configs.append((planned, rotated))
+
+    exhaustive = len(configs) <= max_interleavings
+    if not exhaustive:
+        configs = configs[:max_interleavings]
+
+    failures: List[str] = []
+    for i, (orders, service) in enumerate(configs):
+        kinds, deliv = _simulate(events_by_rank, comms, orders,
+                                 service_order=service)
+        new_kinds = kinds - base_kinds
+        if new_kinds:
+            failures.append(
+                f"interleaving {i}: new finding kind(s) "
+                f"{sorted(new_kinds)}"
+            )
+        elif deliv != base_deliv:
+            failures.append(
+                f"interleaving {i}: per-channel delivery order changed"
+            )
+        if failures:
+            break
+
+    plan.proof = {
+        "interleavings": len(configs),
+        "exhaustive": exhaustive,
+        "base_finding_kinds": sorted(base_kinds),
+        "failures": failures,
+    }
+    plan.proved = not failures and exhaustive
+    if failures:
+        plan.reasons.extend(failures)
+    elif not exhaustive:
+        plan.reasons.append(
+            f"interleaving budget exceeded ({max_interleavings}); "
+            "plan rejected unproven"
+        )
+    return plan.proved
+
+
+def compile_schedules(
+    events_by_rank: Dict[int, List[CommEvent]],
+    comms: Dict[Tuple, Tuple[int, ...]],
+    *,
+    findings: Sequence[Finding] = (),
+    world_size: Optional[int] = None,
+    value_deps_by_rank: Optional[Dict[int, set]] = None,
+    detach_threshold: Optional[int] = None,
+    coalesce_bytes: Optional[int] = None,
+    bucket_bytes: Optional[int] = None,
+    max_interleavings: int = MAX_INTERLEAVINGS,
+) -> ExecutionPlan:
+    """Build the most aggressive provable plan: try hoisting + grouping,
+    fall back to no-hoist, then to the trivial (unrewritten) plan.  The
+    returned plan always carries ``proved`` and the downgrade reasons —
+    an unsafe rewrite is *demonstrably* rejected, never silently run."""
+    kw = dict(
+        world_size=world_size, findings=findings,
+        value_deps_by_rank=value_deps_by_rank,
+        detach_threshold=detach_threshold, coalesce_bytes=coalesce_bytes,
+        bucket_bytes=bucket_bytes,
+    )
+    plan = build_plan(events_by_rank, comms, aggressive=True, **kw)
+    if prove_plan(events_by_rank, comms, plan, max_interleavings):
+        return plan
+    rejected_reasons = list(plan.reasons)
+
+    fallback = build_plan(events_by_rank, comms, aggressive=False, **kw)
+    fallback.reasons = rejected_reasons + [
+        "hoisted plan rejected by the equivalence prover; "
+        "retrying without recv hoisting"
+    ]
+    if prove_plan(events_by_rank, comms, fallback, max_interleavings):
+        fallback.reasons = [r for r in fallback.reasons
+                            if not r.startswith("interleaving ")]
+        return fallback
+
+    trivial = build_plan(events_by_rank, comms, aggressive=False,
+                         force_trivial=True, **kw)
+    trivial.reasons = [
+        "grouped plan rejected by the equivalence prover; "
+        "schedule left unrewritten"
+    ]
+    prove_plan(events_by_rank, comms, trivial, max_interleavings)
+    return trivial
+
+
+# ---------------------------------------------------------------------------
+# plan cache (per jaxpr/schedule hash)
+
+
+def plan_cache_dir() -> str:
+    base = os.environ.get("XDG_CACHE_HOME",
+                          os.path.join(os.path.expanduser("~"), ".cache"))
+    return os.path.join(base, "mpi4jax_tpu", "plans")
+
+
+def plan_cache_path(cache_key: str) -> str:
+    return os.path.join(plan_cache_dir(), f"{cache_key}.json")
+
+
+def save_plan(plan: ExecutionPlan, path: Optional[str] = None) -> str:
+    path = path or plan_cache_path(plan.cache_key)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(plan.to_json(), f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def load_plan(path: str) -> ExecutionPlan:
+    with open(path) as f:
+        data = json.load(f)
+    plan = ExecutionPlan.from_json(data)
+    if plan.analyzer_version != ANALYZER_VERSION:
+        raise ValueError(
+            f"plan at {path} was compiled by analyzer "
+            f"{plan.analyzer_version!r}, this is {ANALYZER_VERSION!r} — "
+            "recompile (the cache key embeds the version exactly so "
+            "stale plans invalidate instead of misexecuting)"
+        )
+    return plan
+
+
+def cached_plan(cache_key: str) -> Optional[ExecutionPlan]:
+    """The cached verified plan for a schedule hash, or None (missing,
+    unreadable, version-mismatched, or never proved)."""
+    path = plan_cache_path(cache_key)
+    try:
+        plan = load_plan(path)
+    except (OSError, ValueError, KeyError, json.JSONDecodeError):
+        return None
+    if plan.cache_key != cache_key or not plan.proved:
+        return None
+    return plan

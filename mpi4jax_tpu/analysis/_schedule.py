@@ -80,10 +80,45 @@ class _Extractor:
         self.events: List[CommEvent] = []
         self.findings: List[Finding] = []
         self.truncated = False
+        #: jaxpr buffer use/def chains, reduced to event coordinates:
+        #: (producer_pos, consumer_pos) pairs where the consumer's
+        #: payload is computed from the producer's output.  Token
+        #: operands/results are deliberately EXCLUDED from propagation —
+        #: the token edge is the artificial serialization the schedule
+        #: compiler (analysis._plan) is licensed to overlap across;
+        #: these pairs are the true data dependencies it must keep.
+        self.value_deps: set = set()
+        self._var_deps = {}   # top-level Var -> frozenset of event pos
+
+    # -- value-dependence bookkeeping (jaxpr buffer use/def chains) -----
+
+    def _deps_of(self, invars, drop_token=False):
+        from jax._src import core as jcore
+
+        vs = [v for v in invars if isinstance(v, jcore.Var)]
+        if drop_token and vs:
+            vs = vs[:-1]  # trailing operand is the explicit token
+        out = frozenset()
+        for v in vs:
+            out |= self._var_deps.get(v, frozenset())
+        return out
+
+    def _set_deps(self, outvars, deps, drop_token=False):
+        from jax._src import core as jcore
+
+        vs = list(outvars)
+        if drop_token and len(vs) > 1:
+            # the token result carries NO data dependence: the token edge
+            # is the artificial serialization the plan may overlap across
+            self._var_deps[vs[-1]] = frozenset()
+            vs = vs[:-1]
+        for v in vs:
+            if isinstance(v, jcore.Var):
+                self._var_deps[v] = deps
 
     # -- events ---------------------------------------------------------
 
-    def _emit(self, eqn, pos):
+    def _emit(self, eqn, pos, top=False):
         from ..ops import _world_impl
 
         sig = _world_impl.schedule_signature(eqn.primitive.name)
@@ -91,8 +126,12 @@ class _Extractor:
             return False
         base, spec, token_variant = sig
         params = eqn.params
+        ins = self._deps_of(eqn.invars, drop_token=token_variant) \
+            if top else frozenset()
         if params.get("transpose"):
-            return True  # transposed allreduce lowers to identity: no comm
+            if top:  # identity pass: data flows through, no comm
+                self._set_deps(eqn.outvars, ins, drop_token=token_variant)
+            return True
         if len(self.events) >= MAX_EVENTS_PER_RANK:
             if not self.truncated:
                 self.truncated = True
@@ -102,6 +141,8 @@ class _Extractor:
                     f"{MAX_EVENTS_PER_RANK} events; truncated",
                     ranks=(self.rank,),
                 ))
+            if top:
+                self._set_deps(eqn.outvars, ins, drop_token=token_variant)
             return True
         comm = params.get("comm")
         fields = {}
@@ -121,49 +162,105 @@ class _Extractor:
             aval = data_vars[0].aval
             dtype = str(aval.dtype)
             shape = tuple(aval.shape)
+        epos = len(self.events)
         self.events.append(CommEvent(
             rank=self.rank,
-            idx=len(self.events),
+            idx=epos,
             kind=spec["kind"],
             comm=_comm_key(comm),
             dtype=dtype,
             shape=shape,
             site=_site_of(eqn, pos),
+            status=params.get("status") is not None,
             **fields,
         ))
+        if top:
+            for d in ins:
+                self.value_deps.add((d, epos))
+            self._set_deps(eqn.outvars, ins | {epos},
+                           drop_token=token_variant)
         return True
 
     # -- recursion ------------------------------------------------------
 
-    def walk(self, jaxpr):
+    def _absorb_region(self, eqn, before: int, top: bool):
+        """Conservative value-dependence treatment of a higher-order
+        region (scan/while/cond/opaque call) whose internal dataflow is
+        not tracked var-by-var: the region's events are chained in order
+        (no reordering inside), every event depends on the eqn's inputs,
+        and the eqn's outputs depend on everything inside."""
+        if not top:
+            return
+        after = len(self.events)
+        ins = self._deps_of(eqn.invars)
+        inside = list(range(before, after))
+        for a, b in zip(inside, inside[1:]):
+            self.value_deps.add((a, b))
+        for e in inside:
+            for d in ins:
+                self.value_deps.add((d, e))
+        self._set_deps(eqn.outvars, ins | set(inside))
+
+    def _inline_call(self, eqn, sub, top: bool) -> bool:
+        """Precise inlining for single-body call primitives (pjit,
+        remat, custom_jvp/vjp bodies): outer operands map 1:1 onto the
+        body's invars, so the use/def chains stay var-accurate through
+        the call boundary instead of degrading to an opaque region."""
+        from jax._src import core as jcore
+
+        if len(sub.invars) != len(eqn.invars):
+            return False
+        if top:
+            for outer, inner in zip(eqn.invars, sub.invars):
+                if isinstance(outer, jcore.Var) and \
+                        isinstance(inner, jcore.Var):
+                    self._var_deps[inner] = self._var_deps.get(
+                        outer, frozenset())
+        self.walk(sub, top=top)
+        if top:
+            deps = self._deps_of(sub.outvars)
+            outs = len(eqn.outvars)
+            if len(sub.outvars) == outs:
+                for outer, inner in zip(eqn.outvars, sub.outvars):
+                    if isinstance(outer, jcore.Var):
+                        self._var_deps[outer] = (
+                            self._var_deps.get(inner, frozenset())
+                            if isinstance(inner, jcore.Var)
+                            else frozenset())
+            else:
+                self._set_deps(eqn.outvars, deps)
+        return True
+
+    def walk(self, jaxpr, top=True):
         self._token_pass(jaxpr)
         for pos, eqn in enumerate(jaxpr.eqns):
             if self.truncated:
                 return
-            if self._emit(eqn, pos):
+            if self._emit(eqn, pos, top=top):
                 continue
             name = eqn.primitive.name
             params = eqn.params
             if name == "scan":
                 body = params["jaxpr"].jaxpr
                 length = int(params.get("length", 1))
+                before = len(self.events)
                 if length > 0:
-                    before = len(self.events)
-                    self.walk(body)
+                    self.walk(body, top=False)
                     per_iter = len(self.events) - before
                     if per_iter:
                         for _ in range(length - 1):
                             if self.truncated:
                                 return
-                            self.walk(body)
+                            self.walk(body, top=False)
+                self._absorb_region(eqn, before, top)
             elif name == "while":
                 # runtime order is cond, body, cond, ... — one iteration
                 # assumed: cond events first, then the body's
                 before = len(self.events)
                 cond = params.get("cond_jaxpr")
                 if cond is not None:
-                    self.walk(cond.jaxpr)
-                self.walk(params["body_jaxpr"].jaxpr)
+                    self.walk(cond.jaxpr, top=False)
+                self.walk(params["body_jaxpr"].jaxpr, top=False)
                 if len(self.events) > before:
                     self.findings.append(Finding(
                         "comm_in_while",
@@ -174,6 +271,7 @@ class _Extractor:
                         ranks=(self.rank,),
                         sites=(_site_of(eqn, pos),),
                     ))
+                self._absorb_region(eqn, before, top)
             elif name == "cond":
                 branches = params.get("branches", ())
                 sub_schedules = []
@@ -200,16 +298,27 @@ class _Extractor:
                         ranks=(self.rank,),
                         sites=(_site_of(eqn, pos),),
                     ))
+                base = len(self.events)
                 if sub_schedules:
-                    base = len(self.events)
                     chosen = sub_schedules[0]
                     for e in chosen.events:
                         e.idx = base + e.idx
                         self.events.append(e)
                     self.findings.extend(chosen.findings)
+                self._absorb_region(eqn, base, top)
             else:
-                for sub in _sub_jaxprs(params):
-                    self.walk(sub)
+                subs = _sub_jaxprs(params)
+                if not subs:
+                    if top:  # pure compute: dataflow passes through
+                        self._set_deps(eqn.outvars,
+                                       self._deps_of(eqn.invars))
+                    continue
+                if len(subs) == 1 and self._inline_call(eqn, subs[0], top):
+                    continue
+                before = len(self.events)
+                for sub in subs:
+                    self.walk(sub, top=False)
+                self._absorb_region(eqn, before, top)
 
     # -- static token discipline ---------------------------------------
 
@@ -298,8 +407,16 @@ class _Extractor:
 
 def trace_rank_schedule(fn, args, kwargs, rank: int, world_size: int,
                         comm=None
-                        ) -> Tuple[List[CommEvent], List[Finding]]:
+                        ) -> Tuple[List[CommEvent], List[Finding], set]:
     """Trace ``fn`` for one simulated rank; abstract eval only.
+
+    Returns ``(events, findings, value_deps)`` — ``value_deps`` is the
+    jaxpr's buffer use/def chains reduced to event coordinates: the set
+    of ``(producer_pos, consumer_pos)`` pairs where the consumer's
+    payload is computed from the producer's output.  Token edges are
+    excluded by construction, so the pair set is exactly the *true data
+    dependence* the schedule compiler must preserve (everything else is
+    token serialization it may overlap across).
 
     The trace-time token chain guard's warnings are captured as
     ``token_violation`` findings: the guard sees the *user-level* chain
@@ -337,9 +454,9 @@ def trace_rank_schedule(fn, args, kwargs, rank: int, world_size: int,
             f"{type(err).__name__}: {err}",
             ranks=(rank,),
         ))
-        return [], guard_findings
+        return [], guard_findings, set()
     finally:
         _world_impl._set_analysis_token_hooks(old_trace, old_warn)
     ex = _Extractor(rank, world_size)
     ex.walk(closed.jaxpr)
-    return ex.events, ex.findings + guard_findings
+    return ex.events, ex.findings + guard_findings, ex.value_deps

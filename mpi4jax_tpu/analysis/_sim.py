@@ -144,6 +144,7 @@ class VirtualWorld:
             dtype=None if data is None else str(data.dtype),
             shape=None if data is None else tuple(data.shape),
             site=self._site(),
+            status=params.get("status") is not None,
             **fields,
         )
 
@@ -668,6 +669,8 @@ class VirtualWorld:
                     )])
             self._record_locked(
                 _match.order_critical_findings(self.schedules, self.comms))
+        from ._events import schedule_cache_key
+
         return Report(
             world_size=self.size,
             target=self.program,
@@ -675,4 +678,7 @@ class VirtualWorld:
             schedules={r: [e.describe() for e in evs]
                        for r, evs in self.schedules.items()},
             output=out_buf.getvalue(),
+            events=dict(self.schedules),
+            comms=dict(self.comms),
+            cache_key=schedule_cache_key(self.schedules, self.size),
         )

@@ -843,6 +843,39 @@ def _elementwise_batching(p):
 
 
 # ---------------- host-side executors ----------------
+#
+# Every executor consults the schedule-plan runner (runtime/planrt.py)
+# first: with MPI4JAX_TPU_PLAN off (the default) that is one module-
+# global boolean; with a verified plan installed, sends/recvs may post
+# as non-blocking tickets on the progress engine (deferred completions,
+# pre-posted hoisted receives) and every other op is signature-checked
+# against the plan before running its historic path — a diverging op
+# stream disables the plan loudly and falls back.
+
+
+_planrt = None
+
+
+def _plan_runner(comm):
+    # module reference cached after the first call: this sits on the
+    # per-op dispatch path whose microseconds PR 5 fought for, and with
+    # plans off the whole detour is one cached-attribute + one cached-
+    # env check inside planrt.get
+    global _planrt
+    if _planrt is None:
+        from ..runtime import planrt as _p
+
+        _planrt = _p
+    return _planrt.get(comm)
+
+
+def _plan_sync(comm, kind, execute, **sig):
+    """Run a non-accelerated op under the plan runner's cursor (or
+    directly when no plan serves this comm)."""
+    rt = _plan_runner(comm)
+    if rt is None:
+        return execute()
+    return rt.run_sync(kind, execute, **sig)
 
 
 def _coll_algo_detail(comm, opname, nbytes):
@@ -872,8 +905,12 @@ def _host_allreduce(x, *, comm, op):
                 f"{_coll_algo_detail(comm, 'allreduce', x.nbytes)}",
         nbytes=x.nbytes,
     ):
-        return bridge.allreduce(comm.handle, x, _OP_CODE[op.name],
-                                reuse=_reuse_ok())
+        return _plan_sync(
+            comm, "allreduce",
+            lambda: bridge.allreduce(comm.handle, x, _OP_CODE[op.name],
+                                     reuse=_reuse_ok()),
+            reduce_op=op.name, nbytes=x.nbytes,
+        )
 
 
 def _host_reduce(x, *, comm, op, root):
@@ -881,8 +918,12 @@ def _host_reduce(x, *, comm, op, root):
 
     with tracing.CallTrace(comm.rank(), "Reduce", f"op {op.name} root {root}",
                            peer=root, nbytes=x.nbytes):
-        return bridge.reduce(comm.handle, x, _OP_CODE[op.name], root,
-                             reuse=_reuse_ok())
+        return _plan_sync(
+            comm, "reduce",
+            lambda: bridge.reduce(comm.handle, x, _OP_CODE[op.name], root,
+                                  reuse=_reuse_ok()),
+            reduce_op=op.name, root=root, nbytes=x.nbytes,
+        )
 
 
 def _host_scan(x, *, comm, op):
@@ -890,8 +931,12 @@ def _host_scan(x, *, comm, op):
 
     with tracing.CallTrace(comm.rank(), "Scan", f"op {op.name}",
                            nbytes=x.nbytes):
-        return bridge.scan(comm.handle, x, _OP_CODE[op.name],
-                           reuse=_reuse_ok())
+        return _plan_sync(
+            comm, "scan",
+            lambda: bridge.scan(comm.handle, x, _OP_CODE[op.name],
+                                reuse=_reuse_ok()),
+            reduce_op=op.name, nbytes=x.nbytes,
+        )
 
 
 def _host_bcast(x, *, comm, root):
@@ -899,7 +944,9 @@ def _host_bcast(x, *, comm, root):
 
     with tracing.CallTrace(comm.rank(), "Bcast", f"root {root}",
                            peer=root, nbytes=x.nbytes):
-        return bridge.bcast(comm.handle, x, root)
+        return _plan_sync(comm, "bcast",
+                          lambda: bridge.bcast(comm.handle, x, root),
+                          root=root, nbytes=x.nbytes)
 
 
 def _host_allgather(x, *, comm):
@@ -910,8 +957,12 @@ def _host_allgather(x, *, comm):
         lambda: f"algo {_coll_algo_detail(comm, 'allgather', x.nbytes)}",
         nbytes=x.nbytes,
     ):
-        return bridge.allgather(comm.handle, x, comm.size(),
-                                reuse=_reuse_ok())
+        return _plan_sync(
+            comm, "allgather",
+            lambda: bridge.allgather(comm.handle, x, comm.size(),
+                                     reuse=_reuse_ok()),
+            nbytes=x.nbytes,
+        )
 
 
 def _host_gather(x, *, comm, root):
@@ -921,7 +972,12 @@ def _host_gather(x, *, comm, root):
                            peer=root, nbytes=x.nbytes):
         # root gets (size, *x.shape); non-root sends and gets x back
         # (exact reference contract, gather.py:86-96,213-226 there)
-        return bridge.gather(comm.handle, x, comm.size(), root, comm.rank())
+        return _plan_sync(
+            comm, "gather",
+            lambda: bridge.gather(comm.handle, x, comm.size(), root,
+                                  comm.rank()),
+            root=root, nbytes=x.nbytes,
+        )
 
 
 def _host_scatter(x, *, comm, root):
@@ -929,14 +985,18 @@ def _host_scatter(x, *, comm, root):
 
     with tracing.CallTrace(comm.rank(), "Scatter", f"root {root}",
                            peer=root, nbytes=x.nbytes):
-        return bridge.scatter(comm.handle, x, root)
+        return _plan_sync(comm, "scatter",
+                          lambda: bridge.scatter(comm.handle, x, root),
+                          root=root, nbytes=x.nbytes)
 
 
 def _host_alltoall(x, *, comm):
     from ..runtime import bridge
 
     with tracing.CallTrace(comm.rank(), "Alltoall", "", nbytes=x.nbytes):
-        return bridge.alltoall(comm.handle, x)
+        return _plan_sync(comm, "alltoall",
+                          lambda: bridge.alltoall(comm.handle, x),
+                          nbytes=x.nbytes)
 
 
 def _host_shift2(x, *, comm, lo, hi, tag):
@@ -944,14 +1004,16 @@ def _host_shift2(x, *, comm, lo, hi, tag):
 
     with tracing.CallTrace(comm.rank(), "Shift2", f"lo {lo} hi {hi}",
                            peer=hi, nbytes=x.nbytes, tag=tag):
-        return bridge.shift2(comm.handle, x, lo, hi, tag)
+        return _plan_sync(comm, "shift2",
+                          lambda: bridge.shift2(comm.handle, x, lo, hi, tag),
+                          lo=lo, hi=hi, tag=tag, nbytes=x.nbytes)
 
 
 def _host_barrier(*, comm):
     from ..runtime import bridge
 
     with tracing.CallTrace(comm.rank(), "Barrier", ""):
-        bridge.barrier(comm.handle)
+        _plan_sync(comm, "barrier", lambda: bridge.barrier(comm.handle))
     return np.zeros((), np.int32)
 
 
@@ -960,7 +1022,9 @@ def _host_send(x, *, comm, dest, tag):
 
     with tracing.CallTrace(comm.rank(), "Send", f"to {dest} tag {tag}",
                            peer=dest, nbytes=x.nbytes, tag=tag):
-        bridge.send(comm.handle, x, dest, tag)
+        rt = _plan_runner(comm)
+        if rt is None or not rt.run_send(x, dest, tag):
+            bridge.send(comm.handle, x, dest, tag)
     return np.zeros((), np.int32)
 
 
@@ -969,13 +1033,25 @@ def _host_recv(x, *, comm, source, tag, status=None):
 
     with tracing.CallTrace(comm.rank(), "Recv", f"from {source} tag {tag}",
                            peer=source, nbytes=x.nbytes, tag=tag):
+        rt = _plan_runner(comm)
         if status is None:
+            if rt is not None:
+                out = rt.run_recv(x.shape, x.dtype, source, tag,
+                                  reuse=_reuse_ok())
+                if out is not None:
+                    return out
             # strict path: arrived size must equal the buffer exactly
             return bridge.recv(comm.handle, x.shape, x.dtype, source, tag,
                                reuse=_reuse_ok())
-        out, src, tg, cnt = bridge.recv_status(
-            comm.handle, x.shape, x.dtype, source, tag
-        )
+        def _ex():
+            return bridge.recv_status(
+                comm.handle, x.shape, x.dtype, source, tag
+            )
+        if rt is not None:
+            out, src, tg, cnt = rt.run_sync("recv", _ex, source=source,
+                                            tag=tag)
+        else:
+            out, src, tg, cnt = _ex()
     status.obj._fill(src, tg, cnt)
     return out
 
@@ -988,13 +1064,20 @@ def _host_sendrecv(x, *, comm, source, dest, sendtag, recvtag, status=None):
         peer=dest, nbytes=2 * x.nbytes, tag=sendtag,
     ):
         if status is None and sendtag == recvtag:
-            return bridge.sendrecv(
-                comm.handle, x, x.shape, x.dtype, source, dest, sendtag,
-                reuse=_reuse_ok()
+            return _plan_sync(
+                comm, "sendrecv",
+                lambda: bridge.sendrecv(
+                    comm.handle, x, x.shape, x.dtype, source, dest,
+                    sendtag, reuse=_reuse_ok()),
+                dest=dest, source=source, sendtag=sendtag,
+                recvtag=recvtag,
             )
-        out, src, tg, cnt = bridge.sendrecv_status(
-            comm.handle, x, x.shape, x.dtype, source, dest, sendtag,
-            recvtag,
+        out, src, tg, cnt = _plan_sync(
+            comm, "sendrecv",
+            lambda: bridge.sendrecv_status(
+                comm.handle, x, x.shape, x.dtype, source, dest, sendtag,
+                recvtag),
+            dest=dest, source=source, sendtag=sendtag, recvtag=recvtag,
         )
     if status is None:
         # no status to report a short message through: keep the strict

@@ -147,6 +147,14 @@ def _finish_lib_setup(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.tpucomm_execute.restype = ctypes.c_int
         lib.tpucomm_execute.argtypes = [ctypes.c_int64, ctypes.c_void_p]
         _exec_fn = lib.tpucomm_execute
+    # ticketed non-blocking posting (schedule-plan execution); guarded
+    # like split/dup: a stale prebuilt .so simply reports plans
+    # unavailable (post_available) instead of failing at load
+    if hasattr(lib, "tpucomm_post"):
+        lib.tpucomm_post.restype = ctypes.c_int64
+        lib.tpucomm_post.argtypes = [ctypes.c_int64, ctypes.c_void_p]
+        lib.tpucomm_wait_ticket.restype = ctypes.c_int
+        lib.tpucomm_wait_ticket.argtypes = [ctypes.c_int64, ctypes.c_int64]
     # guarded: a stale prebuilt .so without split/dup must still serve
     # the other ops (split then fails at call time, not load time)
     if hasattr(lib, "tpucomm_split"):
@@ -230,6 +238,12 @@ def ffi_available() -> bool:
     if _ffi_status is not None:
         return _ffi_status
     if config.ffi_disabled():
+        _ffi_status = False
+        return False
+    if config.plan_spec() is not None:
+        # schedule-plan execution lives in the host-executor layer; the
+        # native FFI custom calls would bypass the plan runner entirely,
+        # so a plan-enabled process keeps the callback dispatch route
         _ffi_status = False
         return False
     try:
@@ -368,6 +382,19 @@ def comm_init(rank: int, size: int, coord: str, hosts=None) -> int:
 
     if config.trace_path() is not None or obs.enabled():
         _install_obs(lib, handle, rank, size)
+    # schedule-plan execution: when MPI4JAX_TPU_PLAN names a verified
+    # plan file (launch --plan), attach this rank's schedule to the
+    # world comm.  Soft like the tune install above: a bad plan file
+    # warns and the job runs the historic path.
+    if config.plan_spec() is not None:
+        try:
+            from . import planrt
+
+            planrt.maybe_install_from_env(handle, rank, size)
+        except Exception as e:  # pragma: no cover - defensive
+            import warnings
+
+            warnings.warn(f"schedule-plan install failed: {e}")
     return handle
 
 
@@ -563,6 +590,82 @@ def _ptr(a: np.ndarray):
 
 def _i64(v) -> ctypes.c_int64:
     return ctypes.c_int64(int(v))
+
+
+# ---------------- ticketed non-blocking posting (plan execution) ----------
+#
+# The schedule-plan runner (runtime/planrt.py) posts descriptors on the
+# progress engine WITHOUT waiting: hoisted receives start reading the
+# wire during host compute, deferred sends stream without blocking the
+# callback.  The engine drains FIFO, so post order is wire order — the
+# exact contract the analysis-side equivalence prover verified.  Every
+# ticket must be waited exactly once (the runner owns that bookkeeping,
+# including keeping the numpy buffers alive until the wait returns).
+
+
+def post_available() -> bool:
+    """True when the loaded .so carries the ticketed posting entry."""
+    return hasattr(get_lib(), "tpucomm_post")
+
+
+def _post(handle, d: "_OpExec") -> int:
+    lib = get_lib()
+    ticket = lib.tpucomm_post(_i64(handle), ctypes.byref(d))
+    if ticket == 0:
+        _abort("Post", 1)
+    return ticket
+
+
+def post_send(handle, buf: np.ndarray, dest: int, tag: int):
+    """Non-blocking send post.  Returns ``(ticket, keepalive)`` — hold
+    ``keepalive`` (the contiguous payload and its descriptor) until
+    :func:`wait_ticket` returns for this ticket.
+
+    OWNERSHIP CONTRACT: the caller must own ``buf``'s storage for the
+    ticket's whole lifetime.  A host-callback operand ndarray does NOT
+    qualify — it aliases an XLA-owned buffer that is only valid for the
+    callback's duration, and the progress thread reads the descriptor
+    later.  The plan runner (runtime/planrt.py) satisfies this with
+    pooled payload copies; drive this entry directly only with buffers
+    you allocated."""
+    buf = _contig(buf)
+    d = _OpExec()
+    d.kind = _K_SEND
+    d.sbuf = _data_ptr(buf)
+    d.snbytes = buf.nbytes
+    d.peer = dest
+    d.tag = tag
+    return _post(handle, d), (buf, d)
+
+
+def post_recv_into(handle, out: np.ndarray, source: int, tag: int):
+    """Non-blocking recv post into a caller-owned buffer (same
+    ownership contract as :func:`post_send`: ``out`` must stay alive
+    and unread until :func:`wait_ticket` returns for the ticket).
+    Returns ``(ticket, keepalive)``."""
+    d = _OpExec()
+    d.kind = _K_RECV
+    d.rbuf = _data_ptr(out)
+    d.rnbytes = out.nbytes
+    d.peer2 = source
+    d.tag = tag
+    return _post(handle, d), d
+
+
+def post_recv(handle, shape, dtype, source: int, tag: int):
+    """Non-blocking recv post into a fresh buffer.  Returns
+    ``(ticket, out, keepalive)``; ``out`` is valid after
+    :func:`wait_ticket` returns 0 for the ticket."""
+    out = np.empty(shape, dtype)
+    ticket, d = post_recv_into(handle, out, source, tag)
+    return ticket, out, d
+
+
+def wait_ticket(handle, ticket: int) -> None:
+    """Block until a posted op completes; aborts the process on a
+    nonzero op result exactly like the synchronous entry points."""
+    rc = get_lib().tpucomm_wait_ticket(_i64(handle), ctypes.c_int64(ticket))
+    _check("WaitTicket", rc)
 
 
 def split(handle, color: int, key: int):

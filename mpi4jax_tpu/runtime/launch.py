@@ -150,6 +150,91 @@ def _preflight_verify(prog: str, np_: int, prog_args=()) -> int:
     return res.returncode or 2
 
 
+def _preflight_plan(prog: str, np_: int, prog_args=(),
+                    enforce_verify: bool = False):
+    """Compile + verify ``prog``'s execution plan before spawning any
+    rank (the schedule compiler, docs/analysis.md § "From verifier to
+    compiler").  Returns ``(rc, plan_path)``: nonzero ``rc`` aborts the
+    launch (only possible with ``enforce_verify``, which folds the
+    ``--verify`` gate into this single analyzer run instead of tracing
+    the program twice); an empty ``plan_path`` means no plan should be
+    installed — compile failure, an unproved plan, or an unrewritten
+    one (exporting a trivial plan would cost the FFI fast path and
+    per-op bookkeeping for zero overlap benefit) — and the job runs the
+    historic token-order path, which is always correct."""
+    import tempfile
+
+    fd, plan_path = tempfile.mkstemp(prefix="m4j_plan_", suffix=".json")
+    os.close(fd)
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env.setdefault("PYTHONPATH", repo)
+    res = subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analyze", prog,
+         "--np", str(np_), "--errors-only", "--emit-plan", plan_path,
+         "--", *prog_args],
+        capture_output=True, text=True, env=env,
+    )
+    if res.returncode == 3 and enforce_verify:
+        print(f"[launch] --verify FAILED for {prog} at np={np_} — "
+              "no rank was spawned:", file=sys.stderr)
+        sys.stderr.write(res.stdout)
+        sys.stderr.write(res.stderr)
+        sys.stderr.flush()
+        os.unlink(plan_path)
+        return 3, ""
+    if enforce_verify and res.returncode == 0 and "WARNING" in res.stdout:
+        # same surfacing contract as plain --verify: warnings document
+        # assumptions and must not get quieter because --plan rode along
+        print(f"[launch] --verify: {prog} has warnings at np={np_} "
+              "(launch proceeds):", file=sys.stderr)
+        sys.stderr.write(res.stdout)
+        sys.stderr.flush()
+    if res.returncode not in (0, 3):
+        if enforce_verify:
+            print(f"[launch] --verify could not run the analyzer "
+                  f"(exit {res.returncode}):", file=sys.stderr)
+            sys.stderr.write(res.stderr[-2000:])
+            sys.stderr.flush()
+            os.unlink(plan_path)
+            return res.returncode or 2, ""
+        print(f"[launch] --plan: schedule compiler could not run "
+              f"(exit {res.returncode}); running without a plan:",
+              file=sys.stderr)
+        sys.stderr.write(res.stderr[-2000:])
+        sys.stderr.flush()
+        os.unlink(plan_path)
+        return 0, ""
+    try:
+        import json as _json
+
+        with open(plan_path) as f:
+            plan = _json.load(f)
+        proved = bool(plan.get("proved"))
+        rewritten = bool(plan.get("rewritten"))
+        reasons = plan.get("reasons", [])
+    except Exception as e:
+        print(f"[launch] --plan: cannot read compiled plan: {e}",
+              file=sys.stderr, flush=True)
+        os.unlink(plan_path)
+        return 0, ""
+    if not (proved and rewritten):
+        state = "NOT proved equivalent" if not proved else "unrewritten"
+        print(f"[launch] --plan: plan for {prog} at np={np_} is "
+              f"{state}; running without a plan:"
+              + "".join(f"\n    {r}" for r in reasons),
+              file=sys.stderr, flush=True)
+        os.unlink(plan_path)
+        return 0, ""
+    print(f"[launch] --plan: verified plan "
+          f"{plan.get('cache_key', '?')} for {prog} at np={np_}"
+          + "".join(f"\n    note: {r}" for r in reasons),
+          file=sys.stderr, flush=True)
+    return 0, plan_path
+
+
 def _merge_trace(out_path: str, np_: int) -> None:
     """Merge the per-rank recordings into one Perfetto-loadable Chrome
     trace at ``out_path``.  Best effort — a failed job may have dumped
@@ -225,6 +310,17 @@ def main(argv=None):
                              "mpi4jax_tpu.analyze) and exit 3 with the "
                              "findings table when it fails — BEFORE any "
                              "rank is spawned")
+    parser.add_argument("--plan", action="store_true",
+                        help="pre-flight: compile the program's "
+                             "communication schedule into a verified "
+                             "execution plan (python -m mpi4jax_tpu."
+                             "analyze --emit-plan) and run every rank "
+                             "with MPI4JAX_TPU_PLAN pointing at it — "
+                             "hoisted recv posts and deferred send "
+                             "completions on the progress engine.  An "
+                             "unprovable plan falls back to the "
+                             "historic path with a notice "
+                             "(docs/analysis.md)")
     parser.add_argument("--trace", default=None, metavar="OUT.json",
                         help="record every rank's per-op events "
                              "(MPI4JAX_TPU_TRACE) and merge them into one "
@@ -236,17 +332,26 @@ def main(argv=None):
     parser.add_argument("args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
 
-    if args.verify:
-        rc = _preflight_verify(args.prog, args.np, args.args)
-        if rc != 0:
-            return rc
-
     if args.hosts:
         nhosts = len(args.hosts.split(","))
         if nhosts != args.np:
             parser.error(
                 f"--hosts lists {nhosts} entries for {args.np} ranks"
             )
+
+    plan_path = ""
+    if args.plan:
+        # one analyzer run serves both gates: with --verify it enforces
+        # the findings verdict too (tracing a large program twice would
+        # double the pre-launch cost for nothing)
+        rc, plan_path = _preflight_plan(args.prog, args.np, args.args,
+                                        enforce_verify=args.verify)
+        if rc != 0:
+            return rc
+    elif args.verify:
+        rc = _preflight_verify(args.prog, args.np, args.args)
+        if rc != 0:
+            return rc
 
     if args.trace:
         # stale parts from a previous run at the same path (possibly a
@@ -310,6 +415,8 @@ def main(argv=None):
             env["MPI4JAX_TPU_JOBID"] = jobid
             if args.trace:
                 env["MPI4JAX_TPU_TRACE"] = os.path.abspath(args.trace)
+            if plan_path:
+                env["MPI4JAX_TPU_PLAN"] = plan_path
             if args.hosts:
                 env["MPI4JAX_TPU_HOSTS"] = args.hosts
             if args.platform:
@@ -400,6 +507,11 @@ def main(argv=None):
         signal.signal(signal.SIGTERM, old_term)
         for pump in pumps:
             pump.join(timeout=2.0)
+        if plan_path:  # every exit path, not just straight-line success
+            try:
+                os.unlink(plan_path)
+            except OSError:
+                pass
 
     if args.trace:
         _merge_trace(os.path.abspath(args.trace), args.np)

@@ -1,0 +1,424 @@
+"""Runtime consumption of verified execution plans (schedule compiler).
+
+The analysis-side schedule compiler (``analysis/_plan.py``) turns a
+statically-extracted per-rank schedule into an :class:`ExecutionPlan`
+whose equivalence the match simulator has proven.  This module executes
+it: a :class:`PlanRunner` installed on a communicator shadows the op
+stream the host executors see and, where the plan licenses it,
+
+- **pre-posts hoisted receives** — at the plan's post point the recv's
+  descriptor goes onto the progress engine as a non-blocking ticket
+  (``bridge.post_recv``), so the wire drains into the user buffer while
+  the host is still computing; the recv's own callback then merely waits
+  the ticket;
+- **defers send completions** — sends past the buffered-send threshold
+  post as tickets (``bridge.post_send``) instead of parking the callback
+  until the wire write finishes; the wait happens lazily at the next
+  synchronous op (FIFO: by then it costs nothing).  Sends at or below
+  the threshold keep the native detached path, which also preserves
+  their coalescing eligibility;
+- leaves everything else exactly on the historic path, in exact
+  program order (the engine queue drains FIFO, so wire order never
+  deviates from what the prover verified).
+
+Safety: the runner matches every runtime op against the plan's op
+signatures.  Any mismatch — a program whose runtime schedule diverges
+from the verified static schedule — permanently disables the plan for
+that communicator (loudly), drains every outstanding ticket, and falls
+back to direct execution.  ``MPI4JAX_TPU_PLAN=0`` (or unset) keeps this
+module entirely inert: one module-level boolean guards the hot path.
+
+Import-light by design (numpy + stdlib + the jax-free analysis plan
+module): bridge-level world programs can exercise plan execution in any
+container, the same contract the PR 5 coalescing tests rely on.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Dict, List, Optional
+
+import numpy as np
+
+#: handle -> PlanRunner; empty = every hook is one boolean check
+_runners: Dict[int, "PlanRunner"] = {}
+_active = False
+
+
+def _plan_mod():
+    from ..analysis import _plan
+
+    return _plan
+
+
+_spec_cache = False  # False = unresolved (None is a valid resolution)
+
+
+def plan_spec() -> Optional[str]:
+    """The raw MPI4JAX_TPU_PLAN value, or None when plan execution is
+    off (unset, empty, or an explicit falsy value — the pre-plan
+    behavior, bit-for-bit).  Resolved once: the knob is a job-level
+    setting (the launcher exports it before any rank starts), and this
+    sits on the per-op hot path."""
+    global _spec_cache
+    if _spec_cache is False:
+        raw = os.environ.get("MPI4JAX_TPU_PLAN", "").strip()
+        _spec_cache = None \
+            if not raw or raw.lower() in ("0", "false", "off", "no") \
+            else raw
+    return _spec_cache
+
+
+def active() -> bool:
+    return _active
+
+
+def get(comm) -> Optional["PlanRunner"]:
+    """The runner serving ``comm``, or None.
+
+    With plan execution off (no runner installed, MPI4JAX_TPU_PLAN
+    unset) this is one module-global check plus one env read — it never
+    touches ``comm.handle``, so AbstractComms under analysis are safe.
+    With MPI4JAX_TPU_PLAN set but no runner yet, reading ``comm.handle``
+    deliberately triggers lazy communicator creation, whose comm_init
+    hook installs the runner — otherwise the FIRST op of a job would
+    slip past the plan (WorldComm handles are created on first use)."""
+    if _active:
+        try:
+            return _runners.get(comm.handle)
+        except Exception:
+            return None
+    if plan_spec() is None:
+        return None
+    try:
+        handle = comm.handle  # lazy comm creation installs the runner
+    except Exception:
+        return None
+    return _runners.get(handle)
+
+
+def install(handle: int, plan, rank: int) -> bool:
+    """Attach a verified plan's per-rank schedule to a communicator.
+
+    Refuses (False, with a warning) anything the runner cannot execute
+    faithfully: unproven plans, a missing rank, or a native library
+    without the ticketed posting entry."""
+    global _active
+    from . import bridge
+
+    rp = plan.ranks.get(rank)
+    if rp is None:
+        _warn(f"plan {plan.cache_key} has no schedule for rank {rank}")
+        return False
+    if not plan.proved:
+        _warn(f"plan {plan.cache_key} was not proved equivalent; "
+              "refusing to execute it")
+        return False
+    if any(tuple(op.comm) != (0,) for op in rp.ops):
+        # the compiler leaves sub-comm schedules unrewritten; a plan
+        # file carrying sub-comm ops anyway (hand-edited, stale) would
+        # desync this world-comm cursor — refuse it
+        _warn(f"plan {plan.cache_key} contains sub-communicator ops; "
+              "the runner serves the world communicator only")
+        return False
+    if not bridge.post_available():
+        _warn("native library predates ticketed posting (tpucomm_post); "
+              "rebuild native/ to execute schedule plans")
+        return False
+    _runners[int(handle)] = PlanRunner(int(handle), plan, rp)
+    _active = True
+    return True
+
+
+def maybe_install_from_env(handle: int, rank: int, size: int) -> None:
+    """``bridge.comm_init`` hook: when MPI4JAX_TPU_PLAN names a plan
+    file (the ``launch --plan`` wiring), load it and attach this rank's
+    schedule to the world communicator.  Never fatal — a bad plan file
+    degrades to the historic path with a warning, it must not take a
+    healthy job down."""
+    spec = plan_spec()
+    if spec is None or spec.lower() in ("1", "true", "on", "yes", "auto"):
+        return  # bare enable: plans attach via the API / plan cache
+    try:
+        plan = _plan_mod().load_plan(spec)
+    except Exception as err:
+        _warn(f"cannot load MPI4JAX_TPU_PLAN={spec}: {err}")
+        return
+    if plan.world_size != size:
+        _warn(f"plan {plan.cache_key} is for np={plan.world_size}, "
+              f"this job is np={size}; ignoring it")
+        return
+    install(handle, plan, rank)
+
+
+def detach(handle: int) -> None:
+    """Drain and remove a communicator's runner (finalize path)."""
+    global _active
+    rt = _runners.pop(int(handle), None)
+    if rt is not None:
+        rt.flush()
+    if not _runners:
+        _active = False
+
+
+def _warn(msg: str) -> None:
+    print(f"[plan] {msg}", file=sys.stderr, flush=True)
+
+
+#: cap on outstanding tickets per runner: bounds buffer keep-alive
+#: memory; FIFO means waiting the oldest is effectively free by the
+#: time the cap is reached
+MAX_OUTSTANDING = 16
+
+
+class PlanRunner:
+    """Executes one rank's verified plan against the live op stream."""
+
+    def __init__(self, handle: int, plan, rank_plan):
+        self.handle = handle
+        self.plan = plan
+        self.ops = rank_plan.ops
+        self.cursor = 0
+        self.enabled = True
+        # post_point -> positions of hoisted recvs posted right after it
+        self.hoists_after: Dict[int, List[int]] = {}
+        for pos, op in enumerate(self.ops):
+            if op.kind == "recv" and op.post_at < pos:
+                self.hoists_after.setdefault(op.post_at, []).append(pos)
+        self.preposted: Dict[int, tuple] = {}   # pos -> (ticket, out, ka)
+        self.outstanding: List[tuple] = []      # (ticket, ka, pool_buf)
+        # pooled payload-copy buffers for deferred sends, keyed by
+        # (dtype, shape): the callback's operand ndarray aliases
+        # XLA-owned storage that dies with the callback, so the posted
+        # descriptor needs a copy we own — and a FRESH multi-MB buffer
+        # per op costs page faults that would eat the overlap win
+        # (glibc returns big frees to the kernel immediately), so the
+        # copies recycle through this pool as their tickets complete
+        self._send_pool: Dict[tuple, List[np.ndarray]] = {}
+        # pooled pre-post recv buffers, same page-fault rationale.  A
+        # served buffer is recycled in TWO steps: it lands in
+        # ``_recv_recycle_pending`` when returned to the caller and
+        # only moves to the pool at the NEXT runner entry — by then the
+        # serving host callback has finished and XLA has copied the
+        # result out, so the engine may write into the storage again.
+        self._recv_pool: Dict[tuple, List[np.ndarray]] = {}
+        self._recv_recycle_pending: List[np.ndarray] = []
+        self.stats = {"hoisted_recvs": 0, "deferred_sends": 0,
+                      "mismatches": 0}
+
+    # -- bookkeeping ----------------------------------------------------
+
+    def _drain(self) -> None:
+        from . import bridge
+
+        while self.outstanding:
+            ticket, _ka, pool_buf = self.outstanding.pop(0)
+            bridge.wait_ticket(self.handle, ticket)
+            if pool_buf is not None:
+                free = self._send_pool.setdefault(
+                    (pool_buf.dtype, pool_buf.shape), [])
+                if len(free) < MAX_OUTSTANDING:
+                    free.append(pool_buf)
+
+    def flush(self) -> None:
+        """Wait everything outstanding (finalize / disable path)."""
+        from . import bridge
+
+        self._drain()
+        for pos in sorted(self.preposted):
+            ticket, _out, _ka = self.preposted.pop(pos)
+            bridge.wait_ticket(self.handle, ticket)
+
+    def _disable(self, why: str) -> None:
+        self.enabled = False
+        self.stats["mismatches"] += 1
+        _warn(
+            f"runtime op stream diverged from plan "
+            f"{self.plan.cache_key} at position {self.cursor} ({why}); "
+            "plan execution disabled for this communicator — the job "
+            "continues on the historic path"
+        )
+        # outstanding sends are real posted work: wait them out.  A
+        # pre-posted recv cannot be cancelled; it is consumed by the
+        # next matching direct recv (see run_recv's disabled path).
+        # The planner refuses hoists on channels that also carry
+        # Status/wildcard receives, so that reconciliation covers every
+        # plannable schedule — but say so loudly if tickets remain.
+        if self.preposted:
+            chans = sorted(
+                {(self.ops[p].source, self.ops[p].tag)
+                 for p in self.preposted})
+            _warn(
+                f"{len(self.preposted)} pre-posted receive ticket(s) "
+                f"remain outstanding on (source, tag) {chans}; they own "
+                "the next wire message on their channels and will be "
+                "consumed by the next matching receive.  If this job "
+                "misbehaves, rerun with MPI4JAX_TPU_PLAN=0."
+            )
+        self._drain()
+
+    def _flush_recycle(self) -> None:
+        while self._recv_recycle_pending:
+            buf = self._recv_recycle_pending.pop()
+            free = self._recv_pool.setdefault((buf.dtype, buf.shape), [])
+            if len(free) < MAX_OUTSTANDING:
+                free.append(buf)
+
+    def _advance(self) -> None:
+        from . import bridge
+
+        pos = self.cursor
+        for hoist_pos in self.hoists_after.get(pos, ()):
+            if hoist_pos in self.preposted or not self.enabled:
+                continue
+            op = self.ops[hoist_pos]
+            key = (np.dtype(op.dtype), tuple(op.shape or ()))
+            free = self._recv_pool.get(key)
+            out = free.pop() if free else np.empty(key[1], key[0])
+            ticket, ka = bridge.post_recv_into(self.handle, out,
+                                               op.source, op.tag)
+            self.preposted[hoist_pos] = (ticket, out, ka)
+            self.stats["hoisted_recvs"] += 1
+        self.cursor = pos + 1
+        if self.cursor >= len(self.ops):
+            # plan cycle complete (steady-state jit loop): flush every
+            # deferred completion, then rearm for the next iteration
+            self._drain()
+            self.cursor = 0
+
+    def _expect(self, kind: str, **sig) -> Optional[object]:
+        """The plan op at the cursor if it matches the runtime op's
+        signature, else None (after disabling)."""
+        if self.cursor >= len(self.ops):
+            self.cursor = 0
+        op = self.ops[self.cursor]
+        if op.kind != kind:
+            self._disable(f"expected {op.kind}, saw {kind}")
+            return None
+        for name, value in sig.items():
+            want = getattr(op, name)
+            if want is not None and value is not None and want != value:
+                self._disable(
+                    f"{kind}.{name}: plan has {want!r}, runtime has "
+                    f"{value!r}")
+                return None
+        return op
+
+    # -- op entry points (called from the ops-layer host executors) -----
+
+    def run_send(self, buf: np.ndarray, dest: int, tag: int,
+                 owned: bool = False) -> bool:
+        """Returns True when the send was posted (deferred completion);
+        False = caller must execute the historic path.
+
+        ``owned=True`` is the MPI_Isend buffer contract: the caller
+        guarantees ``buf``'s storage stays valid and unmodified until
+        the runner's next drain point (the next recv/sync op, plan
+        wrap, or flush), and the post skips the payload copy.  The
+        ops-layer callback path must NOT claim ownership — its operand
+        arrays alias XLA-owned storage that dies with the callback."""
+        from . import bridge
+
+        if not self.enabled:
+            return False
+        self._flush_recycle()
+        op = self._expect("send", dest=dest, tag=tag, nbytes=buf.nbytes)
+        if op is None:
+            return False
+        if not op.deferred or buf.nbytes <= self.plan.detach_threshold:
+            # the native detached path already buffers small sends (and
+            # keeps them coalescible); no ticket needed
+            bridge.send(self.handle, buf, dest, tag)
+            self._advance()
+            return True
+        if len(self.outstanding) >= MAX_OUTSTANDING:
+            ticket, _ka, pool_buf = self.outstanding.pop(0)
+            bridge.wait_ticket(self.handle, ticket)
+            if pool_buf is not None:
+                self._send_pool.setdefault(
+                    (pool_buf.dtype, pool_buf.shape), []).append(pool_buf)
+        if owned:
+            wire_buf, pool_buf = buf, None
+        else:
+            # copy into a pooled buffer we own: the caller's ndarray
+            # may alias XLA-owned callback storage that dies when the
+            # callback returns, while the ticket outlives it (see
+            # bridge.post_send's ownership contract)
+            free = self._send_pool.get((buf.dtype, buf.shape))
+            wire_buf = free.pop() if free else np.empty_like(buf)
+            np.copyto(wire_buf, buf)
+            pool_buf = wire_buf
+        ticket, ka = bridge.post_send(self.handle, wire_buf, dest, tag)
+        self.outstanding.append((ticket, ka, pool_buf))
+        self.stats["deferred_sends"] += 1
+        self._advance()
+        return True
+
+    def run_recv(self, shape, dtype, source: int, tag: int,
+                 reuse: bool = False):
+        """The received array when the runner served the recv (possibly
+        from a pre-posted ticket), else None."""
+        from . import bridge
+
+        shape = tuple(shape)
+        dtype = np.dtype(dtype)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize \
+            if shape else dtype.itemsize
+        if not self.enabled:
+            # consume a matching pre-posted descriptor left over from
+            # before the mismatch: its ticket owns the next message on
+            # this channel, so the direct path must not race it
+            for pos, (ticket, out, _ka) in sorted(self.preposted.items()):
+                pop = self.ops[pos]
+                if (pop.source == source and pop.tag == tag
+                        and out.nbytes == nbytes):
+                    del self.preposted[pos]
+                    bridge.wait_ticket(self.handle, ticket)
+                    if out.shape == shape and out.dtype == dtype:
+                        return out
+                    return np.frombuffer(
+                        out.tobytes(), dtype=dtype).reshape(shape).copy()
+            return None
+        # dtype/shape are part of the signature: matching on byte count
+        # alone would let a stale plan's pre-posted buffer be silently
+        # bit-reinterpreted (f32[64] plan vs i32[64] runtime)
+        self._flush_recycle()
+        op = self._expect("recv", source=source, tag=tag, nbytes=nbytes,
+                          dtype=str(dtype), shape=shape)
+        if op is None:
+            return None
+        pos = self.cursor
+        if pos in self.preposted:
+            ticket, out, _ka = self.preposted.pop(pos)
+            bridge.wait_ticket(self.handle, ticket)
+            if reuse:
+                # callback-path contract (same as bridge._reused_out):
+                # the result is copied out of our buffer before the
+                # next host op runs, so it may recycle then
+                self._recv_recycle_pending.append(out)
+        else:
+            out = bridge.recv(self.handle, shape, dtype, source, tag,
+                              reuse=reuse)
+        # a completed recv proves every earlier ticket on this FIFO
+        # engine is done: collect them now (frees the EngineOps and
+        # recycles the send-copy pool; each wait returns instantly)
+        self._drain()
+        self._advance()
+        return out
+
+    def run_sync(self, kind: str, execute, **sig):
+        """Every other op: verify against the plan, run the historic
+        path, then collect completed tickets (FIFO: the synchronous op
+        queued behind them, so every earlier ticket is already done).
+        ``execute`` is a zero-arg closure running the real op."""
+        if not self.enabled:
+            return execute()
+        self._flush_recycle()
+        op = self._expect(kind, **sig)
+        if op is None:
+            return execute()
+        result = execute()
+        self._drain()
+        self._advance()
+        return result

@@ -159,6 +159,40 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 two; posting parks when full — bounded
                                 memory, never unbounded buffering; read
                                 natively).
+- ``MPI4JAX_TPU_PLAN``        — schedule-plan execution (the analysis
+                                layer's verified comm-program rewriting,
+                                docs/analysis.md § "From verifier to
+                                compiler").  Unset / ``0`` = off (the
+                                historic token-order execution,
+                                bit-for-bit); a *path* names a plan JSON
+                                emitted by ``python -m mpi4jax_tpu.analyze
+                                --emit-plan`` (what ``launch --plan``
+                                wires up): at communicator creation the
+                                rank's verified schedule installs a plan
+                                runner — hoisted receives pre-post on the
+                                progress engine, large sends defer their
+                                completion waits; ``1`` enables runners
+                                attached through the API only.  Only
+                                *proved* plans execute; a diverging op
+                                stream disables the plan loudly and the
+                                job continues on the historic path.
+                                Implies the host-callback dispatch route
+                                (the FFI fast path is skipped while a
+                                plan spec is set).  Must agree across
+                                ranks.
+- ``MPI4JAX_TPU_PLAN_BUCKET_KB`` — gradient-bucket ceiling (KB, default
+                                1024) for the schedule compiler's
+                                allreduce bucket marks; when set
+                                EXPLICITLY it also turns on
+                                ``parallel.dp.sync_gradients``
+                                bucketing: adjacent small same-op/dtype
+                                gradient allreduces fuse into one
+                                bucketed allreduce up to this many KB.
+                                0 disables bucketing.  Must agree across
+                                ranks AND with the analyzer run (it
+                                changes the collective schedule; the
+                                launcher exports the same environment
+                                to both, so they agree by default).
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels
@@ -212,6 +246,8 @@ KNOBS = {
     "MPI4JAX_TPU_TRACE_BUF_KB": "observability event-ring size (KB)",
     "MPI4JAX_TPU_PROGRESS_THREAD": "async progress engine on/off",
     "MPI4JAX_TPU_COALESCE_BYTES": "small-send coalescing threshold",
+    "MPI4JAX_TPU_PLAN": "schedule-plan execution (off / plan file / api)",
+    "MPI4JAX_TPU_PLAN_BUCKET_KB": "gradient allreduce bucket ceiling (KB)",
     "MPI4JAX_TPU_QUEUE_DEPTH": "progress-engine submission-queue depth",
     "MPI4JAX_TPU_PALLAS_COLLECTIVES": "route mesh collectives via Pallas",
     "MPI4JAX_TPU_ANALYZE_TIMEOUT_S": "static verifier wall deadline",
@@ -331,3 +367,27 @@ def trace_path():
     (observability recorder off)."""
     raw = os.environ.get("MPI4JAX_TPU_TRACE")
     return raw if raw else None
+
+
+def plan_spec():
+    """MPI4JAX_TPU_PLAN: a plan-file path or enable flag, or None when
+    plan execution is off (the resolution itself lives in
+    runtime/planrt.py; this mirror serves diag and the FFI gate)."""
+    raw = os.environ.get("MPI4JAX_TPU_PLAN", "").strip()
+    if not raw or raw.lower() in ("0", "false", "off", "no"):
+        return None
+    return raw
+
+
+def plan_bucket_bytes() -> int:
+    """Resolved MPI4JAX_TPU_PLAN_BUCKET_KB in bytes (default 1 MiB;
+    0 disables gradient bucketing)."""
+    raw = os.environ.get("MPI4JAX_TPU_PLAN_BUCKET_KB")
+    if raw is None or not raw.strip():
+        return 1 << 20
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse MPI4JAX_TPU_PLAN_BUCKET_KB={raw!r} as KB")
+    return max(0, v) * 1024

@@ -3956,4 +3956,66 @@ int tpucomm_execute(int64_t h, const struct TpuOpExec* d) {
   return engine_submit(c, &op);
 }
 
+/* ---- ticketed non-blocking posting (schedule-plan execution) ----
+ *
+ * The descriptor is heap-allocated and FORCE-QUEUED (never run inline,
+ * even on an idle engine): the whole point is returning to the caller
+ * before the op completes, so the progress thread can read/write the
+ * wire while the host computes.  The queue drains FIFO, so post order
+ * is wire order — the exact model the schedule compiler's equivalence
+ * prover verified before any plan reaches this entry point. */
+
+int64_t tpucomm_post(int64_t h, const struct TpuOpExec* d) {
+  Comm* c = get_comm(h);
+  if (!c || !d) return 0;
+  auto* op = new EngineOp;
+  op->kind = d->kind;
+  op->comm = c;
+  op->sbuf = d->sbuf;
+  op->rbuf = d->rbuf;
+  op->snb = d->snbytes;
+  op->rnb = d->rnbytes;
+  op->count = d->count;
+  op->dtype = d->dtype;
+  op->rop = d->rop;
+  op->peer = d->peer;
+  op->peer2 = d->peer2;
+  op->tag = d->tag;
+  op->tag2 = d->tag2;
+  op->algo = d->algo;
+  Comm* root = c->lock_root;
+  std::lock_guard<std::mutex> lock(comm_mu(c));
+  Engine* e = root->engine;
+  if (e && e->sticky.load(std::memory_order_acquire)) {
+    delete op;
+    std::fprintf(stderr,
+                 "tpucomm r%d: post rejected — an earlier asynchronously "
+                 "posted send failed (see the diagnostic above)\n",
+                 c->rank);
+    return 0;
+  }
+  op->t_post = now_s();
+  if (!progress_thread_on()) {
+    /* engine off: execute inline now; the ticket is already complete,
+     * so plan execution degrades to the historic serialized order
+     * bit-for-bit (MPI4JAX_TPU_PLAN composes with PROGRESS_THREAD=0) */
+    op->rc = engine_run_body(op);
+    op->state.store(1, std::memory_order_release);
+    return reinterpret_cast<int64_t>(op);
+  }
+  engine_post(root, op);
+  return reinterpret_cast<int64_t>(op);
+}
+
+int tpucomm_wait_ticket(int64_t h, int64_t ticket) {
+  (void)h;  // the ticket IS the descriptor; the handle is for symmetry
+  if (!ticket) return 1;
+  auto* op = reinterpret_cast<EngineOp*>(ticket);
+  while (op->state.load(std::memory_order_acquire) == 0)
+    shm_futex_wait(&op->state, 0, 100);
+  int rc = op->rc;
+  delete op;
+  return rc;
+}
+
 }  /* extern "C" */

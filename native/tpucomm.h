@@ -279,6 +279,29 @@ struct TpuOpExec {
 
 int tpucomm_execute(int64_t h, const struct TpuOpExec* d);
 
+/* ---- ticketed non-blocking posting (schedule-plan execution) ----
+ *
+ * tpucomm_post enqueues a descriptor on the communicator's progress
+ * engine WITHOUT waiting for completion and returns a ticket (> 0; 0 on
+ * failure).  The engine drains its queue strictly in posted order, so
+ * post order IS wire order — exactly the FIFO contract the schedule
+ * compiler's equivalence prover (mpi4jax_tpu/analysis/_plan.py) models
+ * when it verifies a plan.  The caller owns every buffer named in the
+ * descriptor until the matching tpucomm_wait_ticket returns.
+ *
+ * tpucomm_wait_ticket parks on the descriptor's completion futex and
+ * returns the op's result code (0 = success), then frees the ticket.
+ * Each ticket must be waited exactly once; waiting tickets in post
+ * order costs nothing extra (FIFO: an earlier ticket is always done
+ * before a later one).  With MPI4JAX_TPU_PROGRESS_THREAD=0 the post
+ * executes inline and the wait returns the stored result — plans
+ * degrade to the historic serialized execution, never to different
+ * semantics.  Deadlines (MPI4JAX_TPU_TIMEOUT_S) measure from post time
+ * and fault injection fires inside the op bodies, both exactly as for
+ * parked ops. */
+int64_t tpucomm_post(int64_t h, const struct TpuOpExec* d);
+int tpucomm_wait_ticket(int64_t h, int64_t ticket);
+
 }  /* extern "C" */
 
 #endif  /* TPUCOMM_H */

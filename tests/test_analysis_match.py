@@ -143,17 +143,59 @@ def test_wildcard_starvation_and_scan_skip():
     assert out == []
 
 
-def test_order_critical_exchange_fires_only_on_cycles():
-    # bidirectional raw send/recv -> warning
+BIG = (64 * 1024,)  # f32[64Ki] = 256 KB: above any detach threshold
+
+
+def test_order_critical_exchange_fires_only_on_blocking_cycles():
+    # bidirectional raw send/recv with payloads past the buffered-send
+    # threshold -> warning (both directions can rendezvous-block)
     out = MT.match_schedules(
-        {0: [_send(0, 0, dest=1), _recv(0, 1, source=1)],
-         1: [_recv(1, 0, source=0), _send(1, 1, dest=0)]}, WORLD2)
+        {0: [_send(0, 0, dest=1, shape=BIG), _recv(0, 1, source=1, shape=BIG)],
+         1: [_recv(1, 0, source=0, shape=BIG), _send(1, 1, dest=0, shape=BIG)]},
+        WORLD2)
     assert kinds(out) == ["order_critical_exchange"]
     assert out[0].severity == "warning"
     # one-directional traffic stays clean (basic_ops shape)
     out = MT.match_schedules(
         {0: [_send(0, 0, dest=1)], 1: [_recv(1, 0, source=0)]}, WORLD2)
     assert out == []
+
+
+def test_order_critical_exchange_respects_buffered_send_threshold():
+    # with the async progress engine on (the default), sends at or below
+    # max(32 KB, MPI4JAX_TPU_COALESCE_BYTES) are detached buffered sends:
+    # a small bidirectional exchange cannot rendezvous-block and is no
+    # longer flagged (PR 5 made the match model's buffering real)
+    small = {0: [_send(0, 0, dest=1), _recv(0, 1, source=1)],
+             1: [_recv(1, 0, source=0), _send(1, 1, dest=0)]}
+    assert MT.match_schedules(small, WORLD2) == []
+    # one small direction alone already breaks the cycle
+    mixed = {0: [_send(0, 0, dest=1, shape=BIG),
+                 _recv(0, 1, source=1)],
+             1: [_recv(1, 0, source=0, shape=BIG), _send(1, 1, dest=0)]}
+    assert MT.match_schedules(mixed, WORLD2) == []
+    # explicit threshold 0 restores the historic conservative model
+    # (the engine-off MPI4JAX_TPU_PROGRESS_THREAD=0 behavior)
+    out = MT.order_critical_findings(
+        {r: list(v) for r, v in small.items()}, WORLD2,
+        detach_threshold=0)
+    assert kinds(out) == ["order_critical_exchange"]
+    # unknown payload sizes stay conservative
+    unk = {0: [EV.CommEvent(0, 0, "send", dest=1, tag=0),
+               EV.CommEvent(0, 1, "recv", source=1, tag=0)],
+           1: [EV.CommEvent(1, 0, "recv", source=0, tag=0),
+               EV.CommEvent(1, 1, "send", dest=0, tag=0)]}
+    assert "order_critical_exchange" in kinds(MT.match_schedules(unk, WORLD2))
+    # a small FIRST send must not mask a later above-threshold send on
+    # the same direction: ANY blocking send per direction counts
+    masked = {0: [_send(0, 0, dest=1), _recv(0, 1, source=1),
+                  _send(0, 2, dest=1, shape=BIG),
+                  _recv(0, 3, source=1, shape=BIG)],
+              1: [_recv(1, 0, source=0), _send(1, 1, dest=0),
+                  _recv(1, 2, source=0, shape=BIG),
+                  _send(1, 3, dest=0, shape=BIG)]}
+    assert "order_critical_exchange" in \
+        kinds(MT.match_schedules(masked, WORLD2))
 
 
 def test_collective_straggler():

@@ -1,0 +1,288 @@
+"""Unit tests for the schedule compiler (analysis/_deps.py, _plan.py).
+
+Loaded standalone (no package import, no jax) like test_analysis_match:
+the dependence pass, the plan builder, and the equivalence prover are
+pure Python by design, so these run — and the rewrite semantics stay
+pinned — even on hosts whose jax predates the package minimum.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mpi4jax_tpu", "analysis")
+
+
+def _load():
+    if "m4j_pl._plan" in sys.modules:
+        return tuple(sys.modules[f"m4j_pl.{n}"]
+                     for n in ("_events", "_match", "_deps", "_plan"))
+    pkg = types.ModuleType("m4j_pl")
+    pkg.__path__ = [PKG]
+    sys.modules["m4j_pl"] = pkg
+    mods = []
+    for name in ("_events", "_match", "_deps", "_plan"):
+        spec = importlib.util.spec_from_file_location(
+            f"m4j_pl.{name}", os.path.join(PKG, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"m4j_pl.{name}"] = mod
+        spec.loader.exec_module(mod)
+        mods.append(mod)
+    return tuple(mods)
+
+
+EV, MT, DP, PL = _load()
+WORLD2 = {(0,): (0, 1)}
+WORLD3 = {(0,): (0, 1, 2)}
+BIG = (64 * 1024,)  # f32: 256 KB, above any detach threshold
+
+
+def _ev(r, i, kind, shape=(4,), **kw):
+    return EV.CommEvent(r, i, kind, dtype="float32", shape=shape,
+                        site=f"p.py:{10 + i}", **kw)
+
+
+def _send(r, i, dest, tag=0, shape=(4,)):
+    return _ev(r, i, "send", dest=dest, tag=tag, shape=shape)
+
+
+def _recv(r, i, source, tag=0, shape=(4,), **kw):
+    return _ev(r, i, "recv", source=source, tag=tag, shape=shape, **kw)
+
+
+# ---- dependence pass ---------------------------------------------------
+
+
+def test_channel_and_collective_edges():
+    evs = [
+        _send(0, 0, dest=1, tag=0),
+        _send(0, 1, dest=1, tag=1),          # same channel: edge 0->1
+        _send(0, 2, dest=2, tag=0),          # other channel: no edge
+        _ev(0, 3, "allreduce", reduce_op="SUM"),
+        _ev(0, 4, "barrier"),                # collective chain: 3->4
+        _recv(0, 5, source=1),
+        _recv(0, 6, source=1),               # same channel: edge 5->6
+        _recv(0, 7, source=2),               # other channel: no edge
+    ]
+    g = DP.build_rank_deps(evs)
+    assert g.depends(0, 1) and g.kind[(0, 1)] == "channel"
+    assert not g.depends(1, 2) and not g.depends(0, 2)
+    assert g.depends(3, 4) and g.kind[(3, 4)] == "collective"
+    assert g.depends(5, 6) and not g.depends(6, 7)
+    # the pairs with no semantic edge are the token-only serialization
+    assert g.artificial_pairs() >= 3
+
+
+def test_wildcard_fences_everything_on_the_comm():
+    any_src = EV.ANY_SOURCE
+    evs = [
+        _send(0, 0, dest=1),
+        _recv(0, 1, source=any_src),
+        _send(0, 2, dest=2),
+        _recv(0, 3, source=1),
+    ]
+    g = DP.build_rank_deps(evs)
+    assert g.depends(0, 1) and g.kind[(0, 1)] == "wildcard"
+    assert g.depends(1, 2) and g.depends(1, 3)
+
+
+def test_status_recv_is_wildcard_like():
+    evs = [_send(0, 0, dest=1),
+           _recv(0, 1, source=1, status=True),
+           _send(0, 2, dest=1, tag=9)]
+    g = DP.build_rank_deps(evs)
+    assert DP.is_wildcard(evs[1])
+    assert g.depends(0, 1) and g.depends(1, 2)
+
+
+def test_value_deps_become_data_edges():
+    evs = [_recv(0, 0, source=1), _send(0, 1, dest=1)]
+    g = DP.build_rank_deps(evs, value_deps={(0, 1)})
+    assert g.depends(0, 1) and g.kind[(0, 1)] == "data"
+
+
+def test_concurrency_groups_solo_rules_and_cap():
+    evs = ([_send(0, i, dest=1, tag=i) for i in range(6)]
+           + [_ev(0, 6, "allreduce", reduce_op="SUM")]
+           + [_recv(0, 7, source=EV.ANY_SOURCE)])
+    g = DP.build_rank_deps(evs)
+    # sends to ONE peer share a channel -> serialized, all solo groups
+    groups = DP.concurrency_groups(evs, g)
+    assert all(len(grp) == 1 for grp in groups)
+    # sends to DIFFERENT peers group, capped at MAX_GROUP
+    evs2 = [_send(0, i, dest=i + 1, tag=0) for i in range(6)]
+    g2 = DP.build_rank_deps(evs2)
+    groups2 = DP.concurrency_groups(evs2, g2)
+    assert [len(x) for x in groups2] == [DP.MAX_GROUP, 6 - DP.MAX_GROUP]
+    # collectives and wildcards never share a group
+    evs3 = [_send(0, 0, dest=1), _ev(0, 1, "barrier"), _send(0, 2, dest=2)]
+    g3 = DP.build_rank_deps(evs3)
+    assert DP.concurrency_groups(evs3, g3) == [[0], [1], [2]]
+
+
+def test_recv_post_point_temporal_and_fences():
+    evs = [_send(0, 0, dest=1, shape=BIG), _recv(0, 1, source=1, shape=BIG)]
+    g = DP.build_rank_deps(evs)
+    # temporal hoist: posted inside the previous op's callback
+    assert DP.recv_post_point(evs, g, 1) == 0
+    # first op cannot hoist; wildcard/status recvs never hoist
+    assert DP.recv_post_point([_recv(0, 0, source=1)],
+                              DP.build_rank_deps([_recv(0, 0, source=1)]),
+                              0) == 0
+    evs2 = [_send(0, 0, dest=1),
+            _recv(0, 1, source=EV.ANY_SOURCE)]
+    g2 = DP.build_rank_deps(evs2)
+    assert DP.recv_post_point(evs2, g2, 1) == 1
+    # a foreign-engine event between post point and recv is passable
+    # (its lineage ROOT differs: separate socket set, separate progress
+    # thread); a same-engine event — including any sub-comm, which
+    # borrows the parent's sockets — is not (FIFO coupling)
+    foreign = (1,)
+    evs3 = [_send(0, 0, dest=1),
+            EV.CommEvent(0, 1, "send", comm=foreign, dest=1,
+                         dtype="float32", shape=(4,)),
+            _recv(0, 2, source=1)]
+    g3 = DP.build_rank_deps(evs3)
+    assert DP.recv_post_point(evs3, g3, 2) == 0
+    sub = (0, 1, 0)  # sub-comm: same engine root -> fence
+    evs4 = [_send(0, 0, dest=1),
+            EV.CommEvent(0, 1, "send", comm=sub, dest=1,
+                         dtype="float32", shape=(4,)),
+            _recv(0, 2, source=1)]
+    g4 = DP.build_rank_deps(evs4)
+    assert DP.recv_post_point(evs4, g4, 2) == 1
+
+
+# ---- plan construction + the equivalence prover ------------------------
+
+
+def test_pipeline_plan_is_rewritten_and_proved():
+    sch = {r: [_send(r, 0, dest=(r + 1) % 3, shape=BIG),
+               _recv(r, 1, source=(r - 1) % 3, shape=BIG)]
+           for r in range(3)}
+    plan = PL.compile_schedules(sch, WORLD3)
+    assert plan.proved and plan.rewritten
+    assert plan.proof["exhaustive"]
+    for r in range(3):
+        assert plan.ranks[r].ops[1].hoisted
+        assert plan.ranks[r].ops[0].deferred
+    # the summary names the cache key and verdict (CLI surface)
+    assert "proved" in plan.summary() and plan.cache_key
+
+
+def test_order_critical_schedule_left_unrewritten():
+    # send;recv vs recv;send with blocking payloads: true cross-rank
+    # ordering dependence — the plan must demonstrably not rewrite it
+    sch = {0: [_send(0, 0, dest=1, shape=BIG),
+               _recv(0, 1, source=1, shape=BIG)],
+           1: [_recv(1, 0, source=0, shape=BIG),
+               _send(1, 1, dest=0, shape=BIG)]}
+    findings = MT.match_schedules(sch, WORLD2)
+    assert any(f.kind == "order_critical_exchange" for f in findings)
+    plan = PL.compile_schedules(sch, WORLD2, findings=findings)
+    assert plan.proved and not plan.rewritten
+    assert any("unrewritten" in r for r in plan.reasons)
+
+
+def test_prover_rejects_unsafe_wire_reorder():
+    # hand-build a plan whose hoist crosses a same-engine send (the
+    # symmetric-exchange deadlock): the prover must reject it, and
+    # compile_schedules must fall back to a proved plan
+    sch = {r: [_send(r, 0, dest=1 - r, shape=BIG),
+               _recv(r, 1, source=1 - r, shape=BIG)] for r in range(2)}
+    bad = PL.build_plan(sch, WORLD2)
+    for r in range(2):
+        bad.ranks[r].ops[1].post_at = -1  # wire-reorder before the send
+    assert not PL.prove_plan(sch, WORLD2, bad)
+    assert any("new finding kind" in f for f in bad.proof["failures"])
+
+
+def test_prover_pins_per_channel_delivery_order():
+    # two sends to one peer on one channel: any plan permuting them
+    # changes delivery order; the simulator must record it
+    sch = {0: [_send(0, 0, dest=1, tag=5), _send(0, 1, dest=1, tag=6)],
+           1: [_recv(1, 0, source=0, tag=5), _recv(1, 1, source=0, tag=6)]}
+    deliv = {}
+    assert MT.match_schedules(sch, WORLD2, deliveries=deliv) == []
+    chan = deliv["p2p"][((0,), 0, 1)]
+    assert [d[1] for d in chan] == [0, 1]  # send idx order preserved
+    plan = PL.compile_schedules(sch, WORLD2)
+    assert plan.proved  # same-channel sends stay serialized by deps
+
+
+def test_coalesce_and_bucket_marks():
+    sch = {0: [_send(0, 0, dest=1, tag=0), _send(0, 1, dest=1, tag=1),
+               _send(0, 2, dest=1, tag=2)],
+           1: [_recv(1, 0, source=0, tag=0), _recv(1, 1, source=0, tag=1),
+               _recv(1, 2, source=0, tag=2)]}
+    plan = PL.compile_schedules(sch, WORLD2, coalesce_bytes=4096,
+                                detach_threshold=32 * 1024)
+    assert all(op.coalesce for op in plan.ranks[0].ops)
+    assert not any(op.coalesce for op in plan.ranks[1].ops)
+
+    ar = [_ev(r, i, "allreduce", reduce_op="SUM", shape=(64,))
+          for r in range(2) for i in range(3)]
+    sch2 = {0: ar[:3], 1: ar[3:]}
+    plan2 = PL.compile_schedules(sch2, WORLD2, bucket_bytes=1 << 20)
+    assert [op.bucket for op in plan2.ranks[0].ops] == [0, 0, 0]
+    plan3 = PL.compile_schedules(sch2, WORLD2, bucket_bytes=0)
+    assert all(op.bucket is None for op in plan3.ranks[0].ops)
+
+
+def test_plan_json_round_trip_and_diff():
+    sch = {r: [_send(r, 0, dest=(r + 1) % 3, shape=BIG),
+               _recv(r, 1, source=(r - 1) % 3, shape=BIG)]
+           for r in range(3)}
+    plan = PL.compile_schedules(sch, WORLD3)
+    blob = json.loads(json.dumps(plan.to_json()))
+    back = PL.ExecutionPlan.from_json(blob)
+    assert PL.diff_plans(plan, back) == ""
+    back.ranks[0].ops[1].post_at = 1
+    drift = PL.diff_plans(plan, back)
+    assert "post_at" in drift
+    # format gate: a wrong wire version is rejected, not misread
+    blob_bad = dict(blob)
+    blob_bad["format"] = 999
+    try:
+        PL.ExecutionPlan.from_json(blob_bad)
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("bad plan format accepted")
+
+
+def test_cache_key_ignores_sites_but_not_semantics():
+    def mk(tag, site):
+        return {0: [EV.CommEvent(0, 0, "send", dest=1, tag=tag,
+                                 dtype="float32", shape=(4,), site=site)],
+                1: [EV.CommEvent(1, 0, "recv", source=0, tag=tag,
+                                 dtype="float32", shape=(4,),
+                                 site=site)]}
+
+    k1 = EV.schedule_cache_key(mk(0, "a.py:1"), 2)
+    k2 = EV.schedule_cache_key(mk(0, "b.py:99"), 2)  # moved lines only
+    k3 = EV.schedule_cache_key(mk(1, "a.py:1"), 2)   # semantic change
+    assert k1 == k2 and k1 != k3
+    assert EV.schedule_cache_key(mk(0, "a.py:1"), 3) != k1  # world size
+
+
+def test_status_recv_accepts_short_messages():
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1, shape=(2,))],
+         1: [_recv(1, 0, source=0, shape=(8,), status=True)]}, WORLD2)
+    assert out == []  # short into a Status recv is the native contract
+    out = MT.match_schedules(
+        {0: [_send(0, 0, dest=1, shape=(16,))],
+         1: [_recv(1, 0, source=0, shape=(8,), status=True)]}, WORLD2)
+    assert [f.kind for f in out] == ["shape_mismatch"]  # truncation
+
+
+def test_event_nbytes_parsing():
+    assert EV.event_nbytes("float32", (4,)) == 16
+    assert EV.event_nbytes("bfloat16", (8, 2)) == 32
+    assert EV.event_nbytes("bool", (5,)) == 5
+    assert EV.event_nbytes(None, (4,)) is None
+    assert EV.event_nbytes("float32", None) is None

@@ -1,0 +1,33 @@
+"""Tier-1 wiring of the verify-corpus gate (make verify-corpus).
+
+Runs the analyzer + schedule compiler + equivalence prover over every
+program in ``tests/world_programs/golden_plans/manifest.json`` and
+fails on any new finding kind, any unproved plan, or any plan/golden
+drift — the CI contract of docs/analysis.md § "From verifier to
+compiler".  All in-process: no rank processes, no live communication.
+"""
+
+import os
+import sys
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401  (jax version gate)
+except Exception as err:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu not importable here: {err}",
+                allow_module_level=True)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_verify_corpus_gate(capsys):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import verify_corpus
+
+    failures = verify_corpus.run()
+    out = capsys.readouterr().out
+    assert failures == 0, f"verify-corpus failures:\n{out}"
+    # the golden-diffed programs really ran (the gate has teeth)
+    assert "[golden]" in out
+    assert "plan drift" not in out

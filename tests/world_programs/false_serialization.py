@@ -1,0 +1,67 @@
+"""False-serialization pipeline: the schedule compiler's headline shape.
+
+A >= 3-rank ring where every rank sends a large block downstream, does
+local compute, then receives the upstream block.  Token order serializes
+send -> compute -> recv, but nothing truly depends: the recv's POST can
+hoist into the send's callback, so the wire drains during the compute —
+the overlap the execution plan (``analyze --optimize`` /
+``launch --plan``) unlocks.  At np=2 the ring degenerates into a
+bidirectional exchange and the plan must stay unrewritten
+(order-critical); run this at np >= 3.
+
+Numeric contract: two pipeline stages, each forwarding ``f(block)``
+downstream; every rank checks the exact value that travelled two hops.
+Bit-identical with the plan on or off.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+BLOCK = 64 * 1024  # f32: 256 KB, past any buffered-send threshold
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size >= 3, "run at np >= 3 (np=2 is a bidirectional exchange)"
+    nxt, prv = (rank + 1) % size, (rank - 1) % size
+
+    def stage(block, tag):
+        m4j.send(block, dest=nxt, tag=tag, comm=comm)
+        # local compute between the send and the recv: the window the
+        # hoisted recv post overlaps with
+        local = jnp.tanh(block[:1024]).sum()
+        got = m4j.recv(jnp.zeros((BLOCK,), jnp.float32), source=prv,
+                       tag=tag, comm=comm)
+        return got, local
+
+    block0 = jnp.full((BLOCK,), float(rank), jnp.float32)
+    got1, _ = stage(block0, tag=11)
+    np.testing.assert_allclose(np.asarray(got1[:4]), float(prv))
+
+    got2, _ = stage(got1 * 2.0 + 1.0, tag=12)
+    two_back = (rank - 2) % size
+    np.testing.assert_allclose(np.asarray(got2[:4]), two_back * 2.0 + 1.0)
+
+    import hashlib
+
+    digest = hashlib.sha256(
+        np.asarray(got1).tobytes() + np.asarray(got2).tobytes()
+    ).hexdigest()
+    print(f"false_serialization digest r{rank} {digest}", flush=True)
+    print(f"rank {rank}: false_serialization OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""CI gate over the world-program corpus: analyzer + schedule compiler.
+
+    make verify-corpus        (or: python tools/verify_corpus.py)
+
+For every program in ``tests/world_programs/golden_plans/manifest.json``:
+
+- the static verifier (virtual world, no processes) must produce EXACTLY
+  the expected finding kinds — any new kind fails the gate;
+- the schedule compiler must produce a PROVED plan (the equivalence
+  prover replays original and rewritten schedules through the match
+  simulator; an unproved plan is a compiler regression);
+- programs with a checked-in golden plan must compile to it exactly
+  (``analysis.diff_plans``) — plan drift fails the gate with the diff.
+
+Knob-derived thresholds are normalized (progress engine on, default
+coalesce/bucket sizes) so the goldens are stable across CI hosts.
+Exit code = number of failing programs.  ``--update-goldens`` rewrites
+the golden files from the current compiler output (review the diff!).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROGRAMS = os.path.join(REPO, "tests", "world_programs")
+GOLDEN_DIR = os.path.join(PROGRAMS, "golden_plans")
+MANIFEST = os.path.join(GOLDEN_DIR, "manifest.json")
+
+#: knobs that change plan thresholds — cleared so goldens are stable
+NORMALIZED_KNOBS = (
+    "MPI4JAX_TPU_PROGRESS_THREAD",
+    "MPI4JAX_TPU_COALESCE_BYTES",
+    "MPI4JAX_TPU_PLAN_BUCKET_KB",
+    "MPI4JAX_TPU_PLAN",
+    "MPI4JAX_TPU_FAULT",
+)
+
+
+def run(update_goldens: bool = False) -> int:
+    saved = {k: os.environ.pop(k) for k in NORMALIZED_KNOBS
+             if k in os.environ}
+    try:
+        return _run(update_goldens)
+    finally:
+        os.environ.update(saved)
+
+
+def _run(update_goldens: bool) -> int:
+    sys.path.insert(0, REPO)
+    from mpi4jax_tpu import analysis
+
+    with open(MANIFEST) as f:
+        manifest = json.load(f)
+
+    failures = 0
+    for entry in manifest["programs"]:
+        name, np_ = entry["program"], int(entry["np"])
+        label = f"{name} --np {np_}"
+        problems = []
+        report = analysis.check_program(
+            os.path.join(PROGRAMS, name), np_, timeout_s=240)
+        kinds = sorted({f.kind for f in report.findings})
+        if kinds != sorted(entry.get("kinds", [])):
+            problems.append(
+                f"finding kinds {kinds} != expected "
+                f"{sorted(entry.get('kinds', []))}"
+            )
+        plan = analysis.plan_report(report)
+        if not plan.proved:
+            problems.append(f"plan NOT proved: {plan.reasons}")
+        want_rewritten = entry.get("rewritten")
+        if want_rewritten is not None and plan.rewritten != want_rewritten:
+            problems.append(
+                f"plan rewritten={plan.rewritten}, expected "
+                f"{want_rewritten}"
+            )
+        golden_name = entry.get("golden")
+        if golden_name:
+            golden_path = os.path.join(GOLDEN_DIR, golden_name)
+            if update_goldens:
+                analysis.save_plan(plan, golden_path)
+            else:
+                try:
+                    golden = analysis.load_plan(golden_path)
+                except Exception as err:
+                    golden = None
+                    problems.append(f"cannot load golden: {err}")
+                if golden is not None:
+                    drift = analysis.diff_plans(golden, plan)
+                    if drift:
+                        problems.append("plan drift:\n" + drift)
+        if problems:
+            failures += 1
+            print(f"FAIL  {label}")
+            for p in problems:
+                print(f"      {p}")
+        else:
+            extra = " [golden]" if golden_name else ""
+            print(f"PASS  {label}  kinds={kinds} "
+                  f"proved={plan.proved} rewritten={plan.rewritten}"
+                  f"{extra}")
+    total = len(manifest["programs"])
+    print(f"verify-corpus: {total - failures}/{total} program(s) clean")
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tools/verify_corpus.py")
+    ap.add_argument("--update-goldens", action="store_true",
+                    help="rewrite the golden plan files from the current "
+                         "compiler output (review the diff before "
+                         "committing)")
+    args = ap.parse_args(argv)
+    return run(update_goldens=args.update_goldens)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
