@@ -7,10 +7,15 @@ CPU mesh); one JSON line per message size.
     python benchmarks/allreduce_sweep.py [--max-mb 256] [--world] [--pallas]
 
 ``--world`` benchmarks the world tier (native transport) instead, under
-the launcher.  ``--algos ring,rd,tree`` (world tier) additionally sweeps
-each FORCED collective algorithm and emits one GB/s curve per algorithm
-(``"algo"`` field in every record) — the per-algorithm evidence the BENCH
-artifact and the tune package's defaults rest on.  ``--pallas`` benchmarks
+the launcher.  ``--algos ring,qring,rd,qrd,tree`` (world tier)
+additionally sweeps each FORCED collective algorithm — including the
+quantized wire formats — and emits one LOGICAL GB/s curve per algorithm
+(``"algo"`` field in every record; quantized records add ``wire_bytes``
+and ``compression``) — the per-algorithm evidence the BENCH artifact,
+the crossover curves in docs/benchmarks.md, and the tune package's
+defaults rest on.  The raw-transport loop runs IN PLACE
+(sendbuf == recvbuf, the donated-buffer steady state) and reports
+per-call medians.  ``--pallas`` benchmarks
 the Pallas RDMA ring collectives (``ops/pallas_collectives.py``) — on TPU
 meshes this times the real inter-chip DMA kernels; off-TPU they run
 interpreted and the numbers only establish correctness-path overhead.
@@ -166,7 +171,7 @@ def world_tier_rank(max_bytes, sizes=None, algos=None):
                 times.sort()
                 dt = times[len(times) // 2] / K
             else:
-                calls = max(3, min(12, int(2e8 / size)))
+                calls = max(6, min(24, int(5e8 / size)))
                 out = fn(x)  # donates x: re-created per algo above
                 jax.block_until_ready(out)
                 t0 = time.perf_counter()
@@ -183,15 +188,18 @@ def world_tier_rank(max_bytes, sizes=None, algos=None):
             from mpi4jax_tpu.ops.reduce_ops import ALL_OPS
             from mpi4jax_tpu.utils import dtypes as _dtypes
 
+            # IN-PLACE (sendbuf == recvbuf): the steady-state shape a
+            # training loop's donated buffers give the in-jit path —
+            # separate in/out buffers would add one 16 MB memcpy per
+            # call to EVERY algorithm and dilute their differences
             a = np.ones(size // 4, np.float32)
-            o = np.empty_like(a)
             lib = bridge.get_lib()
             sum_code = next(i for i, op in enumerate(ALL_OPS)
                             if op.name == "SUM")
             args_native = [
                 ctypes.c_int64(comm.handle),
                 a.ctypes.data_as(ctypes.c_void_p),
-                o.ctypes.data_as(ctypes.c_void_p),
+                a.ctypes.data_as(ctypes.c_void_p),
                 ctypes.c_int64(a.size),
                 ctypes.c_int(_dtypes.wire_code(a.dtype)),
                 ctypes.c_int(sum_code),
@@ -211,32 +219,58 @@ def world_tier_rank(max_bytes, sizes=None, algos=None):
                 fn_native = lib.tpucomm_allreduce
             args_native = tuple(args_native)
             rc = fn_native(*args_native)  # align ranks on the same op count
-            t0 = time.perf_counter()
+            raw_times = []
+            barrier = lib.tpucomm_barrier
+            hc = ctypes.c_int64(comm.handle)
             for _ in range(calls * K):
+                # barrier-synchronized start: each sample measures the
+                # COLLECTIVE's latency from an all-ranks-ready state
+                # (the barrier is outside the timed window, identical
+                # for every algorithm) — back-to-back free-running
+                # calls accumulate rank drift whose stalls land on
+                # whichever algorithm runs second, an artifact of the
+                # loop rather than of the schedule being measured
+                barrier(hc)
+                t0 = time.perf_counter()
                 rc |= fn_native(*args_native)
-            raw_dt = (time.perf_counter() - t0) / (calls * K)
+                raw_times.append(time.perf_counter() - t0)
             if rc != 0:
                 raise RuntimeError(f"native allreduce failed (rc={rc})")
+            from mpi4jax_tpu import obs
+
+            # median per call: robust to preemption outliers on the
+            # oversubscribed CI hosts these curves are measured on
+            raw_dt = obs.percentile(raw_times, 50)
 
             if comm.rank() == 0:
                 # what actually served the call: "shm" on an arena comm
                 # (forced algorithms are no-ops there), else the engine's
                 # pick / the forced algorithm
                 probed = comm.coll_algo("allreduce", size)
-                from mpi4jax_tpu import obs
-
+                resolved = (probed if (probed == "shm" or algo == "auto")
+                            else algo)
+                extra = {}
+                if resolved in ("qring", "qrd") and bridge.quant_available():
+                    # logical vs on-wire payload: the curves report
+                    # LOGICAL GB/s (comparable across wire formats);
+                    # the compression ratio names the byte saving
+                    wb = bridge.quant_packed_bytes(size // 4)
+                    extra = {"wire_bytes": wb,
+                             "compression": round(size / wb, 3)}
                 # shared serializer (obs.bench_record) keeps this curve
                 # field-compatible with BENCH_*.json and profile reports
                 print(json.dumps(obs.bench_record(
                     op="allreduce", nbytes=size, seconds=dt, ranks=n,
                     tier="world", algo=algo,
-                    resolved_algo=probed if (probed == "shm" or algo == "auto")
-                                  else algo,
+                    resolved_algo=resolved,
                     raw_seconds=round(raw_dt, 9),
+                    raw_p95_us=round(obs.percentile(raw_times, 95) * 1e6,
+                                     1),
                     ops_per_jit=K,
                     raw_eff_GBps_per_chip=round(
                         2 * (n - 1) / n * size / raw_dt / 1e9, 3
                     ),
+                    **extra,
                 )), flush=True)
     tune.clear_overrides()
 

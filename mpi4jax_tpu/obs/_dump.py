@@ -88,7 +88,7 @@ def load_events(path: str):
             if ev.get("ph") != "X" or ev.get("cat") == "phase":
                 continue
             args = ev.get("args") or {}
-            events.append({
+            evd = {
                 "name": ev.get("name", "?"),
                 "src": "native" if ev.get("tid") == 0 else "ops",
                 "ts_us": float(ev.get("ts", 0.0)),
@@ -98,7 +98,10 @@ def load_events(path: str):
                 "peer": int(args.get("peer", -1)),
                 "tag": int(args.get("tag", 0)),
                 "algo": args.get("algo"),
-            })
+            }
+            if "wire_bytes" in args:
+                evd["wire_bytes"] = int(args["wire_bytes"])
+            events.append(evd)
         other = data.get("otherData") or {}
         return events, int(other.get("world_size", 1))
     raise ValueError(

@@ -2,7 +2,11 @@
 
 The wire contract lives in ``native/tpucomm.h``: ``TpuObsEvent`` (this
 module's :class:`TpuObsEvent` must stay field-for-field identical) and
-the ``tpucomm_obs_*`` entry points.  Everything here takes the loaded
+the ``tpucomm_obs_*`` entry points.  ``wire_bytes`` is each event's
+on-wire payload representation — equal to the logical ``bytes`` for
+every exact op, the packed int8+scales size for quantized collectives
+(qring/qrd), so ``bytes / wire_bytes`` is the compression ratio.
+Everything here takes the loaded
 library object explicitly — this module never loads (or builds) the
 transport itself, so the pure-Python half of the subsystem stays usable
 without it.
@@ -19,7 +23,8 @@ OBS_OP_NAMES = (
 )
 
 #: TpuCollAlgo codes -> names (keep in sync with mpi4jax_tpu/tune)
-ALGO_NAMES = {0: "auto", 1: "ring", 2: "rd", 3: "tree", 4: "shm"}
+ALGO_NAMES = {0: "auto", 1: "ring", 2: "rd", 3: "tree", 4: "shm",
+              5: "qring", 6: "qrd"}
 
 
 class TpuObsEvent(ctypes.Structure):
@@ -29,6 +34,7 @@ class TpuObsEvent(ctypes.Structure):
         ("wait_s", ctypes.c_double),
         ("queue_s", ctypes.c_double),
         ("nbytes", ctypes.c_int64),
+        ("wire_bytes", ctypes.c_int64),
         ("op", ctypes.c_int32),
         ("peer", ctypes.c_int32),
         ("tag", ctypes.c_int32),
@@ -44,13 +50,16 @@ def available(lib) -> bool:
     """True when the loaded .so carries the event ring (a stale prebuilt
     library predating it keeps working, just unobserved).
 
-    ``tpucomm_execute`` doubles as the layout probe: a library from
-    before the async progress engine records events WITHOUT the
-    ``queue_s`` field, which this module would misparse — such a
+    ``tpucomm_quant_packed_bytes`` doubles as the layout probe: a
+    library from before the quantized collective engine records events
+    WITHOUT the ``wire_bytes`` field (and pre-progress-engine ones also
+    lack ``queue_s``), which this module would misparse — such a
     library is treated as unobserved rather than decoded wrong."""
     if lib is None or not hasattr(lib, "tpucomm_obs_enable"):
         return False
     if not hasattr(lib, "tpucomm_execute"):
+        return False
+    if not hasattr(lib, "tpucomm_quant_packed_bytes"):
         return False
     # idempotent signature setup (works for bridge-loaded and
     # standalone-loaded libraries alike)
@@ -113,6 +122,7 @@ def drain(lib, max_events: int = 1 << 20):
             "wait_s": e.wait_s,
             "queue_s": e.queue_s,
             "bytes": e.nbytes,
+            "wire_bytes": e.wire_bytes,
             "peer": e.peer,
             "tag": e.tag,
             "algo": ALGO_NAMES.get(e.algo),

@@ -100,7 +100,7 @@ def enabled() -> bool:
 
 def default_capacity_events() -> int:
     """Ring capacity from ``MPI4JAX_TPU_TRACE_BUF_KB`` (default 256 KB
-    of 56-byte native slots ≈ 4600 events; same count on the Python
+    of 64-byte native slots = 4096 events; same count on the Python
     side)."""
     raw = config.setting("MPI4JAX_TPU_TRACE_BUF_KB", "256")
     try:
@@ -192,7 +192,7 @@ def _pull_native() -> None:
     to_unix = _state.unix0 - _state.steady0
     canon = []
     for e in raw:
-        canon.append({
+        ev = {
             "name": e["name"],
             "src": "native",
             "ts_us": (e["t"] + to_unix) * 1e6 + _state.clock_offset_us,
@@ -203,7 +203,15 @@ def _pull_native() -> None:
             "peer": e["peer"],
             "tag": e["tag"],
             "algo": e["algo"],
-        })
+        }
+        # wire_bytes defaults to the logical bytes everywhere (schema
+        # compatibility with pre-quantization recordings); carry it
+        # only when it differs — a quantized collective's compressed
+        # payload
+        wb = e.get("wire_bytes", e["bytes"])
+        if wb != e["bytes"]:
+            ev["wire_bytes"] = wb
+        canon.append(ev)
     _state.native_acc.extend(canon)
 
 

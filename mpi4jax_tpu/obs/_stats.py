@@ -11,6 +11,11 @@ Canonical event shape (every producer — the native ring, the ops-layer
      "bytes": int, "peer": int, "tag": int,
      "algo": "ring" | ... | None}
 
+plus an optional ``wire_bytes`` carried ONLY when it differs from
+``bytes`` (quantized collectives: the packed int8+scales payload).
+Every consumer defaults it to ``bytes``, so pre-quantization
+recordings stay schema-compatible.
+
 ``dispatch_us`` is the submission-queue delay of an engine-queued op
 (post -> native execution start; 0 for inline execution) — the host
 dispatch share, separated from the peer-wait share (``wait_us``) and
@@ -77,8 +82,10 @@ def summarize(events, dropped=None, rank=None) -> dict:
         waits = [float(e.get("wait_us", 0.0)) for e in evs]
         disps = [float(e.get("dispatch_us", 0.0)) for e in evs]
         nbytes = sum(int(e.get("bytes", 0)) for e in evs)
+        wire_bytes = sum(int(e.get("wire_bytes", e.get("bytes", 0)))
+                         for e in evs)
         seconds = sum(durs) / 1e6
-        rows.append({
+        row = {
             "op": op,
             "src": src,
             "peer": peer,
@@ -92,7 +99,16 @@ def summarize(events, dropped=None, rank=None) -> dict:
             "dispatch_frac": round(sum(disps) / max(sum(durs), 1e-12), 4),
             "wait_frac": round(sum(waits) / max(sum(durs), 1e-12), 4),
             "eff_GBps": _sig(nbytes / max(seconds, 1e-12) / 1e9),
-        })
+        }
+        if wire_bytes != nbytes:
+            # quantized wire formats: logical vs on-wire payload.  The
+            # column appears only when it says something (exact rows
+            # stay schema-identical to pre-quantization stats), and
+            # eff_GBps above stays LOGICAL bytes over wall time — the
+            # number comparable across compressed and exact runs.
+            row["wire_bytes"] = wire_bytes
+            row["compression"] = _sig(nbytes / max(wire_bytes, 1))
+        rows.append(row)
     out = {
         "schema": STATS_SCHEMA,
         "total_events": len(events),
@@ -109,6 +125,10 @@ def render_table(stats: dict, *, by=("op", "algo")) -> str:
     cols = ("op", "src", "peer", "algo", "count", "bytes", "p50_us",
             "p95_us", "p99_us", "dispatch_frac", "wait_frac", "eff_GBps")
     rows = stats.get("per_op", [])
+    if any("compression" in r for r in rows):
+        # quantized rows present: show the on-wire compression ratio
+        # (exact rows render blank — their wire IS the logical payload)
+        cols = cols + ("compression",)
     if not rows:
         return "(no events recorded)"
     widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))

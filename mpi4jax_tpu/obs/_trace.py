@@ -52,6 +52,9 @@ def rank_trace_events(events, rank: int):
         }
         if ev.get("algo"):
             args["algo"] = ev["algo"]
+        wb = int(ev.get("wire_bytes", ev.get("bytes", 0)))
+        if wb != args["bytes"]:
+            args["wire_bytes"] = wb  # quantized: compressed payload
         out.append({"name": ev.get("name", "?"), "cat": ev.get("src", "?"),
                     "ph": "X", "pid": int(rank), "tid": tid,
                     "ts": round(ts, 3), "dur": round(dur, 3), "args": args})

@@ -591,11 +591,15 @@ def _register_ffi_lowering(p, target, identity_param=None,
             return [args[0]]  # identity pass, no communication
         from ..runtime import bridge
 
-        if not params.get("ordered", True) or not bridge.ffi_available():
-            # unordered (explicit-token) mode keeps the callback route:
-            # the FFI call's wire format carries the compiler token
+        if (params.get("algo") or not params.get("ordered", True)
+                or not bridge.ffi_available()):
+            # unordered (explicit-token) mode keeps the callback route
+            # (the FFI call's wire format carries the compiler token),
+            # and so does a forced per-call algorithm (the quantized
+            # allreduce path) — the FFI attribute schema has no algo slot
             return p._callback_lowering(ctx, *args, **params)
         params.pop("ordered", None)
+        params.pop("algo", None)
         return _emit_ffi_call(ctx, target, args, _ffi_attrs(**params),
                               alias_in_out=alias_in_out)
 
@@ -630,6 +634,8 @@ def _token_ffi_attrs(name, params):
     params = dict(params)
     if params.pop("status", None) is not None:
         return None
+    if params.pop("algo", None) is not None:
+        return None  # forced (quantized) algorithm: callback route only
     op = params.get("op")
     if op is not None and op.name not in _OP_CODE:
         return None  # custom ReduceOp: the fold runs in Python
@@ -896,19 +902,31 @@ def _reuse_ok() -> bool:
     return not _use_staged_eager()
 
 
-def _host_allreduce(x, *, comm, op):
+def _host_allreduce(x, *, comm, op, algo=None):
     from ..runtime import bridge
 
+    if algo is not None:
+        from .. import tune as _tune
+
+        algo_code = _tune.ALGO_CODES[algo]
+        detail = f"op {op.name} algo {algo} (forced)"
+    else:
+        algo_code = None
+        detail = None
     with tracing.CallTrace(
         comm.rank(), "Allreduce",
-        lambda: f"op {op.name} algo "
-                f"{_coll_algo_detail(comm, 'allreduce', x.nbytes)}",
+        (lambda: detail) if detail is not None else
+        (lambda: f"op {op.name} algo "
+                 f"{_coll_algo_detail(comm, 'allreduce', x.nbytes)}"),
         nbytes=x.nbytes,
     ):
+        # the plan signature stays ("allreduce", reduce_op, nbytes):
+        # a quantized call IS an allreduce to the verifier and the
+        # schedule compiler — only the wire encoding differs
         return _plan_sync(
             comm, "allreduce",
             lambda: bridge.allreduce(comm.handle, x, _OP_CODE[op.name],
-                                     reuse=_reuse_ok()),
+                                     algo=algo_code, reuse=_reuse_ok()),
             reduce_op=op.name, nbytes=x.nbytes,
         )
 
@@ -1108,10 +1126,12 @@ def _host_sendrecv(x, *, comm, source, dest, sendtag, recvtag, status=None):
 allreduce_p = core.Primitive("mpi4jax_tpu_allreduce")
 
 
-def _host_allreduce_or_identity(x, *, comm, op, transpose=False):
+def _host_allreduce_or_identity(x, *, comm, op, transpose=False,
+                                algo=None):
     # the transposed pass is a communication-free identity (reference
     # allreduce.py:87-89 there)
-    return x if transpose else _host_allreduce(x, comm=comm, op=op)
+    return x if transpose else _host_allreduce(x, comm=comm, op=op,
+                                               algo=algo)
 
 
 _allreduce_staged = _staged_eager_impl(
@@ -1121,19 +1141,20 @@ _allreduce_staged = _staged_eager_impl(
 )
 
 
-def _allreduce_impl(x, *, comm, op, transpose=False, ordered=True):
+def _allreduce_impl(x, *, comm, op, transpose=False, ordered=True,
+                    algo=None):
     if transpose:
         return x  # identity: skip the staging D2H/H2D round trip too
     # (_allreduce_staged's eager_impl performs the analysis intercept)
     return _allreduce_staged(x, comm=comm, op=op, transpose=transpose,
-                             ordered=ordered)
+                             ordered=ordered, algo=algo)
 
 
 allreduce_p.def_impl(_allreduce_impl)
 
 
 def _allreduce_abstract_eval(x_aval, *, comm, op, transpose=False,
-                             ordered=True):
+                             ordered=True, algo=None):
     if transpose:
         effects = set()
     else:
@@ -1144,7 +1165,8 @@ def _allreduce_abstract_eval(x_aval, *, comm, op, transpose=False,
 allreduce_p.def_effectful_abstract_eval(_allreduce_abstract_eval)
 
 
-def _allreduce_lowering(ctx, x, *, comm, op, transpose=False, ordered=True):
+def _allreduce_lowering(ctx, x, *, comm, op, transpose=False, ordered=True,
+                        algo=None):
     if transpose:
         return [x]  # identity pass, no communication
     _check_callback_support(ctx)
@@ -1154,7 +1176,7 @@ def _allreduce_lowering(ctx, x, *, comm, op, transpose=False, ordered=True):
     def _callback(*flat):
         result = _host_allreduce(
             *[_np(a, av) for a, av in zip(flat, ctx.avals_in)],
-            comm=comm, op=op,
+            comm=comm, op=op, algo=algo,
         )
         return (_contig(np.asarray(result, dtype=out_aval.dtype)),)
 
@@ -1356,11 +1378,13 @@ def _ad_chain_set(tok):
     _ad_side_chain[id(trace)] = [wr, tok]
 
 
-def _allreduce_t_jvp(primals, tangents, *, comm, op, transpose=False):
+def _allreduce_t_jvp(primals, tangents, *, comm, op, transpose=False,
+                     algo=None):
     x, token = primals
     x_tan, _token_tan = tangents
     p = _token_variants["allreduce"]
-    val, tok = p.bind(x, token, comm=comm, op=op, transpose=transpose)
+    val, tok = p.bind(x, token, comm=comm, op=op, transpose=transpose,
+                      algo=algo)
     if type(x_tan) is ad.Zero:
         # a symbolically-zero tangent differentiates nothing — legal for
         # any op (a non-SUM op behind stop_gradient must not raise)
@@ -1372,12 +1396,13 @@ def _allreduce_t_jvp(primals, tangents, *, comm, op, transpose=False):
         )
     else:
         jvp, tok_jvp = p.bind(x_tan, _ad_chain_token(tok), comm=comm,
-                              op=op, transpose=transpose)
+                              op=op, transpose=transpose, algo=algo)
         _ad_chain_set(tok_jvp)
     return (val, tok), (jvp, ad.Zero.from_primal_value(tok))
 
 
-def _allreduce_t_transpose(cts, x, token, *, comm, op, transpose=False):
+def _allreduce_t_transpose(cts, x, token, *, comm, op, transpose=False,
+                           algo=None):
     ct_out, ct_tok = cts
     if op.name != "SUM":
         raise NotImplementedError(
@@ -1390,7 +1415,8 @@ def _allreduce_t_transpose(cts, x, token, *, comm, op, transpose=False):
     ct_out = ad.instantiate_zeros(ct_out)
     res, tok_out = p.bind(ct_out,
                           _ad_chain_token(_token_or_fresh(token)),
-                          comm=comm, op=op, transpose=not transpose)
+                          comm=comm, op=op, transpose=not transpose,
+                          algo=algo)
     _ad_chain_set(tok_out)
     return res, ct_tok
 
@@ -1451,13 +1477,15 @@ ad.primitive_transposes[_t_sendrecv_p] = _sendrecv_t_transpose
 
 
 def _allreduce_jvp(primals, tangents, *, comm, op, transpose=False,
-                   ordered=True):
+                   ordered=True, algo=None):
     # reference: JVP defined for SUM only (allreduce.py:192-195 there);
     # a symbolically-zero tangent short-circuits first, so a non-SUM op
-    # behind stop_gradient is legal
+    # behind stop_gradient is legal.  A forced (quantized) algorithm
+    # rides along: the tangent sync compresses exactly like the primal
+    # (the reference DP recipe quantizes gradients, not just values).
     (x,), (t,) = primals, tangents
     primal_out = allreduce_p.bind(x, comm=comm, op=op, transpose=transpose,
-                                  ordered=ordered)
+                                  ordered=ordered, algo=algo)
     if type(t) is ad.Zero:
         tangent_out = ad.Zero.from_primal_value(primal_out)
     elif op.name != "SUM":
@@ -1467,18 +1495,19 @@ def _allreduce_jvp(primals, tangents, *, comm, op, transpose=False,
         )
     else:
         tangent_out = allreduce_p.bind(
-            t, comm=comm, op=op, transpose=transpose, ordered=ordered
+            t, comm=comm, op=op, transpose=transpose, ordered=ordered,
+            algo=algo
         )
     return primal_out, tangent_out
 
 
 def _allreduce_transpose(ct, x, *, comm, op, transpose=False,
-                         ordered=True):
+                         ordered=True, algo=None):
     # flip the flag: transpose(allreduce) is the identity pass, and
     # transpose of that is allreduce again (reference allreduce.py:206-218)
     return (
         allreduce_p.bind(ct, comm=comm, op=op, transpose=not transpose,
-                         ordered=ordered),
+                         ordered=ordered, algo=algo),
     )
 
 
@@ -1593,7 +1622,11 @@ batching.primitive_batchers[send_p] = _send_batching
 # ---------------- public entry points (called from op modules) -----------
 
 
-def allreduce(x, op: ReduceOp, comm):
+def allreduce(x, op: ReduceOp, comm, algo=None):
+    """``algo`` forces a collective algorithm name for this one call —
+    the quantized-compression route passes "qring"/"qrd" here; None
+    (the default) keeps engine selection.  Not meaningful for custom
+    reduce ops (their fold rides allgather)."""
     x = jnp.asarray(x)  # dtype validated at the ops-layer entry
     if op.custom:
         # user-defined op: the wire protocol carries no user code, so
@@ -1602,7 +1635,7 @@ def allreduce(x, op: ReduceOp, comm):
         rows = allgather_p.bind(x, comm=comm, ordered=_ordered_now())
         return op.reduce(rows).astype(x.dtype)
     return allreduce_p.bind(x, comm=comm, op=op, transpose=False,
-                            ordered=_ordered_now())
+                            ordered=_ordered_now(), algo=algo)
 
 
 def reduce(x, op: ReduceOp, root, comm):

@@ -48,10 +48,26 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
             from .quantized import quantized_allreduce_sum
 
             body = lambda v: quantized_allreduce_sum(v, comm.axis)
-        else:
-            from .quantized import quantized_allreduce_sum_world
+            return _dispatch.maybe_tokenized(body, x, token)
+        from . import _world_impl
+        from .quantized import check_quantizable, native_quant_algo
 
-            body = lambda v: quantized_allreduce_sum_world(v, comm)
+        check_quantizable(x, comm)
+        algo = native_quant_algo(comm, x)
+        if algo is not None:
+            # native in-collective path: ONE allreduce whose wire frames
+            # carry int8 codes + f32 absmax scales (qring/qrd in
+            # native/tpucomm.cc) — the schedule signature is still
+            # "allreduce", so the verifier and the plan compiler treat
+            # it exactly like the exact collective
+            body = lambda v: _world_impl.allreduce(v, op, comm, algo=algo)
+            return _dispatch.maybe_tokenized(
+                body, x, token,
+                token_fn=_world_impl.token_variant_fn(
+                    "allreduce", comm=comm, op=op, algo=algo))
+        from .quantized import quantized_allreduce_sum_world
+
+        body = lambda v: quantized_allreduce_sum_world(v, comm)
         return _dispatch.maybe_tokenized(body, x, token)
 
     if _dispatch.is_mesh(comm):

@@ -1,20 +1,43 @@
 """Quantized (int8) allreduce — trade precision for wire bandwidth.
 
 Technique pattern after EQuARX (PAPERS.md: "Efficient Quantized AllReduce
-in XLA"): an allreduce decomposed into reduce-scatter + all-gather with
-block-quantized int8 payloads and per-block scales, cutting wire bytes ~4x
-for float32 (~2x for bfloat16) at ~1e-2 relative error.  Own
-implementation, both tiers:
+in XLA"): block-quantized int8 payloads with f32 absmax scales cut wire
+bytes ~4x for float32 (~2x for bfloat16) at ~1e-2 relative error.
 
-1. split the flattened array into ``size`` destination chunks;
-2. per-chunk absmax scales; quantize to int8;
-3. one ``all_to_all`` moves int8 chunks (+ tiny f32 scales);
-4. dequantize, reduce the ``size`` partial chunks locally (f32 math);
-5. re-quantize the reduced chunk, ``all_gather`` it back, dequantize.
+Two execution paths:
 
-On the mesh tier the transfers are XLA collectives over ICI; on the
-world tier they are the same alltoall/allgather schedule over the native
-TCP transport (DCN analog), where the 4x byte saving matters even more.
+- **Native in-collective path (world tier, preferred):** the transport's
+  algorithm engine carries ``qring`` / ``qrd`` allreduce schedules that
+  quantize per chunk at the sender, ship int8 codes + per-256-element
+  f32 absmax scales in ONE wire frame per chunk, and dequantize-and-
+  reduce streaming in f32 at the receiver (``native/tpucomm.cc``).
+  ``allreduce(..., compression="int8")`` routes here whenever the comm
+  is world-tier, the native library carries the quantized engine, and
+  ``MPI4JAX_TPU_COLL_QUANT`` is not ``deny``.  Results are
+  rank-consistent: every rank reconstructs bit-identical output.
+
+- **Python schedule (mesh tier, and the world-tier fallback):** the
+  EQuARX decomposition expressed in jax ops —
+
+  1. split the flattened array into ``size`` destination chunks;
+  2. per-chunk absmax scales; quantize to int8;
+  3. ONE ``all_to_all`` moves int8 chunks with their f32 scales packed
+     into the same int8 payload (bitcast — no separate scale leg);
+  4. dequantize, reduce the ``size`` partial chunks locally (f32 math);
+  5. re-quantize the reduced chunk, ONE ``all_gather`` returns it
+     (scales packed the same way), dequantize.
+
+  On the mesh tier the transfers are XLA collectives over ICI; on the
+  world tier they ride the native transport.
+
+This module also hosts the **numpy reference** of the native wire codec
+(`quant_pack_ref` / `quant_unpack_ref`, bit-identical to
+``tpucomm_quant_pack``/``unpack`` — test-enforced) and per-rank
+**schedule simulators** (:func:`simulate_qring_sum`,
+:func:`simulate_qrd_sum`) that reproduce the native algorithms' exact
+f32 arithmetic without any transport — the accuracy harness
+(``benchmarks/quant_accuracy.py``) drives DP training steps through them
+to bound the quality cost of quantized gradient synchronization.
 
 Exposed via ``allreduce(..., compression="int8")`` and directly as
 :func:`quantized_allreduce_sum` / :func:`quantized_allreduce_sum_world`.
@@ -22,14 +45,17 @@ Exposed via ``allreduce(..., compression="int8")`` and directly as
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-from jax import lax
+import numpy as np
 
-from . import _mesh_impl
+#: elements per f32 absmax scale in the native wire codec — keep in sync
+#: with ``kQuantBlock`` in native/tpucomm.cc (test-enforced via the
+#: packed-bytes probe)
+QUANT_BLOCK = 256
 
 
 def _pad_to(x, n):
+    import jax.numpy as jnp
+
     flat = x.reshape(-1)
     pad = (-flat.size) % n
     if pad:
@@ -39,6 +65,8 @@ def _pad_to(x, n):
 
 def _quantize(x):
     """per-row int8 quantization: x (rows, k) → (q int8, scale f32 (rows,))."""
+    import jax.numpy as jnp
+
     absmax = jnp.max(jnp.abs(x), axis=-1)
     scale = jnp.where(absmax > 0, absmax / 127.0, 1.0).astype(jnp.float32)
     q = jnp.clip(
@@ -52,7 +80,7 @@ def check_quantizable(x, comm=None):
     quantize/dequantize round-trip runs in f32 (complex would silently
     drop the imaginary part; integers would lose exactness the normal
     path guarantees)."""
-    import numpy as np
+    import jax.numpy as jnp
 
     from ..utils import validation as _validation
 
@@ -64,26 +92,91 @@ def check_quantizable(x, comm=None):
             exc=TypeError)
 
 
+def native_quant_algo(comm, x):
+    """The native in-collective algorithm name ("qring"/"qrd") that
+    should carry a world-tier ``compression="int8"`` allreduce, or None
+    when the Python schedule must serve it: the loaded native library
+    predates the quantized engine, or ``MPI4JAX_TPU_COLL_QUANT=deny``
+    vetoes int8 wire formats process-wide.
+
+    The pick mirrors the tune table's exact-algorithm decision for the
+    payload size (ring-family sizes compress as qring, latency-bound
+    sizes as qrd), so a tuned deployment keeps its shape.  Inside an
+    analysis virtual world the native library is never probed — the
+    verified schedule pins the native path's (identical) signature.
+    """
+    from ..utils import config
+
+    if config.quant_mode() == "deny":
+        return None
+    from . import _world_impl
+
+    ex = _world_impl._analysis_executor
+    if ex is None or not ex.owns(comm):
+        if type(comm).__name__ == "AbstractComm":
+            # abstract-eval analysis (analysis.check): no live transport
+            # exists and none may be built — route as if the native
+            # engine were present so the verified schedule matches the
+            # production path's (identical allreduce) signature
+            pass
+        else:
+            from ..runtime import bridge
+
+            if not bridge.quant_available():
+                return None
+    from .. import tune
+
+    nbytes = int(x.size) * np.dtype(x.dtype).itemsize
+    return tune.quantized_algorithm(nbytes)
+
+
+def _pack_scales(q, scale):
+    """Append each row's f32 scale to its int8 payload (bitcast, no
+    widening): (rows, k) int8 + (rows,) f32 -> (rows, k+4) int8.  One
+    collective leg then moves codes AND scales — half the round count
+    of the historic separate-scale schedule, bit-identical results
+    (the bitcast preserves the exact scale bits)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    sbytes = lax.bitcast_convert_type(
+        scale.astype(jnp.float32), jnp.int8)  # (rows, 4)
+    return jnp.concatenate([q, sbytes], axis=-1)
+
+
+def _unpack_scales(packed):
+    """Inverse of :func:`_pack_scales`: (rows, k+4) -> ((rows, k) int8,
+    (rows,) f32)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    q = packed[..., :-4]
+    scale = lax.bitcast_convert_type(packed[..., -4:], jnp.float32)
+    return q, scale
+
+
 def _quantized_schedule(x, size, alltoall, allgather):
     """The one copy of the EQuARX-style schedule; the two tiers inject
     their transport legs (``alltoall(rows)``/``allgather(row)`` both
-    follow the (size, ...) leading-axis contract)."""
+    follow the (size, ...) leading-axis contract).  Scales ride inside
+    the int8 payload (``_pack_scales``), so each phase is ONE leg."""
+    import jax.numpy as jnp
+
     orig_dtype = x.dtype
     flat, pad = _pad_to(x, size)
     chunks = flat.reshape(size, -1)  # row j -> rank j
 
     q, scale = _quantize(chunks)
-    # one alltoall for payloads, one for the (tiny) scales
-    q_t = alltoall(q)                          # (size, chunk) int8
-    s_t = alltoall(scale.reshape(size, 1))     # (size, 1) f32
+    packed = alltoall(_pack_scales(q, scale))   # (size, chunk+4) int8
+    q_t, s_t = _unpack_scales(packed)
     # rows: every rank's contribution to OUR chunk; reduce in f32
-    partial = q_t.astype(jnp.float32) * s_t
-    mine = jnp.sum(partial, axis=0)            # (chunk,)
+    partial = q_t.astype(jnp.float32) * s_t[:, None]
+    mine = jnp.sum(partial, axis=0)             # (chunk,)
 
-    # re-quantize the reduced chunk and share it
+    # re-quantize the reduced chunk and share it (scales packed along)
     q2, s2 = _quantize(mine[None])
-    q_all = allgather(q2[0])                   # (size, chunk)
-    s_all = allgather(s2[0])                   # (size,)
+    packed2 = allgather(_pack_scales(q2, s2)[0])  # (size, chunk+4)
+    q_all, s_all = _unpack_scales(packed2)
     full = (q_all.astype(jnp.float32) * s_all[:, None]).reshape(-1)
     if pad:
         full = full[:-pad]
@@ -96,6 +189,10 @@ def quantized_allreduce_sum(x, axis):
     Returns an approximation of ``psum(x, axis)`` with ~1e-2 relative
     error; payload on the wire is ~1/4 of the float32 collective.
     """
+    from jax import lax
+
+    from . import _mesh_impl
+
     check_quantizable(x)
     size = lax.axis_size(axis)
     x = _mesh_impl.as_varying(x, axis)
@@ -109,9 +206,11 @@ def quantized_allreduce_sum(x, axis):
 
 def quantized_allreduce_sum_world(x, comm):
     """SUM allreduce with int8-compressed transfers over the world-tier
-    native transport — identical schedule to the mesh version, with the
-    alltoall/allgather legs carried by the TCP transport (the DCN path,
-    where the ~4x byte saving is the point)."""
+    native transport — the Python fallback schedule (identical to the
+    mesh version, legs carried by the TCP transport).  The preferred
+    world-tier route is the native in-collective ``qring``/``qrd`` path
+    (see :func:`native_quant_algo`); ``allreduce(compression="int8")``
+    only lands here when that path is unavailable or denied."""
     from . import _world_impl
 
     check_quantizable(x, comm)
@@ -120,3 +219,116 @@ def quantized_allreduce_sum_world(x, comm):
         lambda rows: _world_impl.alltoall(rows, comm),
         lambda row: _world_impl.allgather(row, comm),
     )
+
+
+# ---------------- numpy reference of the native wire codec ----------------
+#
+# Bit-identical to native/tpucomm.cc's quant_pack_f32/quant_unpack_f32
+# (test-enforced against the real library): per-256-element blocks,
+# scale = absmax/127 (1.0 for an all-zero block), codes =
+# round-to-nearest-even of value * (1/scale) clipped to ±127, all in
+# f32.  The schedule simulators below compose these exactly like the
+# native algorithms, so the accuracy harness measures the REAL
+# quantization error, not an approximation of it.
+
+
+def quant_pack_ref(x):
+    """(scales f32 (nblocks,), codes int8 (n,)) for a 1-D f32 array."""
+    x = np.ascontiguousarray(x, np.float32).reshape(-1)
+    n = x.size
+    nb = max((n + QUANT_BLOCK - 1) // QUANT_BLOCK, 0)
+    padded = np.zeros(nb * QUANT_BLOCK, np.float32)
+    padded[:n] = x
+    blocks = padded.reshape(nb, QUANT_BLOCK)
+    amax = np.max(np.abs(blocks), axis=1)
+    scale = np.where(amax > 0, amax / np.float32(127.0),
+                     np.float32(1.0)).astype(np.float32)
+    inv = (np.float32(1.0) / scale).astype(np.float32)
+    v = (blocks * inv[:, None]).astype(np.float32)
+    v = np.clip(v, np.float32(-127.0), np.float32(127.0))
+    codes = np.rint(v).astype(np.int8).reshape(-1)[:n]
+    return scale, codes
+
+
+def quant_unpack_ref(scales, codes):
+    """f32 values from (scales, codes) — exact: scale * code."""
+    codes = np.asarray(codes, np.int8)
+    n = codes.size
+    nb = scales.size
+    padded = np.zeros(nb * QUANT_BLOCK, np.float32)
+    padded[:n] = codes.astype(np.float32)
+    out = (padded.reshape(nb, QUANT_BLOCK)
+           * scales.astype(np.float32)[:, None]).astype(np.float32)
+    return out.reshape(-1)[:n]
+
+
+def _qdq_ref(x):
+    """quantize-dequantize round trip (the owner-requantize step)."""
+    scales, codes = quant_pack_ref(x)
+    return quant_unpack_ref(scales, codes)
+
+
+def _chunk_lo(count, size, i):
+    per = (count + size - 1) // size
+    return min(per * i, count)
+
+
+def simulate_qring_sum(parts):
+    """The native ``qring`` allreduce's exact arithmetic over per-rank
+    f32 arrays, no transport: a direct quantized reduce-scatter (each
+    rank's inputs quantized once; contributions folded in fixed rank
+    order) followed by the once-quantized allgather.  Returns the ONE
+    result every rank reconstructs (the native algorithm is
+    rank-consistent by construction)."""
+    parts = [np.ascontiguousarray(p, np.float32).reshape(-1) for p in parts]
+    size = len(parts)
+    count = parts[0].size
+    if size == 1:
+        return parts[0].copy()
+    out = np.empty(count, np.float32)
+    for c in range(size):
+        lo, hi = _chunk_lo(count, size, c), _chunk_lo(count, size, c + 1)
+        acc = parts[c][lo:hi].astype(np.float32)  # owner's own data, exact
+        # arrival order rank-1, rank-2, ... (the fixed fold order)
+        for round_ in range(1, size):
+            src = (c - round_) % size
+            acc = (acc + _qdq_ref(parts[src][lo:hi])).astype(np.float32)
+        out[lo:hi] = _qdq_ref(acc)  # once-quantized allgather
+    return out
+
+
+def simulate_qrd_sum(parts):
+    """The native ``qrd`` allreduce's exact arithmetic (quantized
+    recursive doubling with the non-power-of-two fold and the final
+    requantize that keeps every rank bit-identical)."""
+    parts = [np.ascontiguousarray(p, np.float32).reshape(-1) for p in parts]
+    size = len(parts)
+    if size == 1:
+        return parts[0].copy()
+    accs = [p.astype(np.float32) for p in parts]
+    pof2 = 1
+    while pof2 * 2 <= size:
+        pof2 *= 2
+    rem = size - pof2
+    group = {}  # newrank -> acc
+    for rank in range(size):
+        if rank < 2 * rem:
+            if rank % 2 == 1:
+                group[rank // 2] = (_qdq_ref(accs[rank])
+                                    + _qdq_ref(accs[rank - 1])
+                                    ).astype(np.float32)
+        else:
+            group[rank - rem] = accs[rank]
+    for shift in range(pof2.bit_length() - 1):
+        mask = 1 << shift
+        nxt = {}
+        for nr, acc in group.items():
+            peer = nr ^ mask
+            nxt[nr] = (_qdq_ref(acc) + _qdq_ref(group[peer])
+                       ).astype(np.float32)
+        group = nxt
+    # all butterfly participants are bit-identical now
+    result = group[0]
+    if rem > 0:
+        result = _qdq_ref(result)  # the quantized return frame
+    return result

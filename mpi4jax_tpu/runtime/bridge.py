@@ -288,6 +288,52 @@ def coll_algo_for(handle, op_kind: int, nbytes: int):
     return code
 
 
+
+def quant_available() -> bool:
+    """True when the loaded native library carries the quantized
+    collective engine (qring/qrd wire formats + the codec exports) —
+    the gate the ops layer uses before routing
+    ``allreduce(compression="int8")`` to the native path."""
+    return hasattr(get_lib(), "tpucomm_quant_packed_bytes")
+
+
+def quant_packed_bytes(count: int) -> int:
+    """On-wire bytes of ``count`` elements under the native int8+scales
+    codec (``4 * ceil(count/256) + count``)."""
+    lib = get_lib()
+    lib.tpucomm_quant_packed_bytes.restype = ctypes.c_int64
+    lib.tpucomm_quant_packed_bytes.argtypes = [ctypes.c_int64]
+    return int(lib.tpucomm_quant_packed_bytes(_i64(count)))
+
+
+def quant_pack(buf: np.ndarray) -> np.ndarray:
+    """Pack a float array through the NATIVE wire codec (the exact
+    bytes qring/qrd put on the wire); returns the packed int8 buffer.
+    Raises on an ineligible dtype — mirrors the engine's gate."""
+    buf = _contig(buf)
+    out = np.empty(quant_packed_bytes(buf.size), np.int8)
+    rc = get_lib().tpucomm_quant_pack(
+        _ptr(buf), _i64(buf.size), _dtypes.wire_code(buf.dtype), _ptr(out))
+    if rc != 0:
+        raise TypeError(
+            f"dtype {buf.dtype} has no quantized wire format (real "
+            "floating dtypes only)")
+    return out
+
+
+def quant_unpack(packed: np.ndarray, count: int, dtype) -> np.ndarray:
+    """Inverse of :func:`quant_pack` (native codec)."""
+    packed = _contig(packed)
+    out = np.empty(int(count), dtype)
+    rc = get_lib().tpucomm_quant_unpack(
+        _ptr(packed), _i64(count), _dtypes.wire_code(out.dtype), _ptr(out))
+    if rc != 0:
+        raise TypeError(
+            f"dtype {out.dtype} has no quantized wire format (real "
+            "floating dtypes only)")
+    return out
+
+
 def shm_info(handle: int):
     """(active, slot_bytes, ring_bytes) for a comm's same-host fast
     paths — 'active' False means the comm runs on TCP only (cross-host

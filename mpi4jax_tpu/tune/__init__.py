@@ -1,8 +1,9 @@
 """Collective algorithm engine: selection, overrides, persistent autotuning.
 
 The world tier's TCP collectives carry selectable schedules (ring /
-recursive doubling / binomial tree — ``native/tpucomm.cc``); this package
-owns WHICH one runs.  Selection is a per-(op, payload-size-bucket)
+recursive doubling / binomial tree, plus the quantized-wire qring/qrd
+allreduce twins — ``native/tpucomm.cc``); this package owns WHICH one
+runs.  Selection is a per-(op, payload-size-bucket)
 decision table resolved in layers, strongest last:
 
 1. static defaults (``_DEFAULT_TABLE`` — the pre-engine heuristics),
@@ -39,10 +40,52 @@ import os
 from typing import Dict, List, Optional, Sequence, Tuple
 
 # keep in sync with native/tpucomm.h (TpuCollAlgo / TpuCollOpKind)
-ALGO_CODES = {"auto": 0, "ring": 1, "rd": 2, "tree": 3, "shm": 4}
+ALGO_CODES = {"auto": 0, "ring": 1, "rd": 2, "tree": 3, "shm": 4,
+              "qring": 5, "qrd": 6}
 ALGO_NAMES = {v: k for k, v in ALGO_CODES.items()}
 OPS = ("allreduce", "allgather")
 OP_KIND = {"allreduce": 0, "allgather": 1}
+
+#: quantized wire-format algorithms (EQuARX-style int8 codes + f32
+#: absmax scales inside every collective frame) — allreduce only,
+#: selected by the native engine only for real floating dtypes with
+#: SUM (anything else silently degrades to the exact twin), and gated
+#: process-wide by MPI4JAX_TPU_COLL_QUANT (allow | deny | force).
+QUANT_ALGOS = frozenset(("qring", "qrd"))
+#: exact counterpart a quantized algorithm degrades to, and the
+#: quantized twin an exact pick promotes to (tree's broadcast shape has
+#: no quantized schedule; its latency regime maps to qrd)
+EXACT_TWIN = {"qring": "ring", "qrd": "rd"}
+QUANT_TWIN = {"ring": "qring", "rd": "qrd", "tree": "qrd",
+              "qring": "qring", "qrd": "qrd"}
+
+#: --from-trace promotion thresholds: an exact allreduce winner at or
+#: above this payload whose recorded wire share (dur - wait - dispatch)
+#: is at least this fraction is wire-bound — compressing its frames is
+#: the lever that helps, so the derived cache rows name the quantized
+#: twin (see cache_from_trace)
+QUANT_PROMOTE_MIN_BYTES = 64 * 1024
+QUANT_PROMOTE_WIRE_FRAC = 0.6
+
+#: algorithm labels whose recorded events carry tuning signal (every
+#: selectable TCP algorithm; "auto" never labels an event and "shm"
+#: measures the arena, not the engine) — THE one copy consumers share
+TRACE_ALGOS = frozenset(ALGO_CODES) - {"auto", "shm"}
+
+
+def _usable_trace_event(ev):
+    """(op, nbytes, dur_s) for a native TCP-path collective event with
+    an algorithm label, or None — the shared filter under
+    measurements_from_events and wire_fractions_from_events."""
+    op = str(ev.get("name", "")).lower()
+    if (op not in OPS or ev.get("src") != "native"
+            or ev.get("algo") not in TRACE_ALGOS):
+        return None
+    nbytes = int(ev.get("bytes", 0))
+    dur_s = float(ev.get("dur_us", 0.0)) / 1e6
+    if nbytes <= 0 or dur_s <= 0:
+        return None
+    return op, nbytes, dur_s
 
 CACHE_VERSION = 1
 
@@ -67,14 +110,19 @@ def _check_op(op: str) -> str:
     return op
 
 
-def _check_algo(algo: str) -> str:
+def _check_algo(algo: str, op: Optional[str] = None) -> str:
     name = str(algo).strip().lower()
     if name in ("recursive_doubling", "recursive-doubling"):
         name = "rd"
     if name not in ALGO_CODES or name == "shm":
         raise ValueError(
             f"unknown collective algorithm {algo!r} "
-            "(expected auto, ring, rd, or tree)"
+            "(expected auto, ring, rd, tree, qring, or qrd)"
+        )
+    if op == "allgather" and name in QUANT_ALGOS:
+        raise ValueError(
+            f"{name} is an allreduce-only algorithm: quantized wire "
+            "formats are lossy and allgather is pure data movement"
         )
     return name
 
@@ -110,7 +158,7 @@ def _validate_table(raw) -> Table:
             min_bytes = int(e[0])
             if min_bytes < 0:
                 raise ValueError(f"negative min_bytes in tune entry: {e!r}")
-            out.append((min_bytes, _check_algo(e[1])))
+            out.append((min_bytes, _check_algo(e[1], op)))
         table[op] = sorted(out)
     return table
 
@@ -174,13 +222,18 @@ def _env_table() -> Table:
     table: Table = {}
     if "=" not in raw:
         algo = _check_algo(raw)
+        # a bare quantized name governs allreduce only (it has no
+        # allgather schedule); other ops keep their normal selection
+        if algo in QUANT_ALGOS:
+            return {"allreduce": [(0, algo)]}
         return {op: [(0, algo)] for op in OPS}
     for part in raw.split(","):
         part = part.strip()
         if not part:
             continue
         op, _, algo = part.partition("=")
-        table[_check_op(op.strip())] = [(0, _check_algo(algo))]
+        op = _check_op(op.strip())
+        table[op] = [(0, _check_algo(algo, op))]
     return table
 
 
@@ -190,7 +243,7 @@ def set_algorithm(op: str, algo: str, min_bytes: int = 0) -> None:
     effect immediately on live communicators — the native layer re-reads
     the table per call."""
     op = _check_op(op)
-    _overrides[op][int(min_bytes)] = _check_algo(algo)
+    _overrides[op][int(min_bytes)] = _check_algo(algo, op)
     _reinstall()
 
 
@@ -240,6 +293,15 @@ def get_algorithm(op: str, nbytes: int) -> str:
         else:
             algo = "ring"
     return algo
+
+
+def quantized_algorithm(nbytes: int) -> str:
+    """The quantized wire-format algorithm that should carry an
+    allreduce of ``nbytes`` (the ``compression="int8"`` route): the
+    quantized twin of whatever the engine would pick exactly —
+    bandwidth-bound sizes compress as qring, latency-bound ones as
+    qrd — so a tuned deployment keeps its shape under compression."""
+    return QUANT_TWIN[get_algorithm("allreduce", nbytes)]
 
 
 def default_algorithm(op: str, nbytes: int) -> str:
@@ -306,17 +368,12 @@ def measurements_from_events(events) -> Dict[str, Dict[int, Dict[str, float]]]:
     """
     samples: Dict[str, Dict[int, Dict[str, List[float]]]] = {}
     for ev in events:
-        op = str(ev.get("name", "")).lower()
-        algo = ev.get("algo")
-        if (op not in OPS or ev.get("src") != "native"
-                or algo not in ("ring", "rd", "tree")):
+        usable = _usable_trace_event(ev)
+        if usable is None:
             continue
-        nbytes = int(ev.get("bytes", 0))
-        dur_s = float(ev.get("dur_us", 0.0)) / 1e6
-        if nbytes <= 0 or dur_s <= 0:
-            continue
+        op, nbytes, dur_s = usable
         samples.setdefault(op, {}).setdefault(nbytes, {}) \
-            .setdefault(algo, []).append(dur_s)
+            .setdefault(ev["algo"], []).append(dur_s)
     out: Dict[str, Dict[int, Dict[str, float]]] = {}
     for op, by_size in samples.items():
         for nbytes, by_algo in by_size.items():
@@ -332,8 +389,35 @@ def measurements_from_events(events) -> Dict[str, Dict[int, Dict[str, float]]]:
     return out
 
 
+def wire_fractions_from_events(events) -> Dict[str, Dict[int, Dict[str, float]]]:
+    """Mean recorded wire share — ``(dur - wait - dispatch) / dur`` —
+    per (op, payload bytes, algorithm), same event filter as
+    :func:`measurements_from_events`.  A high wire fraction means the
+    op spends its time MOVING bytes (not blocked on peers, not queued):
+    exactly the regime where compressing the frames pays, so
+    :func:`cache_from_trace` uses this to decide when an exact winner
+    should be promoted to its quantized twin."""
+    fracs: Dict[str, Dict[int, Dict[str, List[float]]]] = {}
+    for ev in events:
+        usable = _usable_trace_event(ev)
+        if usable is None:
+            continue
+        op, nbytes, dur_s = usable
+        wire_s = max(dur_s - float(ev.get("wait_us", 0.0)) / 1e6
+                     - float(ev.get("dispatch_us", 0.0)) / 1e6, 0.0)
+        fracs.setdefault(op, {}).setdefault(nbytes, {}) \
+            .setdefault(ev["algo"], []).append(wire_s / dur_s)
+    return {
+        op: {nbytes: {algo: sum(fr) / len(fr)
+                      for algo, fr in by_algo.items()}
+             for nbytes, by_algo in by_size.items()}
+        for op, by_size in fracs.items()
+    }
+
+
 def cache_from_trace(paths: Sequence[str], world_size: Optional[int] = None,
-                     cache_path_override: Optional[str] = None) -> str:
+                     cache_path_override: Optional[str] = None,
+                     quantize: bool = True) -> str:
     """Derive the persistent algorithm cache from a recorded real run
     (the ``python -m mpi4jax_tpu.tune --from-trace`` backend): the
     winner per (op, size) is the algorithm with the best median observed
@@ -342,6 +426,18 @@ def cache_from_trace(paths: Sequence[str], world_size: Optional[int] = None,
     traces; ``world_size`` defaults to the recordings' own metadata.
     Raises ``ValueError`` when the recording carries no usable TCP-path
     collective timings (e.g. the run rode the shm arena throughout).
+
+    With ``quantize`` (the default), an exact allreduce winner at
+    >= QUANT_PROMOTE_MIN_BYTES whose recorded wire share is at least
+    QUANT_PROMOTE_WIRE_FRAC is promoted to its quantized twin
+    (qring/qrd): the recording says those calls spend their time moving
+    bytes, so shrinking the frames is the available lever.  Promotion
+    is recorded per measurement (``promoted_from``); it is skipped
+    entirely under ``MPI4JAX_TPU_COLL_QUANT=deny`` (the native engine
+    would degrade the rows right back) and ineligible calls (integer
+    dtypes, non-SUM) degrade natively at dispatch, so a promoted row is
+    always safe.  Pass ``quantize=False`` (CLI: ``--no-quantize``) for
+    an exact-only table.
     """
     try:
         from ..obs import _dump as obs_dump
@@ -368,17 +464,41 @@ def cache_from_trace(paths: Sequence[str], world_size: Optional[int] = None,
             "cannot tell the recording's world size — pass world_size "
             "(tune --from-trace --np N)")
     samples = measurements_from_events(events)
+    if quantize:
+        try:
+            from ..utils.config import quant_mode
+        except ImportError:  # pragma: no cover - standalone tooling load
+            quant_mode = lambda: os.environ.get(  # noqa: E731
+                "MPI4JAX_TPU_COLL_QUANT", "allow").strip() or "allow"
+        quantize = quant_mode() != "deny"
+    wire_fracs = wire_fractions_from_events(events) if quantize else {}
     best: Dict[str, Dict[int, str]] = {}
     measurements = []
     for op, by_size in samples.items():
         for nbytes, by_algo in sorted(by_size.items()):
             winner = min(by_algo, key=by_algo.get)
+            promoted_from = None
+            if (quantize and op == "allreduce"
+                    and winner in ("ring", "rd", "tree")
+                    and nbytes >= QUANT_PROMOTE_MIN_BYTES):
+                frac = wire_fracs.get(op, {}).get(nbytes, {}) \
+                    .get(winner, 0.0)
+                if frac >= QUANT_PROMOTE_WIRE_FRAC:
+                    promoted_from, winner = winner, QUANT_TWIN[winner]
             best.setdefault(op, {})[nbytes] = winner
             for algo, dt in sorted(by_algo.items()):
                 measurements.append({
                     "op": op, "bytes": nbytes, "algo": algo,
                     "seconds": round(dt, 9), "ranks": n,
                     "source": "trace",
+                })
+            if promoted_from is not None:
+                measurements.append({
+                    "op": op, "bytes": nbytes, "algo": winner,
+                    "promoted_from": promoted_from,
+                    "wire_frac": round(wire_fracs[op][nbytes]
+                                       [promoted_from], 4),
+                    "ranks": n, "source": "trace:quant-promotion",
                 })
     if not best:
         raise ValueError(

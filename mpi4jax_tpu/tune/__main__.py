@@ -4,8 +4,9 @@
                                [--repeats N] [--ops allreduce,allgather]
                                [--cache PATH] [--port P]
 
-Sweeps every selectable algorithm (ring / recursive doubling / tree) for
-each (op, payload size) on a live job and writes the winners to the
+Sweeps every selectable algorithm (ring / recursive doubling / tree,
+plus the quantized qring/qrd allreduce twins) for each (op, payload
+size) on a live job and writes the winners to the
 persistent cache (``tune.cache_path(world_size)``), which is loaded at
 communicator creation on every subsequent run — see ``tune.install``.
 
@@ -65,7 +66,17 @@ _SUM, _MAX = 0, 2
 
 DEFAULT_SIZES = [1 << 10, 4 << 10, 16 << 10, 64 << 10, 256 << 10,
                  1 << 20, 4 << 20, 16 << 20]
-CANDIDATES = ("ring", "rd", "tree")
+#: algorithms swept per op.  The sweep's payload is f32 SUM, so the
+#: quantized wire formats are eligible and measured HONESTLY for
+#: allreduce (the dominant DP-gradient shape); a cache row naming
+#: qring/qrd silently degrades to the exact twin at dispatch for
+#: ineligible calls (integer dtypes, MAX/MIN), so the sweep's winners
+#: are safe to install table-wide.  Sweeping them can be suppressed
+#: with MPI4JAX_TPU_COLL_QUANT=deny (the rows would degrade anyway).
+CANDIDATES = {
+    "allreduce": ("ring", "rd", "tree", "qring", "qrd"),
+    "allgather": ("ring", "rd", "tree"),
+}
 
 
 def _parse_args(argv=None):
@@ -84,6 +95,10 @@ def _parse_args(argv=None):
                     help="cache file path (default: tune.cache_path(np))")
     ap.add_argument("--port", type=int, default=None,
                     help="launcher base port (driver mode)")
+    ap.add_argument("--no-quantize", action="store_true",
+                    help="with --from-trace: never promote a wire-bound "
+                         "exact allreduce winner to its quantized twin "
+                         "(qring/qrd); the derived table stays exact-only")
     ap.add_argument("--from-trace", default=None, metavar="REC[,REC...]",
                     help="derive the cache from a recorded real run "
                          "instead of a synthetic sweep: comma-separated "
@@ -111,6 +126,7 @@ def _from_trace(args) -> int:
     try:
         cache = tune.cache_from_trace(
             paths, world_size=args.np_, cache_path_override=args.cache,
+            quantize=not args.no_quantize,
         )
     except (ValueError, OSError) as e:
         print(f"tune: --from-trace: {e}", file=sys.stderr, flush=True)
@@ -211,7 +227,13 @@ def _rank(args) -> int:
         for nbytes in sizes:
             repeats = args.repeats or max(3, min(30, int(3e6 / max(nbytes, 1))))
             per_algo = {}
-            for algo in CANDIDATES:
+            cands = CANDIDATES[op]
+            from mpi4jax_tpu.utils.config import quant_mode
+
+            if quant_mode() == "deny":
+                cands = tuple(a for a in cands
+                              if a not in tune.QUANT_ALGOS)
+            for algo in cands:
                 dt = _time_point(comm, bridge, np, op, nbytes, algo, repeats)
                 per_algo[algo] = dt
                 measurements.append({

@@ -99,6 +99,24 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 (``mpi4jax_tpu/tune``); must agree across
                                 ranks.  The same-host shm arena still wins
                                 when active.
+- ``MPI4JAX_TPU_COLL_QUANT``  — gate over the quantized (int8) collective
+                                wire formats (``qring``/``qrd``, read
+                                natively and by the ops layer):
+                                ``allow`` (default) lets the decision
+                                table / env / API select them and the
+                                ``compression="int8"`` allreduce route
+                                natively; ``deny`` degrades every
+                                quantized pick to its exact twin (ring/
+                                rd) and keeps compression on the Python
+                                schedule — a numerics kill-switch that
+                                never changes which frames match, only
+                                their contents; ``force`` upgrades every
+                                eligible (real floating dtype, SUM)
+                                allreduce to the quantized twin of its
+                                selected algorithm.  Must agree across
+                                ranks (frame sizes differ between exact
+                                and quantized schedules; a divergent
+                                gate fails fast on the size check).
 - ``MPI4JAX_TPU_TUNE_CACHE``  — full path of the persistent autotune cache
                                 (default ``~/.cache/mpi4jax_tpu/
                                 tune_<world_size>.json``), written by
@@ -130,7 +148,7 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 arms a collective clock-alignment
                                 handshake at communicator creation.
 - ``MPI4JAX_TPU_TRACE_BUF_KB`` — event-ring size in KB (default 256;
-                                56-byte slots, so ~4600 events), for
+                                64-byte slots, so 4096 events), for
                                 both the native transport ring and the
                                 Python span ring.  Overflow keeps the
                                 newest events and counts exactly how
@@ -241,6 +259,7 @@ KNOBS = {
     "MPI4JAX_TPU_FAULT": "deterministic native fault injection",
     "MPI4JAX_TPU_JOBID": "unique token for /dev/shm segment names",
     "MPI4JAX_TPU_COLL_ALGO": "force world-tier collective algorithms",
+    "MPI4JAX_TPU_COLL_QUANT": "quantized wire formats: allow/deny/force",
     "MPI4JAX_TPU_TUNE_CACHE": "persistent autotune cache path",
     "MPI4JAX_TPU_TRACE": "record per-op events; dump/merge trace here",
     "MPI4JAX_TPU_TRACE_BUF_KB": "observability event-ring size (KB)",
@@ -277,6 +296,26 @@ def flag(name: str, default: bool = False) -> bool:
 
 def setting(name: str, default: str) -> str:
     return os.environ.get(name, default)
+
+
+def quant_mode() -> str:
+    """``MPI4JAX_TPU_COLL_QUANT`` as "allow" | "deny" | "force" — the
+    ONE Python-side reader of the quantized-wire gate, matching the
+    native parser byte-for-byte (whitespace-trimmed, loud on anything
+    else: a typo'd gate must not silently change numerics — the native
+    layer exits on it, so the Python layer must never quietly read the
+    same value as "allow")."""
+    raw = os.environ.get("MPI4JAX_TPU_COLL_QUANT")
+    if raw is None:
+        return "allow"
+    v = raw.strip()
+    if not v:
+        return "allow"
+    if v in ("allow", "deny", "force"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_COLL_QUANT={raw!r} "
+        "(expected allow, deny, or force)")
 
 
 def debug_enabled() -> bool:

@@ -10,7 +10,8 @@
  *   re-ordering layer).
  * - Collectives are deterministic schedules over the point-to-point layer.
  *   allreduce/allgather carry SELECTABLE algorithms (ring / recursive
- *   doubling / binomial tree — the collective algorithm engine, owned by
+ *   doubling / binomial tree, plus the quantized-wire qring/qrd
+ *   allreduce twins — the collective algorithm engine, owned by
  *   mpi4jax_tpu/tune): AUTO consults the decision table installed via
  *   tpucomm_set_coll_table, per-call forcing goes through the *_algo
  *   entry points.
@@ -60,6 +61,7 @@
 #include <random>
 #include <string>
 #include <thread>
+#include <memory>
 #include <vector>
 
 namespace {
@@ -319,12 +321,16 @@ struct ObsScope {
     ev.peer = peer;
     ev.tag = tag;
     ev.nbytes = nbytes;
+    ev.wire_bytes = nbytes;  // exact ops: the wire carries the payload
     ev.algo = algo;
     wait0 = g_obs_wait_acc;
     post = t_post;
     t0 = now_s();
   }
   void set_algo(int algo) { ev.algo = algo; }
+  /* quantized collectives: the payload's on-wire representation is the
+   * packed codec size, not the logical bytes */
+  void set_wire(int64_t wb) { ev.wire_bytes = wb; }
   ~ObsScope() {
     if (!on) return;
     double t1 = now_s();
@@ -2288,17 +2294,58 @@ const char* coll_algo_name(int algo) {
     case TPU_COLL_RD: return "rd";
     case TPU_COLL_TREE: return "tree";
     case TPU_COLL_SHM: return "shm";
+    case TPU_COLL_QRING: return "qring";
+    case TPU_COLL_QRD: return "qrd";
     default: return "auto";
   }
+}
+
+/* quantized wire formats (codec + schedules defined below) */
+bool quant_dtype_ok(int dtype);
+int64_t quant_packed_bytes(int64_t count);
+
+/* MPI4JAX_TPU_COLL_QUANT: process-wide gate over the quantized wire
+ * formats.  allow (default) = table/env/API selection may pick them;
+ * deny = quantized picks degrade to their exact counterparts (a safety
+ * kill-switch that never changes which frames match, only their
+ * contents); force = every quant-eligible allreduce upgrades to the
+ * quantized twin of its selected schedule.  Must agree across ranks
+ * (like COLL_ALGO: a divergent gate fails fast on frame-size checks). */
+enum { QUANT_ALLOW = 0, QUANT_DENY = 1, QUANT_FORCE = 2 };
+
+int quant_mode() {
+  static int v = [] {
+    const char* e = std::getenv("MPI4JAX_TPU_COLL_QUANT");
+    if (!e) return QUANT_ALLOW;
+    /* trim surrounding whitespace (shell exports / YAML trailing
+     * newlines) so this agrees byte-for-byte with the Python layers'
+     * read of the same knob (utils/config.quant_mode) */
+    std::string s(e);
+    const size_t b = s.find_first_not_of(" \t\r\n");
+    if (b == std::string::npos) return QUANT_ALLOW;
+    s = s.substr(b, s.find_last_not_of(" \t\r\n") - b + 1);
+    if (s == "allow") return QUANT_ALLOW;
+    if (s == "deny") return QUANT_DENY;
+    if (s == "force") return QUANT_FORCE;
+    std::fprintf(stderr,
+                 "tpucomm: cannot parse MPI4JAX_TPU_COLL_QUANT=%s "
+                 "(expected allow, deny, or force)\n", e);
+    std::exit(2);  // a typo'd gate must not silently change numerics
+  }();
+  return v;
 }
 
 /* The algorithm that will serve (op_kind, nbytes, count) on comm `c`.
  * `requested` = per-call force (AUTO -> table -> built-in heuristic).
  * Also applies legality fixups (allgather has no recursive-doubling
- * schedule for non-power-of-two sizes: falls back to ring), so callers
- * log the algorithm that actually runs. */
+ * schedule for non-power-of-two sizes: falls back to ring; quantized
+ * codes degrade to their exact counterparts unless the call is a
+ * float SUM allreduce and MPI4JAX_TPU_COLL_QUANT permits), so callers
+ * log the algorithm that actually runs.  `dtype`/`rop` carry the
+ * reduction context for the quantized-eligibility gate; callers
+ * without one (allgather, the byte-only probe) pass the defaults. */
 int resolve_coll_algo(Comm* c, int op_kind, int64_t nbytes, int64_t count,
-                      int requested) {
+                      int requested, int dtype = -1, int rop = -1) {
   if (c->arena && c->size > 1) return TPU_COLL_SHM;
   int algo = requested;
   if (algo == TPU_COLL_AUTO) algo = coll_table_lookup(op_kind, nbytes);
@@ -2309,6 +2356,23 @@ int resolve_coll_algo(Comm* c, int op_kind, int64_t nbytes, int64_t count,
                                                        : TPU_COLL_TREE;
     else
       algo = TPU_COLL_RING;
+  }
+  /* quantized eligibility: allreduce, real floating dtype, SUM.  An
+   * ineligible (dtype, op) or the deny gate degrades the quantized
+   * code to its exact counterpart — dtype agrees across ranks, so the
+   * degradation is consistent and the schedules still match.  BEFORE
+   * the allgather fixups, so a (nonsensical) quantized table row for
+   * allgather degrades and then takes the normal rd/ring legality
+   * path. */
+  {
+    const bool q_ok = op_kind == TPU_OPKIND_ALLREDUCE &&
+                      quant_dtype_ok(dtype) && rop == TPU_SUM;
+    if (algo == TPU_COLL_QRING || algo == TPU_COLL_QRD) {
+      if (!q_ok || quant_mode() == QUANT_DENY)
+        algo = algo == TPU_COLL_QRING ? TPU_COLL_RING : TPU_COLL_RD;
+    } else if (quant_mode() == QUANT_FORCE && q_ok) {
+      algo = algo == TPU_COLL_RING ? TPU_COLL_QRING : TPU_COLL_QRD;
+    }
   }
   if (op_kind == TPU_OPKIND_ALLGATHER && algo == TPU_COLL_RD &&
       (c->size & (c->size - 1)) != 0)
@@ -2556,6 +2620,611 @@ int rd_allgather(Comm* c, const void* sendbuf, int64_t nbytes,
     int rc = recv_msg(c, peer, kCollectiveTag, out + peer_off, len);
     if (wait_send(c, &job) || rc) return 1;
   }
+  return 0;
+}
+
+/* ============ quantized wire formats (qring / qrd) ============
+ *
+ * EQuARX-style in-collective block quantization (arXiv:2506.17615):
+ * every collective frame carries int8 codes plus per-block f32 absmax
+ * scales instead of full-precision elements — ~4x fewer payload bytes
+ * for f32, ~2x for bf16/f16 — and the receive side dequantizes and
+ * reduces streaming in f32.  The codec below IS the wire format; it is
+ * also exported (tpucomm_quant_pack/unpack) so diag and the Python
+ * accuracy harness can round-trip the exact native bits.
+ *
+ * Determinism contract: quantization is pure per-block f32 arithmetic
+ * (absmax, divide, round-to-nearest-even), so identical inputs pack to
+ * identical bytes on every rank, and both algorithms are built so that
+ * every rank reconstructs bit-identical RESULTS (see each schedule's
+ * comment) — a quantized gradient sync cannot make DP replicas drift
+ * apart. */
+
+constexpr int64_t kQuantBlock = 256;  // elements per f32 absmax scale
+
+int64_t quant_blocks(int64_t count) {
+  return count > 0 ? (count + kQuantBlock - 1) / kQuantBlock : 0;
+}
+
+/* packed layout: ceil(count/256) f32 scales, then count int8 codes */
+int64_t quant_packed_bytes(int64_t count) {
+  return count > 0 ? 4 * quant_blocks(count) + count : 0;
+}
+
+bool quant_dtype_ok(int dtype) {
+  return dtype == TPU_F16 || dtype == TPU_BF16 || dtype == TPU_F32 ||
+         dtype == TPU_F64;
+}
+
+/* dtype buffer -> f32 working values (codec and reduction run in f32) */
+void quant_load_f32(const void* src, int dtype, int64_t count, float* dst) {
+  switch (dtype) {
+    case TPU_F32:
+      std::memcpy(dst, src, (size_t)count * 4);
+      break;
+    case TPU_F64: {
+      const double* s = static_cast<const double*>(src);
+      for (int64_t i = 0; i < count; i++) dst[i] = (float)s[i];
+      break;
+    }
+    case TPU_BF16: {
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; i++) dst[i] = bf16_to_f32(s[i]);
+      break;
+    }
+    default: {  // TPU_F16 (quant_dtype_ok gates everything else out)
+      const uint16_t* s = static_cast<const uint16_t*>(src);
+      for (int64_t i = 0; i < count; i++) dst[i] = f16_to_f32(s[i]);
+      break;
+    }
+  }
+}
+
+void quant_store_f32(const float* src, int dtype, int64_t count, void* dst) {
+  switch (dtype) {
+    case TPU_F32:
+      std::memcpy(dst, src, (size_t)count * 4);
+      break;
+    case TPU_F64: {
+      double* d = static_cast<double*>(dst);
+      for (int64_t i = 0; i < count; i++) d[i] = (double)src[i];
+      break;
+    }
+    case TPU_BF16: {
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < count; i++) d[i] = f32_to_bf16(src[i]);
+      break;
+    }
+    default: {  // TPU_F16
+      uint16_t* d = static_cast<uint16_t*>(dst);
+      for (int64_t i = 0; i < count; i++) d[i] = f32_to_f16(src[i]);
+      break;
+    }
+  }
+}
+
+/* Block kernels.  The AVX2 variants follow the vertical_reduce pattern
+ * (target attribute + have_avx2() runtime dispatch) and are BIT-
+ * IDENTICAL to the scalar fallbacks: both compute value*(1/scale) in
+ * f32, clip to ±127, and round to nearest EVEN (cvtps_epi32 under the
+ * default MXCSR mode ≡ the scalar add-2^23-magic-number trick), so a
+ * mixed-CPU job cannot diverge on quantized bits.  The pack loop at
+ * 16 MiB measures ~9 GB/s with AVX2 vs ~1 GB/s scalar on the CI host —
+ * without it the codec, not the wire, would be the bottleneck. */
+
+inline float quant_amax_scalar(const float* x, int64_t n) {
+  float amax = 0.0f;
+  for (int64_t i = 0; i < n; i++) amax = std::max(amax, std::fabs(x[i]));
+  return amax;
+}
+
+inline void quant_codes_scalar(const float* x, int64_t n, float inv,
+                               int8_t* codes) {
+  for (int64_t i = 0; i < n; i++) {
+    float v = x[i] * inv;
+    v = std::min(127.0f, std::max(-127.0f, v));
+    v = (v + 12582912.0f) - 12582912.0f;  // round to nearest even
+    codes[i] = (int8_t)(int32_t)v;
+  }
+}
+
+__attribute__((target("avx2"))) float quant_amax_avx2(const float* x,
+                                                      int64_t n) {
+  const __m256 mask = _mm256_castsi256_ps(_mm256_set1_epi32(0x7fffffff));
+  __m256 am = _mm256_setzero_ps();
+  int64_t i = 0;
+  for (; i + 8 <= n; i += 8)
+    am = _mm256_max_ps(am, _mm256_and_ps(mask, _mm256_loadu_ps(x + i)));
+  float tmp[8];
+  _mm256_storeu_ps(tmp, am);
+  float amax = 0.0f;
+  for (int k = 0; k < 8; k++) amax = std::max(amax, tmp[k]);
+  for (; i < n; i++) amax = std::max(amax, std::fabs(x[i]));
+  return amax;
+}
+
+__attribute__((target("avx2"))) void quant_codes_avx2(const float* x,
+                                                      int64_t n, float inv,
+                                                      int8_t* codes) {
+  const __m256 vinv = _mm256_set1_ps(inv);
+  const __m256 vlo = _mm256_set1_ps(-127.0f);
+  const __m256 vhi = _mm256_set1_ps(127.0f);
+  int64_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 v = _mm256_mul_ps(_mm256_loadu_ps(x + i), vinv);
+    v = _mm256_min_ps(vhi, _mm256_max_ps(vlo, v));
+    __m256i q = _mm256_cvtps_epi32(v);  // rounds to nearest even
+    __m128i p16 = _mm_packs_epi32(_mm256_castsi256_si128(q),
+                                  _mm256_extracti128_si256(q, 1));
+    __m128i p8 = _mm_packs_epi16(p16, p16);
+    _mm_storel_epi64(reinterpret_cast<__m128i*>(codes + i), p8);
+  }
+  if (i < n) quant_codes_scalar(x + i, n - i, inv, codes + i);
+}
+
+__attribute__((target("avx2"))) void quant_dq_avx2(const int8_t* codes,
+                                                   int64_t n, float scale,
+                                                   float* dst, bool add) {
+  const __m256 vs = _mm256_set1_ps(scale);
+  int64_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256i q = _mm256_cvtepi8_epi32(
+        _mm_loadl_epi64(reinterpret_cast<const __m128i*>(codes + i)));
+    __m256 v = _mm256_mul_ps(vs, _mm256_cvtepi32_ps(q));
+    if (add) v = _mm256_add_ps(_mm256_loadu_ps(dst + i), v);
+    _mm256_storeu_ps(dst + i, v);
+  }
+  for (; i < n; i++) {
+    const float v = scale * (float)codes[i];
+    dst[i] = add ? dst[i] + v : v;
+  }
+}
+
+__attribute__((target("avx512f,avx512bw,avx512dq"))) float
+quant_amax_avx512(const float* x, int64_t n) {
+  const __m512 mask = _mm512_castsi512_ps(_mm512_set1_epi32(0x7fffffff));
+  __m512 am = _mm512_setzero_ps();
+  int64_t i = 0;
+  for (; i + 16 <= n; i += 16)
+    am = _mm512_max_ps(am, _mm512_and_ps(mask, _mm512_loadu_ps(x + i)));
+  float amax = _mm512_reduce_max_ps(am);
+  for (; i < n; i++) amax = std::max(amax, std::fabs(x[i]));
+  return amax;
+}
+
+__attribute__((target("avx512f,avx512bw,avx512dq"))) void
+quant_codes_avx512(const float* x, int64_t n, float inv, int8_t* codes) {
+  const __m512 vinv = _mm512_set1_ps(inv);
+  const __m512 vlo = _mm512_set1_ps(-127.0f);
+  const __m512 vhi = _mm512_set1_ps(127.0f);
+  int64_t i = 0;
+  for (; i + 16 <= n; i += 16) {
+    __m512 v = _mm512_mul_ps(_mm512_loadu_ps(x + i), vinv);
+    v = _mm512_min_ps(vhi, _mm512_max_ps(vlo, v));
+    __m512i q = _mm512_cvtps_epi32(v);  // rounds to nearest even
+    /* saturating narrow is exact here: q is pre-clipped to ±127 */
+    _mm_storeu_si128(reinterpret_cast<__m128i*>(codes + i),
+                     _mm512_cvtsepi32_epi8(q));
+  }
+  if (i < n) quant_codes_scalar(x + i, n - i, inv, codes + i);
+}
+
+__attribute__((target("avx512f,avx512bw,avx512dq"))) void
+quant_dq_avx512(const int8_t* codes, int64_t n, float scale, float* dst,
+                bool add) {
+  const __m512 vs = _mm512_set1_ps(scale);
+  int64_t i = 0;
+  /* NB: non-temporal stores were tried for the write-only (!add) path
+   * and measured SLOWER on the virtualized CI hosts (WC flushes under
+   * KVM), besides needing fence discipline around the progress
+   * thread — plain stores keep the kernel simple and bit-obvious. */
+  for (; i + 16 <= n; i += 16) {
+    __m512i q = _mm512_cvtepi8_epi32(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(codes + i)));
+    __m512 v = _mm512_mul_ps(vs, _mm512_cvtepi32_ps(q));
+    if (add) v = _mm512_add_ps(_mm512_loadu_ps(dst + i), v);
+    _mm512_storeu_ps(dst + i, v);
+  }
+  for (; i < n; i++) {
+    const float v = scale * (float)codes[i];
+    dst[i] = add ? dst[i] + v : v;
+  }
+}
+
+/* 0 = scalar, 1 = avx2, 2 = avx512 — one probe, pack/unpack dispatch */
+int quant_isa() {
+  static int v = [] {
+    if (__builtin_cpu_supports("avx512f") &&
+        __builtin_cpu_supports("avx512bw") &&
+        __builtin_cpu_supports("avx512dq"))
+      return 2;
+    return have_avx2() ? 1 : 0;
+  }();
+  return v;
+}
+
+/* pack: per-block absmax scale (absmax/127; 1.0 for an all-zero
+ * block), codes = round-to-nearest-even of value/scale clipped ±127 */
+void quant_pack_f32(const float* x, int64_t count, char* out) {
+  const int64_t nb = quant_blocks(count);
+  char* scales = out;
+  int8_t* codes = reinterpret_cast<int8_t*>(out + 4 * nb);
+  const int isa = quant_isa();
+  for (int64_t b = 0; b < nb; b++) {
+    const int64_t lo = b * kQuantBlock;
+    const int64_t n = std::min(count - lo, kQuantBlock);
+    const float amax = isa == 2   ? quant_amax_avx512(x + lo, n)
+                       : isa == 1 ? quant_amax_avx2(x + lo, n)
+                                  : quant_amax_scalar(x + lo, n);
+    const float scale = amax > 0.0f ? amax / 127.0f : 1.0f;
+    const float inv = 1.0f / scale;
+    std::memcpy(scales + 4 * b, &scale, 4);
+    if (isa == 2)
+      quant_codes_avx512(x + lo, n, inv, codes + lo);
+    else if (isa == 1)
+      quant_codes_avx2(x + lo, n, inv, codes + lo);
+    else
+      quant_codes_scalar(x + lo, n, inv, codes + lo);
+  }
+}
+
+/* dst = scale * code (exact in f32: |code| <= 127 is exact, scale is a
+ * stored f32 — every rank dequantizing the same bytes gets the same
+ * bits).  `scales`/`codes` may point into one packed buffer (the
+ * contiguous wire layout) or at separate staging runs (the streaming
+ * receive path): `count` elements starting at dst, whole leading
+ * blocks. */
+void quant_dq_run(const char* scales, const int8_t* codes, int64_t count,
+                  float* dst, bool add) {
+  const int64_t nb = quant_blocks(count);
+  const int isa = quant_isa();
+  for (int64_t b = 0; b < nb; b++) {
+    const int64_t lo = b * kQuantBlock;
+    const int64_t n = std::min(count - lo, kQuantBlock);
+    float scale;
+    std::memcpy(&scale, scales + 4 * b, 4);
+    if (isa == 2) {
+      quant_dq_avx512(codes + lo, n, scale, dst + lo, add);
+    } else if (isa == 1) {
+      quant_dq_avx2(codes + lo, n, scale, dst + lo, add);
+    } else {
+      for (int64_t i = 0; i < n; i++) {
+        const float v = scale * (float)codes[lo + i];
+        dst[lo + i] = add ? dst[lo + i] + v : v;
+      }
+    }
+  }
+}
+
+void quant_unpack_f32(const char* in, int64_t count, float* dst) {
+  quant_dq_run(in,
+               reinterpret_cast<const int8_t*>(in + 4 * quant_blocks(count)),
+               count, dst, false);
+}
+
+/* Reusable per-thread scratch for the quantized schedules: fresh
+ * multi-MB allocations per call cost first-touch page faults that are
+ * pure CPU on the loopback critical path (the same reasoning as the
+ * bridge's reusable output buffers).  One op executes at a time per
+ * thread, so fixed slots cannot alias; a slot grows to the largest
+ * payload seen and stays.  Slots: 0 = send packs, 1 = own-chunk pack,
+ * 2 = frame scales, 3 = codes run, 4 = received contributions. */
+std::vector<char>& quant_tls_buf(int slot, int64_t n) {
+  static thread_local std::vector<char> bufs[5];
+  auto& b = bufs[slot];
+  if ((int64_t)b.size() < n) b.resize((size_t)std::max<int64_t>(n, 1));
+  return b;
+}
+
+/* Fold the peers' packed contributions into the chunk, quantize the
+ * reduced chunk, and dequantize the packed bytes back into the working
+ * buffer — ONE L1-blocked pass per 256-element block instead of three
+ * whole-chunk passes (fold, pack, unpack).  The per-element arithmetic
+ * sequence is exactly the sequential version's, so the packed bytes
+ * and the final values are bit-identical to quant_dq_multi_add
+ * followed by quant_pack_f32 + quant_unpack_f32. */
+void quant_fold_pack(const char* const* packs, int nsrc, int64_t count,
+                     float* acc, char* out) {
+  const int64_t nb = quant_blocks(count);
+  char* scales_out = out;
+  int8_t* codes_out = reinterpret_cast<int8_t*>(out + 4 * nb);
+  const int isa = quant_isa();
+  for (int64_t b = 0; b < nb; b++) {
+    const int64_t lo = b * kQuantBlock;
+    const int64_t n = std::min(count - lo, kQuantBlock);
+    for (int k = 0; k < nsrc; k++) {
+      const char* in = packs[k];
+      const int8_t* codes =
+          reinterpret_cast<const int8_t*>(in + 4 * nb) + lo;
+      float scale;
+      std::memcpy(&scale, in + 4 * b, 4);
+      if (isa == 2) {
+        quant_dq_avx512(codes, n, scale, acc + lo, true);
+      } else if (isa == 1) {
+        quant_dq_avx2(codes, n, scale, acc + lo, true);
+      } else {
+        for (int64_t i = 0; i < n; i++)
+          acc[lo + i] += scale * (float)codes[i];
+      }
+    }
+    const float amax = isa == 2   ? quant_amax_avx512(acc + lo, n)
+                       : isa == 1 ? quant_amax_avx2(acc + lo, n)
+                                  : quant_amax_scalar(acc + lo, n);
+    const float scale = amax > 0.0f ? amax / 127.0f : 1.0f;
+    const float inv = 1.0f / scale;
+    std::memcpy(scales_out + 4 * b, &scale, 4);
+    if (isa == 2)
+      quant_codes_avx512(acc + lo, n, inv, codes_out + lo);
+    else if (isa == 1)
+      quant_codes_avx2(acc + lo, n, inv, codes_out + lo);
+    else
+      quant_codes_scalar(acc + lo, n, inv, codes_out + lo);
+    if (isa == 2) {
+      quant_dq_avx512(codes_out + lo, n, scale, acc + lo, false);
+    } else if (isa == 1) {
+      quant_dq_avx2(codes_out + lo, n, scale, acc + lo, false);
+    } else {
+      for (int64_t i = 0; i < n; i++)
+        acc[lo + i] = scale * (float)codes_out[lo + i];
+    }
+  }
+}
+
+/* Receive one packed-codec collective frame from `source` and
+ * dequantize it into `dst` (accumulating when `add`) AS THE BYTES
+ * ARRIVE: the scales land in one small read, then the codes stream
+ * through a cache-sized scratch run — the packed payload never
+ * occupies a full-size intermediate buffer (recv_combine_msg's
+ * streaming-fold pattern, specialized to the quantized wire).  Frame
+ * checks are identical to recv_msg (one frame, same header
+ * diagnostics).  TCP path only — arena comms never reach the
+ * quantized schedules. */
+int recv_quant_msg(Comm* c, int source, int64_t count, float* dst,
+                   bool add) {
+  fault_fire(c, g_job_rank, FP_RECV, "recv");
+  if (pending_head(c, source))
+    FAIL(c, "message order violation: collective frame expected from rank "
+         "%d but user message (tag %d) is pending", source,
+         pending_head(c, source)->tag);
+  const int64_t nbytes = quant_packed_bytes(count);
+  MsgHeader h{};
+  int rc;
+  {
+    ObsWaitTimer wt;  // header arrival = wait phase (see recv_msg_status)
+    rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  }
+  if (rc) FAIL_IO(c, rc, "recv header from %d", source);
+  if (h.tag == kPoisonTag) return poison_fail(c, source, h);
+  if (h.comm_id != c->comm_id)
+    FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
+         "is comm %d — ops on sibling communicators must run in a "
+         "consistent order on both endpoints", source, h.comm_id,
+         c->comm_id);
+  if (h.tag != kCollectiveTag)
+    FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
+         kCollectiveTag, source, h.tag);
+  if (h.nbytes != nbytes)
+    FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
+         source, (long long)nbytes, (long long)h.nbytes);
+  if (count <= 0) return 0;
+  const int64_t nb = quant_blocks(count);
+  std::vector<char>& scales = quant_tls_buf(2, 4 * nb);
+  rc = read_all_dl(c->socks[source], scales.data(), 4 * nb);
+  if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
+  /* codes in runs of whole blocks (kCombineBlockBytes is a multiple of
+   * kQuantBlock, so every run starts on a block boundary) */
+  static_assert(kCombineBlockBytes % kQuantBlock == 0,
+                "codes runs must stay block-aligned");
+  std::vector<char>& run =
+      quant_tls_buf(3, std::min<int64_t>(count, kCombineBlockBytes));
+  for (int64_t e0 = 0; e0 < count; e0 += kCombineBlockBytes) {
+    const int64_t e1 = std::min(count, e0 + kCombineBlockBytes);
+    rc = read_all_dl(c->socks[source], run.data(), e1 - e0);
+    if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
+    quant_dq_run(scales.data() + 4 * (e0 / kQuantBlock),
+                 reinterpret_cast<const int8_t*>(run.data()), e1 - e0,
+                 dst + e0, add);
+  }
+  return 0;
+}
+
+/* Quantized ring-family allreduce (the EQuARX decomposition): a DIRECT
+ * pairwise quantized reduce-scatter — round r exchanges packed chunks
+ * with ranks ±r, so each rank's inputs are quantized exactly ONCE —
+ * followed by the ring allgather of the once-quantized reduced chunks.
+ * Same total wire bytes as the exact ring (2*(n-1)/n of the payload,
+ * at ~1/4 the bytes for f32), but only TWO quantization steps touch
+ * any element (input + reduced chunk) instead of one per hop: less
+ * codec CPU on the critical path AND a tighter error bound.  Each
+ * rank's own contribution to its chunk stays full-precision; the
+ * allgather forwards packed bytes verbatim and the owner dequantizes
+ * its own packed chunk too, so every rank reconstructs bit-identical
+ * results.  SUM only (resolve_coll_algo gates). */
+int qring_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype,
+                    int op) {
+  (void)op;  // gated to TPU_SUM before dispatch
+  const int size = c->size, rank = c->rank;
+  /* f32 payloads run IN PLACE on the caller's buffer — a 16 MiB call
+   * must not pay a 16 MiB zero-fill + two 16 MiB copies of staging
+   * (measured: the staging traffic alone cost more than the wire
+   * saving on a loopback host).  Other dtypes stage through an
+   * uninitialized f32 scratch. */
+  float* acc;
+  std::unique_ptr<float[]> staged;
+  if (dtype == TPU_F32) {
+    acc = static_cast<float*>(recvbuf);
+  } else {
+    staged.reset(new float[(size_t)count]);
+    quant_load_f32(recvbuf, dtype, count, staged.get());
+    acc = staged.get();
+  }
+  const int64_t per = chunk_lo(count, size, 1) - chunk_lo(count, size, 0);
+  const int64_t ppc = quant_packed_bytes(per);  // per-chunk pack ceiling
+  const int64_t mlo = chunk_lo(count, size, rank);
+  const int64_t mhi = chunk_lo(count, size, rank + 1);
+  /* phase 1: direct quantized reduce-scatter.  Pack EVERY destination
+   * chunk up front (dest chunks are never accumulated into, so they
+   * still hold the original values — each input element is quantized
+   * exactly once) and post all sends before the first receive: the
+   * writer thread streams them while this thread drains incoming
+   * contributions, instead of a per-round pack -> wire -> fold convoy.
+   * Send k goes to rank+k and is the k-th frame receiver rank+k reads
+   * from this channel, so every posted frame is at most one deep in a
+   * socket buffer — deadlock-free for any buffer size. */
+  std::vector<char>& spacks = quant_tls_buf(0, ppc * size);
+  std::vector<SendJob> jobs((size_t)size);
+  for (int round = 1; round < size; round++) {
+    const int dest = (rank + round) % size;
+    const int64_t dlo = chunk_lo(count, size, dest);
+    const int64_t dhi = chunk_lo(count, size, dest + 1);
+    char* p = spacks.data() + (int64_t)dest * ppc;
+    quant_pack_f32(acc + dlo, dhi - dlo, p);
+    if (async_send(c, &jobs[dest], dest, kCollectiveTag, p,
+                   quant_packed_bytes(dhi - dlo))) {
+      for (int r2 = 1; r2 < round; r2++)
+        wait_send(c, &jobs[(rank + r2) % size]);
+      return 1;
+    }
+  }
+  int rc = 0;
+  /* land every peer's contribution (one frame per channel, reusable
+   * scratch), then fold them in ONE L1-blocked pass over my chunk —
+   * the fixed arrival order rank-1, rank-2, ... is preserved per
+   * element by the fused fold, so the f32 accumulation is
+   * deterministic and bit-identical to sequential folding */
+  const int64_t mpb = quant_packed_bytes(mhi - mlo);
+  std::vector<char>& contrib =
+      quant_tls_buf(4, mpb * std::max(size - 1, 1));
+  std::vector<const char*> cptrs((size_t)std::max(size - 1, 1));
+  for (int round = 1; round < size && !rc; round++) {
+    const int src = (rank - round + size) % size;
+    char* slot = contrib.data() + (int64_t)(round - 1) * mpb;
+    cptrs[(size_t)(round - 1)] = slot;
+    rc = recv_msg(c, src, kCollectiveTag, slot, mpb);
+  }
+  std::vector<char>& own = quant_tls_buf(1, quant_packed_bytes(mhi - mlo));
+  if (!rc && size > 1)
+    /* fold + quantize + owner-requantize in one cache-blocked pass:
+     * `own` then holds the once-quantized reduced chunk phase 2 ships */
+    quant_fold_pack(cptrs.data(), size - 1, mhi - mlo, acc + mlo,
+                    own.data());
+  /* phase-1 sends keep draining on the writer thread while phase 2
+   * packs and posts — both sets are waited together at the end */
+  if (rc) {
+    for (int round = 1; round < size; round++)
+      wait_send(c, &jobs[(rank + round) % size]);
+    return 1;
+  }
+  /* phase 2: direct allgather of the once-quantized reduced chunks —
+   * pack the own chunk ONCE, dequantize the same bytes back (owner and
+   * receivers hold identical bits), stream the identical frame to
+   * every peer off the writer thread, then drain the peers' chunks.
+   * Wire bytes per rank are the same as the ring pipeline
+   * ((n-1)/n of the packed payload each way) without its step-by-step
+   * serialization; per-channel depth stays one frame, so this is
+   * deadlock-free for any socket buffer size. */
+  {
+    std::vector<SendJob> jobs2((size_t)size);
+    bool posted_fail = false;
+    for (int round = 1; round < size && !posted_fail; round++) {
+      const int dest = (rank + round) % size;
+      posted_fail = async_send(c, &jobs2[dest], dest, kCollectiveTag,
+                               own.data(),
+                               quant_packed_bytes(mhi - mlo)) != 0;
+      if (posted_fail) rc = 1;
+    }
+    for (int round = 1; round < size && !rc; round++) {
+      const int src = (rank - round + size) % size;
+      const int64_t slo = chunk_lo(count, size, src);
+      const int64_t shi = chunk_lo(count, size, src + 1);
+      rc = recv_quant_msg(c, src, shi - slo, acc + slo, false);
+    }
+    /* both phases' sends reference spacks/own until here */
+    for (int round = 1; round < size; round++) {
+      rc |= wait_send(c, &jobs[(rank + round) % size]);
+      if (jobs2[(rank + round) % size].fd >= 0 ||
+          jobs2[(rank + round) % size].done)
+        rc |= wait_send(c, &jobs2[(rank + round) % size]);
+    }
+    if (rc) return 1;
+  }
+  if (dtype != TPU_F32) quant_store_f32(acc, dtype, count, recvbuf);
+  return 0;
+}
+
+/* Quantized recursive doubling: log2(n) pairwise exchanges of the
+ * whole packed buffer.  Rank consistency: each side combines
+ * dequant(own packed) + dequant(peer packed) — the pair exchanges the
+ * same two byte strings and f32 addition is commutative, so merged
+ * groups hold identical bits after every round and all ranks finish
+ * identical.  Non-power-of-two fold matches rd_allreduce (the odd
+ * member of each leading pair also requantizes the final result it
+ * returns, keeping the sidelined even member bit-identical). */
+int qrd_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype, int op) {
+  (void)op;  // gated to TPU_SUM before dispatch
+  const int size = c->size, rank = c->rank;
+  /* in-place for f32, staged otherwise — see qring_allreduce */
+  float* acc;
+  std::unique_ptr<float[]> staged;
+  if (dtype == TPU_F32) {
+    acc = static_cast<float*>(recvbuf);
+  } else {
+    staged.reset(new float[(size_t)count]);
+    quant_load_f32(recvbuf, dtype, count, staged.get());
+    acc = staged.get();
+  }
+  const int64_t pb = quant_packed_bytes(count);
+  std::vector<char>& self = quant_tls_buf(0, pb);
+  int pof2 = 1;
+  while (pof2 * 2 <= size) pof2 *= 2;
+  const int rem = size - pof2;
+  int newrank;
+  if (rank < 2 * rem) {
+    quant_pack_f32(acc, count, self.data());
+    if ((rank & 1) == 0) {
+      if (send_msg(c, rank + 1, kCollectiveTag, self.data(), pb)) return 1;
+      newrank = -1;  // sits out the butterfly
+    } else {
+      quant_unpack_f32(self.data(), count, acc);
+      if (recv_quant_msg(c, rank - 1, count, acc, true)) return 1;
+      newrank = rank / 2;
+    }
+  } else {
+    newrank = rank - rem;
+  }
+  if (newrank >= 0) {
+    for (int mask = 1; mask < pof2; mask <<= 1) {
+      int newpeer = newrank ^ mask;
+      int peer = newpeer < rem ? newpeer * 2 + 1 : newpeer + rem;
+      quant_pack_f32(acc, count, self.data());
+      SendJob job;
+      if (async_send(c, &job, peer, kCollectiveTag, self.data(), pb))
+        return 1;
+      /* requantize the local half while the peer's frame is in
+       * flight, then dequantize-and-add the arriving bytes streaming */
+      quant_unpack_f32(self.data(), count, acc);
+      int rc = recv_quant_msg(c, peer, count, acc, true);
+      if (wait_send(c, &job) || rc) return 1;
+    }
+  }
+  if (rem > 0) {
+    /* non-power-of-two return phase: the sidelined evens receive the
+     * result QUANTIZED, so every other rank must hold the same
+     * quantize-dequantize image of it — the odd fold members pack the
+     * bytes they send anyway, and the out-of-fold ranks requantize
+     * locally (the butterfly left all participants bit-identical, so
+     * everyone packs the same bytes and lands on the same result). */
+    if (rank < 2 * rem && (rank & 1) == 0) {
+      if (recv_quant_msg(c, rank + 1, count, acc, false)) return 1;
+    } else {
+      quant_pack_f32(acc, count, self.data());
+      if (rank < 2 * rem &&
+          send_msg(c, rank - 1, kCollectiveTag, self.data(), pb))
+        return 1;
+      quant_unpack_f32(self.data(), count, acc);
+    }
+  }
+  if (dtype != TPU_F32) quant_store_f32(acc, dtype, count, recvbuf);
   return 0;
 }
 
@@ -2902,8 +3571,10 @@ int engine_run_body(EngineOp* o) {
       if (esize == 0) FAIL(c, "bad dtype %d", o->dtype);
       int64_t nbytes = o->count * esize;
       int chosen = resolve_coll_algo(c, TPU_OPKIND_ALLREDUCE, nbytes,
-                                     o->count, o->algo);
+                                     o->count, o->algo, o->dtype, o->rop);
       ObsScope obs(TPU_OBS_ALLREDUCE, -1, 0, nbytes, chosen, tp);
+      if (chosen == TPU_COLL_QRING || chosen == TPU_COLL_QRD)
+        obs.set_wire(quant_packed_bytes(o->count));
       LogScope log(c->rank, "Allreduce", [&] {
         return std::to_string(o->count) + " elems dtype " +
                std::to_string(o->dtype) + " op " + std::to_string(o->rop) +
@@ -2922,6 +3593,10 @@ int engine_run_body(EngineOp* o) {
           return ring_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
         case TPU_COLL_RD:
           return rd_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
+        case TPU_COLL_QRING:
+          return qring_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
+        case TPU_COLL_QRD:
+          return qrd_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
         default:
           return tree_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
       }
@@ -3054,6 +3729,7 @@ int engine_write_coalesced(Engine* e, EngineOp** ops, int n) {
       ev.peer = dest;
       ev.tag = ops[i]->tag;
       ev.nbytes = ops[i]->snb;
+      ev.wire_bytes = ops[i]->snb;
       ev.algo = -1;
       ev.t_start = ops[i]->t_post;
       ev.dur_s = tw1 - ops[i]->t_post;
@@ -3844,7 +4520,8 @@ void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
   std::vector<std::pair<int64_t, int32_t>> entries;
   for (int i = 0; i < n; i++) {
     int32_t a = algos[i];
-    if (a < TPU_COLL_AUTO || a > TPU_COLL_TREE) continue;  // SHM not forcible
+    if (a < TPU_COLL_AUTO || a > TPU_COLL_QRD || a == TPU_COLL_SHM)
+      continue;  // SHM not forcible; unknown codes dropped
     entries.emplace_back(min_bytes[i], a);
   }
   std::sort(entries.begin(), entries.end());
@@ -3856,8 +4533,37 @@ int tpucomm_coll_algo_for(int64_t h, int op_kind, int64_t nbytes) {
   Comm* c = get_comm(h);
   if (!c || op_kind < 0 || op_kind > 1) return -1;
   /* count only gates the built-in allreduce heuristic's ring cutoff;
-   * approximate with 4-byte elements (the table path ignores it) */
-  return resolve_coll_algo(c, op_kind, nbytes, nbytes / 4, TPU_COLL_AUTO);
+   * approximate with 4-byte elements (the table path ignores it).
+   * The probe has no dtype/op context: assume the quant-eligible case
+   * (f32 SUM) so it reports qring/qrd where the table picks them — an
+   * actual ineligible call degrades to the exact twin at dispatch. */
+  return resolve_coll_algo(c, op_kind, nbytes, nbytes / 4, TPU_COLL_AUTO,
+                           TPU_F32, TPU_SUM);
+}
+
+/* ---- quantized wire codec (diag / tests / accuracy-harness probe) ---- */
+
+int64_t tpucomm_quant_packed_bytes(int64_t count) {
+  return quant_packed_bytes(count);
+}
+
+int tpucomm_quant_pack(const void* in, int64_t count, int dtype, void* out) {
+  if (!quant_dtype_ok(dtype)) return 1;
+  if (count <= 0) return 0;
+  std::vector<float> tmp((size_t)count);
+  quant_load_f32(in, dtype, count, tmp.data());
+  quant_pack_f32(tmp.data(), count, static_cast<char*>(out));
+  return 0;
+}
+
+int tpucomm_quant_unpack(const void* in, int64_t count, int dtype,
+                         void* out) {
+  if (!quant_dtype_ok(dtype)) return 1;
+  if (count <= 0) return 0;
+  std::vector<float> tmp((size_t)count);
+  quant_unpack_f32(static_cast<const char*>(in), count, tmp.data());
+  quant_store_f32(tmp.data(), dtype, count, out);
+  return 0;
 }
 
 void tpucomm_obs_enable(int enabled, int64_t capacity) {

@@ -44,6 +44,19 @@ enum TpuCollAlgo {
   TPU_COLL_RD = 2,    /* recursive doubling (latency-optimal, log2 rounds) */
   TPU_COLL_TREE = 3,  /* binomial reduce-to-root + tree bcast */
   TPU_COLL_SHM = 4,   /* report-only: same-host shared-memory arena */
+  /* Quantized wire formats (EQuARX-style in-collective block
+   * quantization): the ring / recursive-doubling allreduce schedules
+   * with every wire frame carrying int8 codes + per-block f32 absmax
+   * scales instead of full-precision elements (~4x fewer payload bytes
+   * for f32, ~2x for bf16/f16).  Results are APPROXIMATE (~1e-2
+   * relative error) and rank-consistent (every rank computes identical
+   * output bits).  Allreduce only; legal for real floating dtypes with
+   * SUM — any other (dtype, op) silently degrades to the exact
+   * counterpart (ring / rd), so a table row or forced code never
+   * corrupts an integer or MAX reduction.  MPI4JAX_TPU_COLL_QUANT
+   * (allow | deny | force) gates them process-wide. */
+  TPU_COLL_QRING = 5, /* quantized chunked ring */
+  TPU_COLL_QRD = 6,   /* quantized recursive doubling */
 };
 
 /* op kinds for the per-op decision tables */
@@ -160,8 +173,28 @@ void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
 
 /* Resolution probe for diag/tracing: the TpuCollAlgo code that WOULD
  * run for (comm, op kind, payload bytes) — including TPU_COLL_SHM when
- * the same-host arena path serves the call.  -1 for a bad handle. */
+ * the same-host arena path serves the call.  -1 for a bad handle.
+ * The probe has no dtype/op context, so it assumes the quant-eligible
+ * case (f32 SUM): it reports TPU_COLL_QRING/QRD where the table picks
+ * them; an actual int or MAX call at that size degrades to the exact
+ * counterpart at dispatch. */
 int tpucomm_coll_algo_for(int64_t h, int op_kind, int64_t nbytes);
+
+/* ---- quantized wire format (qring / qrd payload codec) ----
+ *
+ * The EQuARX-style block codec the quantized algorithms put on the
+ * wire, exported so diag / tests / the Python accuracy harness can
+ * round-trip the EXACT native format: `count` elements quantize to
+ * ceil(count/256) f32 absmax scales followed by `count` int8 codes in
+ * one contiguous buffer of tpucomm_quant_packed_bytes(count) bytes.
+ * Codes are round-to-nearest-even of value/scale, clipped to ±127;
+ * scale = blockwise absmax/127 (1.0 for an all-zero block).  Legal
+ * dtypes: F16 / BF16 / F32 / F64 (the conversion runs through f32).
+ * Both functions return 0 on success, nonzero on an ineligible dtype. */
+int64_t tpucomm_quant_packed_bytes(int64_t count);
+int tpucomm_quant_pack(const void* in, int64_t count, int dtype, void* out);
+int tpucomm_quant_unpack(const void* in, int64_t count, int dtype,
+                         void* out);
 
 /* ---- observability event ring (mpi4jax_tpu/obs is the owner) ----
  *
@@ -192,7 +225,11 @@ struct TpuObsEvent {
   double queue_s;  /* dispatch share: post -> native execution start (the
                     * submission-queue delay; 0 for inline execution).
                     * wire = dur - queue - wait */
-  int64_t nbytes;  /* payload bytes of this call (0 for barrier) */
+  int64_t nbytes;  /* LOGICAL payload bytes of this call (0 for barrier) */
+  int64_t wire_bytes; /* the payload's on-wire representation: equal to
+                    * nbytes for every exact op; the packed (int8 codes
+                    * + f32 scales) size for quantized collectives —
+                    * nbytes / wire_bytes is the compression ratio */
   int32_t op;      /* TpuObsOp */
   int32_t peer;    /* peer/root rank; -1 when not applicable */
   int32_t tag;     /* user tag; 0 when not applicable */

@@ -287,13 +287,25 @@ def test_from_trace_derives_loadable_algorithm_cache(tmp_path):
     assert data["world_size"] == 3
     assert data["transport"] == "tcp:from-trace"
     # the best MEDIAN observed algorithm wins per size bucket: rd at
-    # 1 KB, ring at 1 MB — collapsed into bucket entries
-    assert data["table"]["allreduce"] == [[0, "rd"], [1 << 20, "ring"]]
+    # 1 KB; at 1 MB ring wins AND its recorded wire share dominates
+    # (dur >> wait), so the row is promoted to the quantized twin —
+    # the wire is the bottleneck there, shrinking frames is the lever
+    assert data["table"]["allreduce"] == [[0, "rd"], [1 << 20, "qring"]]
     assert data["table"]["allgather"] == [[0, "ring"]]
     assert any(m["source"] == "trace" for m in data["measurements"])
+    promo = [m for m in data["measurements"]
+             if m.get("source") == "trace:quant-promotion"]
+    assert promo and promo[0]["promoted_from"] == "ring"
+    assert promo[0]["wire_frac"] >= tune.QUANT_PROMOTE_WIRE_FRAC
     # exactly what bridge.comm_init loads at communicator creation
     loaded = tune.load_cache(3, path=cache)
-    assert loaded["allreduce"] == [(0, "rd"), (1 << 20, "ring")]
+    assert loaded["allreduce"] == [(0, "rd"), (1 << 20, "qring")]
+    # the exact-only escape hatch (tune --from-trace --no-quantize)
+    cache2 = str(tmp_path / "tune_cache_exact.json")
+    tune.cache_from_trace(parts, cache_path_override=cache2,
+                          quantize=False)
+    data2 = json.load(open(cache2))
+    assert data2["table"]["allreduce"] == [[0, "rd"], [1 << 20, "ring"]]
 
 
 def test_from_trace_rejects_recordings_without_tcp_signal(tmp_path):
@@ -612,5 +624,5 @@ def test_launch_trace_end_to_end_bridge_level(tmp_path, np_):
     tune.cache_from_trace(parts, cache_path_override=cache)
     data = json.load(open(cache))
     assert data["world_size"] == np_
-    assert all(e[1] in ("ring", "rd", "tree")
+    assert all(e[1] in ("ring", "rd", "tree", "qring", "qrd")
                for op in data["table"] for e in data["table"][op])

@@ -202,6 +202,22 @@ for it in range(12):
         h, p(buf), p(out), n, F32, SUM)
     assert rc == 0, f"allreduce failed at iter {it}"
     assert out[0] == sum(range(size)), out[0]
+    # quantized wire formats under the engine: forced qring (chunked
+    # codec frames + the TLS scratch the progress thread owns) and qrd
+    # (whole-buffer packed exchanges).  On an arena comm (shm on) they
+    # are exact no-ops; on TCP the result is approximate.
+    QRING, QRD = 5, 6
+    nq = 3000  # several codec blocks, uneven chunks at size 3
+    qbuf = (np.arange(nq, dtype=np.float32) % 17 - 8) * (rank + 1)
+    qout = np.zeros_like(qbuf)
+    expect = (np.arange(nq, dtype=np.float64) % 17 - 8) * sum(
+        r + 1 for r in range(size))
+    for algo in (QRING, QRD):
+        rc = lib.tpucomm_allreduce_algo(
+            h, p(qbuf), p(qout), nq, F32, SUM, algo)
+        assert rc == 0, f"quantized allreduce failed at iter {it}"
+        denom = max(abs(expect).max(), 1e-6)
+        assert abs(qout - expect).max() / denom < 3e-2, algo
     assert lib.tpucomm_barrier(h) == 0
 lib.tpucomm_finalize(ctypes.c_int64(h))
 print("san-rank-ok", rank, flush=True)

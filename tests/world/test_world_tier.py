@@ -149,7 +149,8 @@ def test_tune_cli_smoke(tmp_path):
     for op in ("allreduce", "allgather"):
         entries = data["table"][op]
         assert entries and entries[0][0] == 0
-        assert all(e[1] in ("ring", "rd", "tree") for e in entries)
+        assert all(e[1] in ("ring", "rd", "tree", "qring", "qrd")
+                   for e in entries)
     assert data["measurements"], "tuner wrote no measurements"
 
     # round-trip through the loader, then honor-check on a live job

@@ -78,6 +78,73 @@ def main():
                         f"outside fp tolerance ({np.max(np.abs(a - b))})"
                     )
 
+        # quantized wire formats (qring / qrd): APPROXIMATE but
+        # rank-consistent — every rank must reconstruct bit-identical
+        # results, and the native arithmetic must match the documented
+        # numpy simulators exactly (ops/quantized.py).  On an arena
+        # comm the forced quantized algorithms are no-ops (shm wins):
+        # results are bit-identical to the default path.
+        from mpi4jax_tpu.ops import quantized as quant
+
+        for qcount in (2, count):  # 2 < size exercises empty chunks
+            qbase = rng.randn(size, qcount).astype(np.float32) * 3
+            exact64 = np.sum(qbase.astype(np.float64), axis=0)
+            for qname, sim in (("qring", quant.simulate_qring_sum),
+                               ("qrd", quant.simulate_qrd_sum)):
+                xq = qbase[rank].copy()
+                outq = np.empty_like(xq)
+                bridge.allreduce_raw(h, xq, outq, 11, SUM,
+                                     algo=tune.ALGO_CODES[qname])
+                if active:
+                    ref = np.empty_like(xq)
+                    bridge.allreduce_raw(h, qbase[rank].copy(), ref, 11,
+                                         SUM)
+                    assert np.array_equal(outq, ref), (
+                        f"{qname} on an arena comm must be the exact "
+                        f"shm path (count={qcount})")
+                else:
+                    denom = max(np.max(np.abs(exact64)), 1e-6)
+                    err = np.max(np.abs(outq - exact64)) / denom
+                    assert err < 3e-2, (
+                        f"{qname} count={qcount}: rel err {err:.2e} "
+                        "outside the documented bound")
+                    # bit-parity with the documented reference math
+                    simulated = sim([qbase[r] for r in range(size)])
+                    assert np.array_equal(outq, simulated), (
+                        f"{qname} count={qcount}: native result "
+                        "diverges from the numpy simulator")
+                # rank consistency: every rank holds the same bits
+                rows = bridge.allgather(h, outq, size)
+                for r in range(size):
+                    assert np.array_equal(rows[r], outq), (
+                        f"{qname} count={qcount}: rank {r} diverged")
+            # bf16 quantized: error-bound only (store rounding differs
+            # per element; the wire math is covered by the f32 parity)
+            bfq = f32_to_bf16_bits(qbase)
+            outb = np.empty(qcount, np.uint16)
+            bridge.allreduce_raw(h, bfq[rank].copy(), outb, 10, SUM,
+                                 algo=tune.ALGO_CODES["qring"])
+            bf_vals = (outb.astype(np.uint32) << 16).view(np.float32)
+            bf_exact = np.sum(
+                (bfq.astype(np.uint32) << 16).view(np.float32)
+                .astype(np.float64), axis=0)
+            if not active:
+                denom = max(np.max(np.abs(bf_exact)), 1e-6)
+                assert np.max(np.abs(bf_vals - bf_exact)) / denom < 4e-2
+            rows = bridge.allgather(h, outb, size)
+            for r in range(size):
+                assert np.array_equal(rows[r], outb), "bf16 qring diverged"
+            # ineligible dtype: a forced quantized code DEGRADES to the
+            # exact twin — int32 stays bit-exact
+            xi = (qbase[rank] * 100).astype(np.int32)
+            outi = np.empty_like(xi)
+            bridge.allreduce_raw(h, xi, outi, 3, SUM,
+                                 algo=tune.ALGO_CODES["qring"])
+            ref_i = np.empty_like(xi)
+            bridge.allreduce_raw(h, xi.copy(), ref_i, 3, SUM)
+            assert np.array_equal(outi, ref_i), (
+                "int32 under forced qring must run the exact twin")
+
         # allgather: pure data movement — bit-for-bit under every algorithm
         xg = (base_i[rank, :count] + 7 * rank).astype(np.int32)
         ref = bridge.allgather(h, xg, size)
