@@ -17,7 +17,9 @@ verify-corpus:
 update-goldens:
 	$(PY) tools/verify_corpus.py --update-goldens
 
-# sanitizer builds of the native transport (tests/test_sanitizers.py)
+# sanitizer builds of the native transport (tests/test_sanitizers.py:
+# loopback pairs, the progress engine, and the elastic shrink-under-load
+# three-rank scenario all run against these builds — 0 reports required)
 tsan asan:
 	$(MAKE) -C native $@
 

@@ -77,7 +77,9 @@ def gpt2_init(rng, vocab, d_model, n_layer, n_head, seq):
     return params
 
 
-def gpt2_loss(params, tokens, targets, n_layer, n_head):
+def gpt2_logits(params, tokens, n_layer, n_head):
+    """Next-token logits of the tiny LM — the forward pass
+    :func:`gpt2_loss` trains and ``examples/serve_gpt.py`` serves."""
     import jax.numpy as jnp
 
     def ln(x, g):
@@ -108,7 +110,13 @@ def gpt2_loss(params, tokens, targets, n_layer, n_head):
         m = jnp.maximum(m_in @ h["mlp_in"], 0.0)
         x = x + m @ h["mlp_out"]
     x = ln(x, params["ln_f"])
-    logits = x @ params["wte"].T
+    return x @ params["wte"].T
+
+
+def gpt2_loss(params, tokens, targets, n_layer, n_head):
+    import jax.numpy as jnp
+
+    logits = gpt2_logits(params, tokens, n_layer, n_head)
     logits = logits - jnp.max(logits, -1, keepdims=True)
     logp = logits - jnp.log(jnp.sum(jnp.exp(logits), -1, keepdims=True))
     nll = -jnp.take_along_axis(logp, targets[..., None], -1)

@@ -1,0 +1,146 @@
+"""Request-latency percentiles for the elastic serving harness —
+before, during, and after an injected rank failure.
+
+Run as a rank program under the launcher (bridge-level: no jax, works
+in any container), rank 0 prints one ``obs.bench_record`` JSON row per
+phase:
+
+    # steady-state baseline
+    python -m mpi4jax_tpu.runtime.launch -n 3 --elastic \
+        benchmarks/serving_latency.py
+
+    # with a worker death mid-stream
+    MPI4JAX_TPU_FAULT=rank=1,point=recv,after=40,action=exit \
+    MPI4JAX_TPU_TIMEOUT_S=8 MPI4JAX_TPU_DISABLE_SHM=1 \
+    python -m mpi4jax_tpu.runtime.launch -n 3 --elastic \
+        benchmarks/serving_latency.py
+
+Phases: ``before`` — requests that completed before the failure was
+detected; ``during`` — requests that were in flight across the
+recovery (their iterations were retried on the shrunk world; their
+latency carries the detection deadline + the rebuild, which is why
+p99 spikes there); ``after`` — requests submitted after recovery,
+i.e. the shrunk world's steady state.  Without a fault everything
+lands in one ``steady`` row.  The rows share the benchmark field
+names (op/bytes/us/p50_us/p95_us/p99_us), so they join with
+``obs.stats`` tables and the ``profile report`` rendering of any
+``--trace`` recording taken alongside.
+"""
+
+import argparse
+import json
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+if "mpi4jax_tpu" not in sys.modules:
+    # parent-package shim: obs + elastic + the bridge import without
+    # jax, so the benchmark runs wherever the launcher does
+    pkg = types.ModuleType("mpi4jax_tpu")
+    pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+    sys.modules["mpi4jax_tpu"] = pkg
+
+import numpy as np  # noqa: E402
+
+from mpi4jax_tpu import obs  # noqa: E402
+from mpi4jax_tpu.elastic import serving  # noqa: E402
+from mpi4jax_tpu.runtime import transport  # noqa: E402
+
+
+def decode_fn(toks, lengths, start, stop):
+    """Toy next-token function (pure function of the row, so retried
+    iterations and shrunk worlds reproduce identical transcripts)."""
+    out = np.zeros(stop - start, np.int32)
+    for i in range(start, stop):
+        n = int(lengths[i])
+        row = toks[i, :n].astype(np.int64)
+        out[i - start] = int((row.sum() * 31 + n * 7 + int(row[-1])) % 997)
+    return out
+
+
+def _phase_row(phase, reqs, *, ranks, recoveries):
+    lat_us = sorted(r.latency_s * 1e6 for r in reqs)
+    mean_bytes = int(np.mean([4 * len(r.tokens) for r in reqs]))
+    return obs.bench_record(
+        op="serve_request", nbytes=mean_bytes,
+        seconds=obs.percentile(lat_us, 50) / 1e6, ranks=None,
+        tier="serving", reps=len(reqs),
+        phase=phase,
+        p50_us=round(obs.percentile(lat_us, 50), 1),
+        p95_us=round(obs.percentile(lat_us, 95), 1),
+        p99_us=round(obs.percentile(lat_us, 99), 1),
+        completed=len(reqs), recoveries=recoveries,
+        world_size_end=ranks,
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=24,
+                    help="total requests (half submitted up front, "
+                         "half streamed in while serving)")
+    ap.add_argument("--max-new", type=int, default=6)
+    ap.add_argument("--max-batch", type=int, default=4)
+    args = ap.parse_args()
+
+    comm = transport.get_world_comm()
+    _ = comm.handle
+    if comm.rank() != 0:
+        serving.serve_worker(comm, decode_fn)
+        return
+
+    server = serving.Server(comm, decode_fn, max_batch=args.max_batch)
+    rng = np.random.RandomState(11)
+
+    def submit(n):
+        for _ in range(n):
+            server.submit(rng.randint(0, 900, size=rng.randint(2, 5)),
+                          max_new=args.max_new)
+
+    first = args.requests // 2
+    submit(first)
+    import time
+
+    recovery_at = None  # perf_counter of the first completed recovery
+    streamed = False
+    iters = 0
+    while server.active or len(server.completed) < args.requests:
+        iters += 1
+        if iters > 2000:
+            raise RuntimeError("serving did not drain")
+        pre = server.recoveries
+        server.step()
+        if server.recoveries > pre and recovery_at is None:
+            recovery_at = time.perf_counter()
+        # stream the second half in: after recovery when a fault is
+        # armed (the "after" phase), else once serving is warm
+        if not streamed and (recovery_at is not None or iters == 4):
+            submit(args.requests - first)
+            streamed = True
+    server.stop()
+
+    done = server.completed
+    assert len(done) == args.requests, (len(done), args.requests)
+    rows = []
+    if server.recoveries == 0:
+        rows.append(_phase_row("steady", done, ranks=comm.size(),
+                               recoveries=0))
+    else:
+        before = [r for r in done if r.retries == 0
+                  and r.completed_at < recovery_at]
+        during = [r for r in done if r.retries > 0]
+        after = [r for r in done if r.retries == 0
+                 and r.completed_at >= recovery_at]
+        for phase, reqs in (("before", before), ("during", during),
+                            ("after", after)):
+            if reqs:
+                rows.append(_phase_row(phase, reqs, ranks=comm.size(),
+                                       recoveries=server.recoveries))
+    for row in rows:
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

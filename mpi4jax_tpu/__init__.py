@@ -59,6 +59,8 @@ from .parallel import (  # noqa: E402
     make_mesh,
     spmd,
 )
+from . import elastic  # noqa: E402
+from .elastic import RankFailure  # noqa: E402
 from .runtime.transport import WorldComm  # noqa: E402
 from .utils.status import ANY_SOURCE, ANY_TAG, Status  # noqa: E402
 from .utils.tracing import set_logging  # noqa: E402
@@ -128,6 +130,8 @@ __all__ = [
     "current_comm",
     "get_default_comm",
     "WorldComm",
+    "elastic",
+    "RankFailure",
     "make_mesh",
     "spmd",
     "set_logging",

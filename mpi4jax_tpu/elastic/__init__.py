@@ -1,0 +1,50 @@
+"""Elastic worlds: surviving a dead rank instead of dying with it.
+
+PR 2 made failure *detection* bounded (deadlines + poison-frame abort
+propagation tear a wedged group down in one deadline); this package
+converts that machinery into *recovery* (docs/elasticity.md):
+
+- with ``MPI4JAX_TPU_ELASTIC`` set (``launch --elastic`` sets it), a
+  transport failure raises :class:`RankFailure` in Python — after
+  poisoning every peer so the whole group unblocks and reaches its own
+  recovery point — instead of hard-exiting the process;
+- :func:`recover` waits for the elastic launcher's next *generation*
+  announcement (which names the survivors, their dense renumbering,
+  and a re-derived port block) and rebuilds the world communicator over
+  the survivors through the native ``tpucomm_shrink`` bootstrap,
+  rebinding the existing :class:`~mpi4jax_tpu.WorldComm` in place so
+  every reference keeps working;
+- :mod:`~mpi4jax_tpu.elastic.training` runs a checkpoint-resumed
+  training loop across recoveries (sharded atomic checkpoints from
+  ``utils/checkpoint.py``); :mod:`~mpi4jax_tpu.elastic.serving` is a
+  continuous-batching inference harness that keeps answering requests
+  across an injected rank death.
+
+The package is stdlib+numpy importable (no jax) so the recovery path
+works at the raw bridge level too.  Everything is deterministic under
+``MPI4JAX_TPU_FAULT``, which is how the test suite drives it.
+"""
+
+from ._errors import RankFailure, is_rank_failure  # noqa: F401
+from ._world import (  # noqa: F401
+    Recovery,
+    current_generation,
+    my_slot,
+    read_generation,
+    recover,
+    wait_for_generation,
+)
+from . import serving, training  # noqa: F401
+
+__all__ = [
+    "RankFailure",
+    "Recovery",
+    "current_generation",
+    "is_rank_failure",
+    "my_slot",
+    "read_generation",
+    "recover",
+    "serving",
+    "training",
+    "wait_for_generation",
+]

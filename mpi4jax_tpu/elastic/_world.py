@@ -1,0 +1,199 @@
+"""World reconstruction after a rank failure.
+
+Protocol (docs/elasticity.md): the elastic launcher supervises the rank
+group.  When a rank dies it announces a new world *generation* — an
+atomically written ``gen_<n>.json`` in ``MPI4JAX_TPU_ELASTIC_DIR``
+carrying the member map (original launcher *slot* → dense new rank, or
+-1 for lost slots), the new world size, and a re-derived base port.
+Surviving ranks catch :class:`RankFailure`, call :func:`recover`, and:
+
+1. wait (bounded by ``MPI4JAX_TPU_ELASTIC_GRACE_S``) for the next
+   generation announcement;
+2. look up their own new rank by their launcher slot (the
+   ``MPI4JAX_TPU_RANK`` this process was BORN with — slots never
+   renumber, so maps from consecutive generations compose trivially);
+3. rebuild the native communicator over the survivors through
+   ``tpucomm_shrink`` — the same bootstrap dialer as ``tpucomm_init``,
+   bounded by ``MPI4JAX_TPU_CONNECT_TIMEOUT_S`` — and rebind the
+   process's :class:`~mpi4jax_tpu.WorldComm` *in place*, so every held
+   reference (jitted closures, the default-comm stack) keeps working.
+
+Renumbering is dense (0..new_size-1), so every rank/size invariant the
+static verifier proved about a program's schedule shape holds on the
+shrunk world too — a schedule valid for *any* np stays valid; only
+np-specific *plans* are dropped (bridge.rebuild does not reinstall
+them).
+
+Under the ``respawn`` policy the announcement keeps the original size
+and an identity map; the launcher restarts the dead slot's program in a
+fresh process that joins the new bootstrap via plain ``comm_init``
+(its environment carries the new generation and coordinates).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+from ..utils import config
+from ._errors import RankFailure
+
+#: the live world generation of this process: starts at the generation
+#: the process was born into (MPI4JAX_TPU_GENERATION, 0 for the
+#: original world) and advances on every successful recover()
+_generation = None
+
+#: newest generation this process ATTEMPTED to join (a failed bootstrap
+#: must not re-target the same announcement in a tight loop)
+_last_attempted = None
+
+
+def current_generation() -> int:
+    """The world generation this process currently belongs to."""
+    global _generation
+    if _generation is None:
+        _generation = config.generation()
+    return _generation
+
+
+def my_slot() -> int:
+    """This process's original launcher slot.  Slots never renumber
+    across generations; the generation maps key on them.  For
+    generation-0 ranks the slot IS the spawn rank
+    (``MPI4JAX_TPU_RANK``); a respawned child may bootstrap with a
+    different dense rank, so the launcher gives it its slot identity
+    separately (``MPI4JAX_TPU_SLOT``)."""
+    raw = os.environ.get("MPI4JAX_TPU_SLOT",
+                         os.environ.get("MPI4JAX_TPU_RANK"))
+    if raw is None:
+        raise RuntimeError(
+            "not a world-tier rank (MPI4JAX_TPU_RANK unset); elastic "
+            "recovery needs the launcher")
+    return int(raw)
+
+
+def _gen_path(gen_dir: str, n: int) -> str:
+    return os.path.join(gen_dir, f"gen_{int(n)}.json")
+
+
+def read_generation(gen_dir: str, n: int):
+    """The generation-``n`` announcement dict, or None when it has not
+    been (fully) written yet."""
+    try:
+        with open(_gen_path(gen_dir, n)) as f:
+            spec = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if int(spec.get("generation", -1)) != int(n):
+        return None
+    return spec
+
+
+def wait_for_generation(n: int, *, grace_s=None, gen_dir=None):
+    """Poll the coordination directory until generation >= ``n`` is
+    announced; returns the NEWEST announcement (deaths can outpace
+    recoveries — a survivor always joins the latest membership).
+    Raises :class:`RankFailure` when the grace period expires."""
+    gen_dir = gen_dir or config.elastic_dir()
+    if gen_dir is None:
+        raise RuntimeError(
+            "MPI4JAX_TPU_ELASTIC_DIR unset: elastic recovery needs the "
+            "launcher's --elastic mode (or an explicit gen_dir)")
+    grace_s = config.elastic_grace_s() if grace_s is None else grace_s
+    deadline = time.monotonic() + grace_s
+    while True:
+        newest = None
+        k = int(n)
+        while True:
+            spec = read_generation(gen_dir, k)
+            if spec is None:
+                break
+            newest = spec
+            k += 1
+        if newest is not None:
+            return newest
+        if time.monotonic() >= deadline:
+            raise RankFailure(
+                f"no generation >= {n} announced within {grace_s:g} s "
+                f"(MPI4JAX_TPU_ELASTIC_GRACE_S) in {gen_dir}; giving up",
+                op="recover")
+        time.sleep(0.05)
+
+
+class Recovery:
+    """What one successful :func:`recover` produced."""
+
+    def __init__(self, *, generation, world, rank, size, old_to_new,
+                 lost, policy, base_port):
+        self.generation = int(generation)
+        self.world = world            # the rebound WorldComm
+        self.rank = int(rank)         # this process's NEW dense rank
+        self.size = int(size)
+        self.old_to_new = dict(old_to_new)  # slot -> new rank (-1 = lost)
+        self.lost = list(lost)              # slots lost so far (cumulative)
+        self.policy = policy
+        self.base_port = int(base_port)
+
+    def __repr__(self):
+        return (f"Recovery(gen={self.generation}, rank={self.rank}/"
+                f"{self.size}, lost={self.lost}, policy={self.policy})")
+
+
+def recover(world=None, *, grace_s=None):
+    """Rebuild the world communicator over the surviving ranks.
+
+    Call after catching :class:`RankFailure` (or anything
+    :func:`is_rank_failure` recognizes).  Blocks until the launcher
+    announces the next generation, then runs the native shrink
+    bootstrap and rebinds ``world`` (default: the process world comm)
+    in place.  Raises :class:`RankFailure` again when this process was
+    declared lost, the announcement never arrives, or the rebuilt
+    bootstrap itself fails — the caller's recovery loop may retry (a
+    newer generation supersedes a failed one) or let it propagate (the
+    launcher then counts this rank lost and announces yet another
+    generation to the remaining survivors).
+    """
+    global _generation, _last_attempted
+    from ..runtime import bridge, transport
+
+    if world is None:
+        world = transport.get_world_comm()
+    slot = my_slot()
+    cur = current_generation()
+    if _last_attempted is not None:
+        cur = max(cur, _last_attempted)
+    # a missing dial deadline would let a recovery wait on a peer that
+    # is never coming; the knobs below only tighten unset defaults —
+    # explicit operator settings win (os.environ.setdefault)
+    os.environ.setdefault("MPI4JAX_TPU_CONNECT_TIMEOUT_S", "30")
+    spec = wait_for_generation(cur + 1, grace_s=grace_s)
+    gen = int(spec["generation"])
+    _last_attempted = gen
+    mapping = {int(k): int(v) for k, v in spec.get("map", {}).items()}
+    new_rank = mapping.get(slot, -1)
+    if new_rank < 0:
+        raise RankFailure(
+            f"slot {slot} was declared lost in generation {gen} "
+            "(the launcher presumed this rank dead)", op="recover")
+    new_size = int(spec["size"])
+    base_port = int(spec["base_port"])
+    hosts = spec.get("hosts", "") or ""
+    # children forked/spawned after this point (and the obs re-arm
+    # inside the rebuild) must see the new generation
+    os.environ["MPI4JAX_TPU_GENERATION"] = str(gen)
+    handle = bridge.rebuild(world._handle, new_rank, new_size, base_port,
+                            hosts)
+    host = (hosts.split(",")[0] if hosts else "127.0.0.1")
+    world._rebind(new_rank, new_size, f"{host}:{base_port}", handle)
+    _generation = gen
+    # stderr: the launcher pumps rank stderr and greps these for its
+    # recovery post-mortem
+    print(f"[elastic] slot {slot}: recovered into generation {gen} as "
+          f"rank {new_rank}/{new_size} (lost slots: {spec.get('lost')})",
+          file=sys.stderr, flush=True)
+    return Recovery(
+        generation=gen, world=world, rank=new_rank, size=new_size,
+        old_to_new=mapping, lost=spec.get("lost", []),
+        policy=spec.get("policy", "shrink"), base_port=base_port)

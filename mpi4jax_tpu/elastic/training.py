@@ -1,0 +1,120 @@
+"""Checkpoint-resumed training across rank failures.
+
+The loop the acceptance scenario runs (docs/elasticity.md): a DP
+training job whose every step is a pure function of (state, step,
+comm), checkpointed through ``utils/checkpoint.py``'s committed sharded
+writer.  On :class:`RankFailure` the loop recovers the world
+(``elastic.recover``), restores the last COMMITTED checkpoint, and
+replays from there — steps after the last commit are recomputed on the
+new world, so the trajectory continues exactly as if the job had been
+restarted from that checkpoint by hand.
+
+Works at the raw bridge level (numpy state, ``bridge.allreduce``
+gradient sync — no jax) and at the ops level (jax pytrees,
+``parallel.dp.sync_gradients``) alike: the loop never looks inside the
+state.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Callable, Optional
+
+from ..utils import checkpoint
+from ._errors import is_rank_failure
+from ._world import current_generation, my_slot, recover
+
+
+def run(step_fn: Callable[[Any, int, Any], Any], init_state: Any, *,
+        steps: int, directory: Optional[str] = None, save_every: int = 1,
+        comm=None, replicated: bool = True, keep: Optional[int] = 3,
+        max_recoveries: Optional[int] = None):
+    """Run ``steps`` training steps elastically; returns the final
+    state.
+
+    ``step_fn(state, step, comm) -> state`` must be collective over
+    ``comm`` and (for the resumed trajectory to be meaningful)
+    deterministic given ``(state, step, world size)``.  The state is
+    checkpointed every ``save_every`` steps — ``step_<k>`` holds the
+    state AFTER ``k`` steps, and step 0 (the initial state) is
+    committed up front so a failure before the first save still has a
+    restore point.  ``replicated=True`` (the DP pattern: every rank
+    holds identical state) is what allows a restore onto a SHRUNK
+    world; pass False for truly sharded state, which then survives
+    ``respawn`` recoveries only.
+
+    On a failure the loop recovers, restores the newest committed
+    checkpoint, and continues; ``max_recoveries`` bounds how many times
+    (None = unbounded — the launcher's generation cap is the global
+    backstop).
+    """
+    if comm is None:
+        from ..runtime import transport
+
+        comm = transport.get_world_comm()
+    directory = checkpoint._resolve_dir(directory)
+
+    recoveries = 0
+
+    def bootstrap():
+        """Restore the newest committed checkpoint, or commit step 0 so
+        a failure before the first periodic save still has a restore
+        point."""
+        try:
+            state, start, _ = checkpoint.restore_sharded(
+                init_state, directory=directory, comm=comm)
+            _log(f"resuming from step {start} "
+                 f"(generation {current_generation()})")
+            return state, start
+        except FileNotFoundError:
+            checkpoint.save_sharded(init_state, step=0,
+                                    directory=directory, comm=comm,
+                                    replicated=replicated, keep=keep)
+            return init_state, 0
+
+    # the bootstrap is collective (the step-0 commit barriers), so a
+    # rank dying THERE must recover like a mid-step death would
+    while True:
+        try:
+            state, start = bootstrap()
+            break
+        except BaseException as e:
+            if not is_rank_failure(e):
+                raise
+            recoveries += 1
+            if max_recoveries is not None and recoveries > max_recoveries:
+                raise
+            _log(f"bootstrap failed ({type(e).__name__}); recovering")
+            recover(comm)
+
+    step = start
+    while step < steps:
+        try:
+            state = step_fn(state, step, comm)
+            step += 1
+            if step % max(int(save_every), 1) == 0 or step == steps:
+                checkpoint.save_sharded(state, step=step,
+                                        directory=directory, comm=comm,
+                                        replicated=replicated, keep=keep)
+        except BaseException as e:
+            if not is_rank_failure(e):
+                raise
+            recoveries += 1
+            if max_recoveries is not None and recoveries > max_recoveries:
+                raise
+            _log(f"step {step} failed ({type(e).__name__}); recovering")
+            recover(comm)
+            state, step, _ = checkpoint.restore_sharded(
+                init_state, directory=directory, comm=comm)
+            # the launcher's recovery post-mortem greps this line
+            _log(f"resuming from step {step} "
+                 f"(generation {current_generation()})")
+    return state
+
+
+def _log(msg: str) -> None:
+    try:
+        slot = my_slot()
+    except RuntimeError:
+        slot = -1
+    print(f"[elastic] slot {slot}: {msg}", file=sys.stderr, flush=True)

@@ -41,6 +41,7 @@ from ._recorder import (  # noqa: F401
     dropped,
     enabled,
     events,
+    generation,
     record_span,
     reset,
     start,
@@ -83,6 +84,7 @@ def dump(base_path: str) -> str:
         events=events(),
         dropped=dropped(),
         clock_offset_us=clock_offset_us(),
+        generation=_recorder.generation(),
     )
 
 

@@ -35,14 +35,19 @@ def part_paths(base: str):
 
 
 def write_part(base: str, *, rank: int, size: int, events,
-               dropped=None, clock_offset_us=0.0) -> str:
-    """Atomically write one rank's recording; returns the path."""
+               dropped=None, clock_offset_us=0.0, generation=0) -> str:
+    """Atomically write one rank's recording; returns the path.
+
+    ``generation`` is the elastic world generation the recording
+    belongs to (0 = the original world) — an additive field, so
+    pre-elastic readers and parts are unaffected."""
     path = part_path(base, rank)
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     payload = {
         "version": PART_VERSION,
         "rank": int(rank),
         "size": int(size),
+        "generation": int(generation),
         "clock_offset_us": float(clock_offset_us),
         "dropped": dict(dropped or {}),
         "events": list(events),

@@ -83,6 +83,7 @@ class _State:
     lib = None            # native library (None = python spans only)
     rank = 0
     size = 1
+    generation = 0        # elastic world generation (0 = original world)
     clock_offset_us = 0.0  # cross-rank alignment shift for this rank
     steady0 = 0.0          # native clock sample ...
     unix0 = 0.0            # ... taken at this unix time
@@ -114,16 +115,23 @@ def default_capacity_events() -> int:
 
 
 def start(lib=None, capacity_events=None, rank=0, size=1,
-          clock_offset_s=0.0) -> None:
+          clock_offset_s=0.0, generation=None) -> None:
     """Arm recording.  ``lib`` (the loaded transport) is optional — the
     Python span recorder works alone for mesh-tier / single-process use.
     ``clock_offset_s`` shifts this rank's timestamps onto the job-global
-    timeline (see ``runtime/bridge.py``'s alignment handshake)."""
+    timeline (see ``runtime/bridge.py``'s alignment handshake).
+    ``generation`` stamps the recording with the elastic world
+    generation (default: the live generation — elastic recovery mirrors
+    it into MPI4JAX_TPU_GENERATION, and the re-arm after a rebuild runs
+    through here, so post-recovery events carry the new generation)."""
     global _ENABLED
     cap = capacity_events or default_capacity_events()
     _state.lib = lib if _native.available(lib) else None
     _state.rank = int(rank)
     _state.size = int(size)
+    if generation is None:
+        generation = config.generation()
+    _state.generation = int(generation)
     _state.clock_offset_us = float(clock_offset_s) * 1e6
     _state.spans = Recorder(cap)
     _state.native_acc = Recorder(cap)
@@ -249,3 +257,8 @@ def size() -> int:
 
 def clock_offset_us() -> float:
     return _state.clock_offset_us
+
+
+def generation() -> int:
+    """The elastic world generation this recording belongs to."""
+    return _state.generation

@@ -92,24 +92,31 @@ def merge_parts(parts) -> dict:
     trace_events = []
     world_size = 0
     dropped = {}
+    generations = {}
     for part in parts:
         rank = int(part.get("rank", 0))
         world_size = max(world_size, int(part.get("size", rank + 1)))
+        # elastic worlds: each part says which generation its rank
+        # ended in; a merged timeline spanning a recovery shows it here
+        generations[f"rank{rank}"] = int(part.get("generation", 0))
         for src, n in (part.get("dropped") or {}).items():
             dropped[f"rank{rank}.{src}"] = int(n)
         trace_events.extend(rank_trace_events(part.get("events", ()), rank))
     meta = [e for e in trace_events if e.get("ph") == "M"]
     spans = sorted((e for e in trace_events if e.get("ph") != "M"),
                    key=lambda e: (e.get("ts", 0.0), e.get("pid", 0)))
+    other = {
+        "schema": TRACE_SCHEMA,
+        "tool": "mpi4jax_tpu.obs",
+        "world_size": world_size,
+        "dropped": dropped,
+    }
+    if any(generations.values()):
+        other["generations"] = generations
     return {
         "traceEvents": meta + spans,
         "displayTimeUnit": "ms",
-        "otherData": {
-            "schema": TRACE_SCHEMA,
-            "tool": "mpi4jax_tpu.obs",
-            "world_size": world_size,
-            "dropped": dropped,
-        },
+        "otherData": other,
     }
 
 

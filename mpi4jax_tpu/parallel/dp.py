@@ -117,6 +117,39 @@ def sync_gradients(grads, *, comm=None, bucket_bytes=None):
     return jax.tree.unflatten(treedef, synced)
 
 
+def elastic_step_fn(loss_fn, *, lr, batch_fn, optimizer=None):
+    """Build a ``step_fn(params, step, comm)`` for
+    :func:`mpi4jax_tpu.elastic.training.run` out of a per-shard loss:
+    SGD (or ``optimizer(params, grads, lr) -> params``) over
+    DP-synchronized gradients, with the local batch re-derived every
+    step from ``batch_fn(step, rank, size)``.
+
+    The rank/size indirection is the elastic wiring: after a recovery
+    shrinks the world, the SAME function reshards the global batch over
+    the new ranks — keep the global batch size divisible by every world
+    size you intend to survive and the synced gradient stays the global
+    mean, so the resumed loss trajectory matches an uninterrupted run
+    up to float reassociation (docs/elasticity.md documents the bound).
+    """
+    import jax
+
+    def sgd(params, grads, lr_):
+        return jax.tree.map(lambda p, g: p - lr_ * g, params, grads)
+
+    opt = optimizer or sgd
+
+    def step_fn(params, step, comm):
+        comm_ = _resolve(comm)
+        batch = batch_fn(step, int(comm_.rank()), int(comm_.size()))
+        if not isinstance(batch, tuple):
+            batch = (batch,)
+        _, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        grads = sync_gradients(grads, comm=comm_)
+        return opt(params, grads, lr)
+
+    return step_fn
+
+
 def value_and_synced_grad(loss_fn, *, comm=None):
     """``value_and_grad`` of a per-shard loss with DP synchronization.
 

@@ -155,6 +155,14 @@ def _finish_lib_setup(lib: ctypes.CDLL) -> ctypes.CDLL:
         lib.tpucomm_post.argtypes = [ctypes.c_int64, ctypes.c_void_p]
         lib.tpucomm_wait_ticket.restype = ctypes.c_int
         lib.tpucomm_wait_ticket.argtypes = [ctypes.c_int64, ctypes.c_int64]
+    # elastic recovery (guarded like split/dup: a stale prebuilt .so
+    # reports recovery unavailable instead of failing at load)
+    if hasattr(lib, "tpucomm_shrink"):
+        lib.tpucomm_shrink.restype = ctypes.c_int64
+        lib.tpucomm_shrink.argtypes = [
+            ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p,
+        ]
     # guarded: a stale prebuilt .so without split/dup must still serve
     # the other ops (split then fails at call time, not load time)
     if hasattr(lib, "tpucomm_split"):
@@ -244,6 +252,14 @@ def ffi_available() -> bool:
         # schedule-plan execution lives in the host-executor layer; the
         # native FFI custom calls would bypass the plan runner entirely,
         # so a plan-enabled process keeps the callback dispatch route
+        _ffi_status = False
+        return False
+    if config.elastic_enabled():
+        # FFI lowering bakes the comm HANDLE into the compiled program
+        # (an i64 attr); after a recovery rebinds the world to a fresh
+        # native comm, such a baked handle would address the dead one.
+        # The callback route resolves comm.handle per call, so rebound
+        # comms keep working — elastic processes stay on it.
         _ffi_status = False
         return False
     try:
@@ -379,6 +395,17 @@ def _abort(opname: str, rc: int):
             lib.tpucomm_abort_all()
     except Exception:
         pass
+    # elastic worlds (docs/elasticity.md): surface the failure as an
+    # exception the recovery layer can catch instead of killing the
+    # process.  The poison/shutdown above already ran, so every peer
+    # unblocks within one deadline and reaches ITS recovery point too —
+    # the same propagation that used to cascade the teardown now
+    # cascades the recovery.  The old world is unusable either way
+    # (sockets are shut down); elastic.recover() rebuilds it.
+    if config.elastic_enabled():
+        from ..elastic import RankFailure
+
+        raise RankFailure(f"tpucomm_{opname} failed{detail}", op=opname)
     os._exit(1)
 
 
@@ -397,6 +424,14 @@ def comm_init(rank: int, size: int, coord: str, hosts=None) -> int:
     )
     if handle == 0:
         _abort("init", 1)
+    _post_init_setup(lib, handle, rank, size, install_plan=True)
+    return handle
+
+
+def _post_init_setup(lib, handle, rank: int, size: int, *,
+                     install_plan: bool) -> None:
+    """The selection/telemetry layers every fresh world needs, shared by
+    :func:`comm_init` and elastic recovery's :func:`rebuild`."""
     # collective algorithm engine: load the persistent autotune cache and
     # push the merged decision table natively — every dispatch path
     # (eager / callback / FFI) then resolves the algorithm per call.
@@ -432,7 +467,7 @@ def comm_init(rank: int, size: int, coord: str, hosts=None) -> int:
     # plan file (launch --plan), attach this rank's schedule to the
     # world comm.  Soft like the tune install above: a bad plan file
     # warns and the job runs the historic path.
-    if config.plan_spec() is not None:
+    if install_plan and config.plan_spec() is not None:
         try:
             from . import planrt
 
@@ -441,7 +476,41 @@ def comm_init(rank: int, size: int, coord: str, hosts=None) -> int:
             import warnings
 
             warnings.warn(f"schedule-plan install failed: {e}")
+
+
+def shrink_available() -> bool:
+    """True when the loaded .so carries the elastic recovery bootstrap."""
+    return hasattr(get_lib(), "tpucomm_shrink")
+
+
+def rebuild(old_handle, new_rank: int, new_size: int, base_port: int,
+            hosts: str = "") -> int:
+    """Elastic recovery's native step (``mpi4jax_tpu.elastic`` is the
+    caller): finalize the dead world (``old_handle``; 0/None when none
+    was ever created) and bootstrap a fresh one over the survivors at
+    the re-derived ``base_port``, then rerun the per-world setup
+    (decision table for the new size, obs re-arm with a new clock
+    handshake).  Schedule plans are NOT reinstalled: a plan is proved
+    for one (program, np) shape and a shrunk world invalidates it —
+    the historic token-order path serves post-recovery (docs/
+    elasticity.md)."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_shrink"):
+        raise RuntimeError(
+            "elastic recovery needs a native library with the "
+            "tpucomm_shrink bootstrap; rebuild native/")
+    handle = lib.tpucomm_shrink(
+        _i64(old_handle or 0), int(new_rank), int(new_size),
+        int(base_port), (hosts or "").encode())
+    if handle == 0:
+        _abort("shrink", 1)
+    _post_init_setup(lib, handle, new_rank, new_size, install_plan=False)
     return handle
+
+
+def comm_finalize(handle) -> None:
+    """Close one native communicator (drains its engine first)."""
+    get_lib().tpucomm_finalize(_i64(handle))
 
 
 _obs_dump_registered = False

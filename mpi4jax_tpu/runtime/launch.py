@@ -25,6 +25,18 @@ supervisor play in the reference):
 
 The grace period between escalation steps is ``MPI4JAX_TPU_LAUNCH_GRACE_S``
 (default 5 seconds).
+
+**Elastic mode** (``--elastic [--elastic-policy shrink|respawn]``,
+docs/elasticity.md)
+replaces fail-fast with recovery supervision: a dead rank advances the
+world *generation* — the launcher announces the survivor map and a
+re-derived port block as ``gen_<n>.json`` in a coordination directory
+(``MPI4JAX_TPU_ELASTIC_DIR``), survivors rebuild through
+``mpi4jax_tpu.elastic.recover()``, and under the ``respawn`` policy the
+dead slot's program is restarted in a fresh process that joins the new
+bootstrap.  A job that finishes after recoveries exits 0; the
+post-mortem then names the recovery outcome (generation reached, slots
+lost, resume step) instead of a first-failing rank.
 """
 
 from __future__ import annotations
@@ -321,6 +333,24 @@ def main(argv=None):
                              "unprovable plan falls back to the "
                              "historic path with a notice "
                              "(docs/analysis.md)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="supervise for RECOVERY instead of "
+                             "fail-fast teardown: a dead rank advances "
+                             "the world generation — survivors rebuild "
+                             "over a re-derived port block via "
+                             "mpi4jax_tpu.elastic.recover(), and under "
+                             "the respawn policy the dead slot's "
+                             "program restarts in a fresh process.  A "
+                             "job that completes after recoveries "
+                             "exits 0 (docs/elasticity.md)")
+    parser.add_argument("--elastic-policy", default=None,
+                        choices=("shrink", "respawn"),
+                        help="what --elastic does about a dead rank: "
+                             "shrink (default; survivors renumber "
+                             "densely into a smaller world) or respawn "
+                             "(restart the dead slot at full size).  "
+                             "Default: MPI4JAX_TPU_ELASTIC_POLICY, "
+                             "else shrink")
     parser.add_argument("--trace", default=None, metavar="OUT.json",
                         help="record every rank's per-op events "
                              "(MPI4JAX_TPU_TRACE) and merge them into one "
@@ -372,6 +402,21 @@ def main(argv=None):
     import uuid
 
     jobid = uuid.uuid4().hex[:16]
+
+    elastic_policy = None
+    elastic_dir = None
+    if args.elastic:
+        elastic_policy = (args.elastic_policy
+                          or os.environ.get("MPI4JAX_TPU_ELASTIC_POLICY")
+                          or "shrink").strip()
+        if elastic_policy not in ("shrink", "respawn"):
+            parser.error(
+                f"--elastic policy must be shrink or respawn, "
+                f"got {elastic_policy!r}")
+        import tempfile
+
+        elastic_dir = tempfile.mkdtemp(prefix="m4j_elastic_")
+
     procs = []
     tails = []
     pumps = []
@@ -404,66 +449,202 @@ def main(argv=None):
     first_fail = None  # (rank, exit code)
     watchdog_fired = False
     t_start = time.time()
-    pending = []
+    pending = {}       # slot -> live process
+    slot_tails = {}    # slot -> the slot's LATEST process's stderr tail
+    generation = 0
+    deaths = []        # every rank death observed, in order
+    lost_slots = []    # slots PERMANENTLY lost (shrink; respawned
+                       # slots died but are back, so they are not lost)
+    # a deterministically-crashing program under respawn would otherwise
+    # loop forever; past this many generations the launcher gives up
+    max_generations = 2 * args.np + 2
+
+    def _spawn(slot, *, rank, size, coord, gen):
+        """One rank process; returns its Popen.  ``slot`` is the
+        launcher-slot identity (stable across generations), ``rank``
+        the world rank this process bootstraps with."""
+        env = dict(os.environ)
+        env["MPI4JAX_TPU_RANK"] = str(rank)
+        env["MPI4JAX_TPU_SIZE"] = str(size)
+        env["MPI4JAX_TPU_COORD"] = coord
+        env["MPI4JAX_TPU_JOBID"] = jobid
+        if elastic_policy is not None:
+            env["MPI4JAX_TPU_ELASTIC"] = "1"
+            env["MPI4JAX_TPU_ELASTIC_DIR"] = elastic_dir
+            env["MPI4JAX_TPU_ELASTIC_POLICY"] = elastic_policy
+            env["MPI4JAX_TPU_GENERATION"] = str(gen)
+            env["MPI4JAX_TPU_SLOT"] = str(slot)
+            # recovery depends on every blocking wait being bounded:
+            # poison frames unblock most peers instantly, but a peer
+            # parked on the DEAD rank's socket needs the deadline.
+            # setdefault — explicit operator settings win.
+            env.setdefault("MPI4JAX_TPU_TIMEOUT_S", "60")
+            env.setdefault("MPI4JAX_TPU_CONNECT_TIMEOUT_S", "60")
+        if args.trace:
+            env["MPI4JAX_TPU_TRACE"] = os.path.abspath(args.trace)
+        if plan_path:
+            env["MPI4JAX_TPU_PLAN"] = plan_path
+        if args.hosts:
+            env["MPI4JAX_TPU_HOSTS"] = args.hosts
+        if args.platform:
+            env["JAX_PLATFORMS"] = args.platform
+        else:
+            env.setdefault("JAX_PLATFORMS", "cpu")
+        p = subprocess.Popen(
+            [sys.executable, args.prog, *args.args], env=env,
+            stderr=subprocess.PIPE,
+        )
+        tail = collections.deque(maxlen=80)
+        pump = threading.Thread(
+            target=_pump_stderr, args=(p.stderr, tail), daemon=True
+        )
+        pump.start()
+        procs.append(p)
+        tails.append(tail)
+        pumps.append(pump)
+        slot_tails[slot] = tail
+        return p
+
+    def _announce(gen, members, port, policy):
+        """Atomically write the generation file survivors poll for:
+        member map (slot -> dense new rank; lost slots -> -1), world
+        size, and the re-derived base port."""
+        mapping = {str(s): i for i, s in enumerate(members)}
+        for s in lost_slots:
+            mapping.setdefault(str(s), -1)
+        hosts = ""
+        if args.hosts:
+            hl = args.hosts.split(",")
+            hosts = ",".join(hl[s] for s in members)
+        spec = {
+            "generation": gen,
+            "size": len(members),
+            "base_port": port,
+            "map": mapping,
+            "lost": list(lost_slots),
+            "policy": policy,
+            "hosts": hosts,
+            "np0": args.np,
+        }
+        path = os.path.join(elastic_dir, f"gen_{gen}.json")
+        import json as _json
+
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            _json.dump(spec, f)
+        os.replace(tmp, path)
+        return spec
+
+    def _failover(slot, rc):
+        """One dead rank under --elastic: record it, advance the
+        generation, announce the survivor map, and (respawn policy)
+        restart the dead slot's program.  Returns False when recovery
+        is impossible (no survivors / generation cap) — the caller
+        then falls back to fail-fast semantics."""
+        nonlocal generation
+        deaths.append(slot)
+        if elastic_policy != "respawn":
+            lost_slots.append(slot)
+        generation += 1
+        live = sorted(pending)
+        err = _last_native_error(slot_tails.get(slot, ()))
+        print(
+            f"launch: elastic: rank slot {slot} died (exit code {rc})"
+            + (f"; last error: {err}" if err else "")
+            + f"; advancing to generation {generation} "
+            f"({elastic_policy})", file=sys.stderr, flush=True,
+        )
+        if generation > max_generations:
+            print(
+                f"launch: elastic: giving up after {generation - 1} "
+                "recoveries (generation cap); tearing the job down",
+                file=sys.stderr, flush=True,
+            )
+            return False
+        if not live and elastic_policy == "shrink":
+            print(
+                "launch: elastic: no surviving rank to shrink onto",
+                file=sys.stderr, flush=True,
+            )
+            return False
+        new_port = base_port + generation * (args.np + 1)
+        if elastic_policy == "respawn":
+            members = sorted(set(live) | {slot})
+            spec = _announce(generation, members, new_port, "respawn")
+            new_rank = spec["map"][str(slot)]
+            in_spawn[0] = True
+            try:
+                p = _spawn(slot, rank=new_rank, size=len(members),
+                           coord=f"127.0.0.1:{new_port}",
+                           gen=generation)
+            finally:
+                in_spawn[0] = False
+            pending[slot] = p
+            if deferred:
+                raise deferred[0]
+        else:
+            _announce(generation, live, new_port, "shrink")
+        return True
+
     try:
         signal.signal(signal.SIGINT, _on_sigint_spawn)
         for rank in range(args.np):
-            env = dict(os.environ)
-            env["MPI4JAX_TPU_RANK"] = str(rank)
-            env["MPI4JAX_TPU_SIZE"] = str(args.np)
-            env["MPI4JAX_TPU_COORD"] = f"127.0.0.1:{base_port}"
-            env["MPI4JAX_TPU_JOBID"] = jobid
-            if args.trace:
-                env["MPI4JAX_TPU_TRACE"] = os.path.abspath(args.trace)
-            if plan_path:
-                env["MPI4JAX_TPU_PLAN"] = plan_path
-            if args.hosts:
-                env["MPI4JAX_TPU_HOSTS"] = args.hosts
-            if args.platform:
-                env["JAX_PLATFORMS"] = args.platform
-            else:
-                env.setdefault("JAX_PLATFORMS", "cpu")
-            p = subprocess.Popen(
-                [sys.executable, args.prog, *args.args], env=env,
-                stderr=subprocess.PIPE,
-            )
-            tail = collections.deque(maxlen=80)
-            pump = threading.Thread(
-                target=_pump_stderr, args=(p.stderr, tail), daemon=True
-            )
-            pump.start()
-            procs.append(p)
-            tails.append(tail)
-            pumps.append(pump)
+            pending[rank] = _spawn(
+                rank, rank=rank, size=args.np,
+                coord=f"127.0.0.1:{base_port}", gen=0)
         in_spawn[0] = False
         signal.signal(signal.SIGINT, old_int)
         if deferred:
             raise deferred[0]  # a signal arrived mid-spawn: reap now
-        pending = list(enumerate(procs))
         while pending:
-            for rank, p in list(pending):
+            dead = []
+            for slot, p in list(pending.items()):
                 rc = p.poll()
-                if rc is None:
+                if rc is not None:
+                    dead.append((slot, rc))
+            if any(rc != 0 for _, rc in dead):
+                # cascade failures land milliseconds after their root
+                # cause: a victim polled late in the sweep could be
+                # seen dead while the root cause (already exited, but
+                # polled earlier, while still alive) waits for the
+                # next sweep — misattributing "failed first".  One
+                # short beat + re-poll collects the whole failure
+                # wave before attribution.
+                time.sleep(0.08)
+                for slot, p in list(pending.items()):
+                    rc = p.poll()
+                    if rc is not None and (slot, rc) not in dead:
+                        dead.append((slot, rc))
+            aborted = False
+            for slot, rc in sorted(dead):
+                if slot not in pending:
                     continue
-                pending.remove((rank, p))
-                if rc != 0:
-                    exit_code = rc
-                    if first_fail is None:
-                        first_fail = (rank, rc)
-                    # fail-fast: take the rest of the job down
-                    _terminate_group([q for _, q in pending])
-                    pending.clear()
-                    break
+                del pending[slot]
+                if rc == 0:
+                    continue
+                if first_fail is None:
+                    first_fail = (slot, rc)
+                if elastic_policy is not None and _failover(slot, rc):
+                    continue
+                exit_code = rc
+                # fail-fast: take the rest of the job down
+                _terminate_group(list(pending.values()))
+                pending.clear()
+                aborted = True
+                break
+            if aborted:
+                break
             if pending and args.timeout is not None \
                     and time.time() - t_start > args.timeout:
                 watchdog_fired = True
-                stuck = sorted(r for r, p in pending if p.poll() is None)
+                stuck = sorted(s for s, p in pending.items()
+                               if p.poll() is None)
                 print(
                     f"launch: watchdog: wall-clock timeout after "
                     f"{args.timeout:g} s; terminating rank(s) {stuck}",
                     file=sys.stderr, flush=True,
                 )
-                _terminate_group([q for _, q in pending])
+                _terminate_group(list(pending.values()))
                 pending.clear()
                 exit_code = 124
             time.sleep(0.02)
@@ -516,12 +697,41 @@ def main(argv=None):
     if args.trace:
         _merge_trace(os.path.abspath(args.trace), args.np)
 
-    if first_fail is not None:
+    if elastic_policy is not None and generation > 0 and exit_code == 0:
+        # the recovery outcome, not the first failure: the job SURVIVED
+        # — say what it cost and where it resumed (exit code stays 0)
+        import re as _re
+
+        steps = []
+        for tail in slot_tails.values():
+            for line in tail:
+                m = _re.search(rb"resum\w+ from step (\d+)",
+                               bytes(line))
+                if m:
+                    steps.append(int(m.group(1)))
+        resume = f", resumed from step {max(steps)}" if steps else \
+            ", no checkpoint resume reported"
+        # shrink loses slots permanently; a respawned slot died but
+        # finished — saying "lost" for it would misread the outcome
+        outcome = (f"lost rank slot(s) {lost_slots}"
+                   if elastic_policy != "respawn" else
+                   f"rank death(s) at slot(s) {deaths} (respawned)")
+        print(
+            f"launch: post-mortem: elastic job completed after recovery "
+            f"(policy {elastic_policy}): reached generation "
+            f"{generation}, {outcome}{resume}",
+            file=sys.stderr, flush=True,
+        )
+    elif first_fail is not None:
         rank, rc = first_fail
-        err = _last_native_error(tails[rank])
+        err = _last_native_error(slot_tails.get(rank, ()))
+        gen_note = (
+            f" after reaching generation {generation} "
+            f"(death(s) at slot(s) {deaths})"
+            if elastic_policy is not None and generation > 0 else "")
         print(
             f"launch: post-mortem: rank {rank} failed first (exit code "
-            f"{rc})" + (f"; last error: {err}" if err else ""),
+            f"{rc}){gen_note}" + (f"; last error: {err}" if err else ""),
             file=sys.stderr, flush=True,
         )
     elif watchdog_fired:
@@ -532,6 +742,10 @@ def main(argv=None):
             "this (docs/sharp-bits.md)",
             file=sys.stderr, flush=True,
         )
+    if elastic_dir is not None:
+        import shutil
+
+        shutil.rmtree(elastic_dir, ignore_errors=True)
     return exit_code
 
 

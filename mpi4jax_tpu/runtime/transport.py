@@ -172,6 +172,29 @@ class WorldComm:
     def size(self) -> int:
         return self._size
 
+    def _rebind(self, rank: int, size: int, coord: str, handle) -> None:
+        """Elastic recovery (``mpi4jax_tpu.elastic``) rebinds THIS
+        object onto the rebuilt native communicator, so every held
+        reference — jitted closures, the default-comm stack, the
+        process world — keeps working across the shrink.  Only the
+        world comm is rebindable: sub-communicators borrow the dead
+        world's sockets and must be re-derived on the new world.
+
+        Note the hash contract: a shrink changes ``size()``, so cached
+        jaxprs keyed on the old shape retrace naturally; a respawn
+        keeps rank/size and reuses them (``handle`` resolves per call
+        on the callback dispatch route — the FFI fast path is off in
+        elastic mode for exactly this reason)."""
+        if self._parent is not None:
+            raise RuntimeError("only the world communicator is "
+                               "rebindable; re-split sub-comms on the "
+                               "recovered world")
+        self._rank = int(rank)
+        self._size = int(size)
+        self._coord = coord
+        self._handle = handle
+        self._split_seq = 0
+
     def split(self, color: int, key=None):
         """Collective: ranks sharing ``color`` form a new communicator,
         ordered by ``(key, parent rank)`` (``key`` defaults to the parent

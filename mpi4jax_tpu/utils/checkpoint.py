@@ -1,25 +1,57 @@
 """Checkpoint / resume for model state pytrees.
 
-The reference has no checkpointing at all (SURVEY.md §5.4); training
-frameworks need it, so this framework ships a minimal, dependency-light
-implementation: orbax when available, otherwise a flattened ``.npz`` with a
-structure descriptor.  Works for any pytree of arrays (params, optimizer
-state, solver state).
+The reference has no checkpointing at all (SURVEY.md §5.4); this
+framework ships a dependency-light implementation that the elastic
+recovery subsystem (``mpi4jax_tpu.elastic``, docs/elasticity.md) builds
+on:
 
-Single-controller semantics: arrays are fetched to host (global views of
-sharded arrays) and restored with whatever sharding the consumer applies;
-for multi-process (world-tier) jobs, call on rank 0 after a ``gather`` or
-give each rank its own path.
+- :func:`save` / :func:`restore` — one pytree, one ``.npz`` file
+  (orbax when installed and the path is not ``.npz``-shaped).  Writes
+  are ATOMIC: the payload lands in ``<path>.tmp.<pid>`` and is
+  ``os.replace``d into place, so a crash mid-save can never corrupt the
+  previous checkpoint.
+- :func:`save_sharded` / :func:`restore_sharded` — one directory per
+  step holding one shard file per rank plus a ``manifest.json`` that is
+  written LAST, after a cross-rank barrier confirmed every shard is
+  durable.  A checkpoint *exists* iff its manifest does; a kill at ANY
+  point of the save leaves either the previous committed step intact or
+  a manifest-less directory that :func:`latest_step` ignores — never a
+  torn checkpoint.  Manifests are generation-stamped (elastic worlds).
+
+Leaves are serialized as raw bytes with the dtype NAME recorded in a
+JSON descriptor inside the archive — numpy's ``.npz`` round-trips
+builtin dtypes only (an ``ml_dtypes.bfloat16`` array comes back as
+opaque ``V2`` records), and training state is full of bf16.
+
+jax is optional to this MODULE: tree flattening uses ``jax.tree`` when
+importable and falls back to a pure-Python walk over dict/list/tuple
+(sorted dict keys and None-as-empty-subtree, matching jax's semantics),
+so any jax version works — there is no >= 0.6 gate here — and the
+module even loads standalone where jax cannot import (the packaged
+``mpi4jax_tpu.utils`` import path does pull in jax via its
+``__init__``; load ``checkpoint.py`` with a synthetic parent package to
+avoid that, as ``tests/test_checkpoint_commit.py`` demonstrates).
+
+Single-controller semantics: arrays are fetched to host and restored
+with whatever sharding the consumer applies.  For world-tier jobs use
+the sharded API; a DP-replicated tree (every rank holds the same
+params — the ``parallel.dp`` pattern) restores onto ANY world size,
+which is what lets a job resume after the world shrank.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 
-import jax
+from . import config
+
+MANIFEST = "manifest.json"
+_META_KEY = "__m4j_meta__"
+_FORMAT = 2
 
 
 def _try_orbax():
@@ -31,32 +63,350 @@ def _try_orbax():
         return None
 
 
+# ---------------- pytree handling (jax optional) ----------------
+
+
+def _flatten(tree: Any):
+    """(leaves, rebuild) — ``jax.tree`` when available, else a pure-
+    Python walk over dict/list/tuple (dict keys sorted, jax's order)."""
+    try:
+        import jax
+
+        leaves, treedef = jax.tree.flatten(tree)
+        return list(leaves), ("jax", treedef)
+    except ImportError:
+        leaves = []
+
+        def walk(t):
+            if t is None:
+                return  # jax semantics: None is an empty subtree
+            if isinstance(t, dict):
+                for k in sorted(t):
+                    walk(t[k])
+            elif isinstance(t, (list, tuple)):
+                for x in t:
+                    walk(x)
+            else:
+                leaves.append(t)
+
+        walk(tree)
+        return leaves, ("py", tree)
+
+
+def _unflatten(treedef, leaves):
+    kind, td = treedef
+    if kind == "jax":
+        import jax
+
+        return jax.tree.unflatten(td, list(leaves))
+    it = iter(leaves)
+
+    def build(t):
+        if t is None:
+            return None  # empty subtree, consumes no leaf (jax semantics)
+        if isinstance(t, dict):
+            return {k: build(t[k]) for k in sorted(t)}
+        if isinstance(t, tuple):
+            vals = [build(x) for x in t]
+            return type(t)(*vals) if hasattr(t, "_fields") else tuple(vals)
+        if isinstance(t, list):
+            return [build(x) for x in t]
+        return next(it)
+
+    return build(td)
+
+
+# ---------------- leaf codec + atomic npz ----------------
+
+
+def _write_npz(path: str, tree: Any, extra_meta: Optional[dict] = None
+               ) -> None:
+    """Atomically write one pytree as an npz archive: every leaf as raw
+    bytes (``leaf_<i>`` uint8) plus a JSON descriptor naming dtype and
+    shape — the only encoding that round-trips bf16 and friends."""
+    leaves, _ = _flatten(tree)
+    arrays = {}
+    metas = []
+    for i, leaf in enumerate(leaves):
+        arr = np.asarray(leaf)
+        arr = arr if arr.flags.c_contiguous else np.ascontiguousarray(arr)
+        arrays[f"leaf_{i}"] = arr.reshape(-1).view(np.uint8)
+        metas.append({"dtype": arr.dtype.name, "shape": list(arr.shape)})
+    meta = {"format": _FORMAT, "nleaves": len(leaves), "leaves": metas}
+    meta.update(extra_meta or {})
+    arrays[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode(), np.uint8).copy()
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def _read_npz(path: str):
+    """(leaves, meta) back from :func:`_write_npz`; also reads the
+    legacy format-1 files (plain ``leaf_<i>`` arrays, no descriptor)."""
+    data = np.load(path)
+    if _META_KEY not in data.files:
+        # legacy format 1: dtypes were native, arrays stored direct
+        n = len([k for k in data.files if k.startswith("leaf_")])
+        return [data[f"leaf_{i}"] for i in range(n)], {"format": 1,
+                                                       "nleaves": n}
+    meta = json.loads(bytes(data[_META_KEY].tobytes()).decode())
+    leaves = []
+    for i, desc in enumerate(meta["leaves"]):
+        raw = data[f"leaf_{i}"]
+        arr = raw.view(_resolve_dtype(desc["dtype"])).reshape(desc["shape"])
+        leaves.append(arr)
+    return leaves, meta
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    """Dtype from its recorded name.  bf16 (and friends) only exist in
+    numpy's registry after ml_dtypes is imported — a jax process has it
+    implicitly, the jax-free recovery path must pull it in itself."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes  # noqa: F401  (registers the dtypes)
+
+            return np.dtype(name)
+        except (ImportError, TypeError):
+            raise TypeError(
+                f"checkpoint leaf dtype {name!r} is not resolvable in "
+                "this process (for bfloat16 and friends, install "
+                "ml_dtypes)")
+
+
+def _check_match(path: str, like_leaves, loaded_leaves) -> None:
+    """Loud, specific mismatch errors: a silent zip would truncate."""
+    if len(like_leaves) != len(loaded_leaves):
+        raise ValueError(
+            f"checkpoint {path} holds {len(loaded_leaves)} leaves but "
+            f"the provided tree has {len(like_leaves)} — the model "
+            "architecture (or optimizer state shape) changed since the "
+            "checkpoint was written")
+    for i, (want, got) in enumerate(zip(like_leaves, loaded_leaves)):
+        w = np.asarray(want)
+        if tuple(w.shape) != tuple(got.shape):
+            raise ValueError(
+                f"checkpoint {path} leaf {i} has shape "
+                f"{tuple(got.shape)} but the provided tree expects "
+                f"{tuple(w.shape)}")
+
+
+# ---------------- single-file API ----------------
+
+
 def save(path: str, tree: Any) -> None:
-    """Save a pytree of arrays to ``path`` (directory for orbax, file for
-    npz fallback)."""
+    """Save a pytree of arrays to ``path`` (directory for orbax, file
+    for the npz fallback).  Atomic either way: the npz path writes
+    tmp + ``os.replace`` — a crash mid-save leaves any previous file at
+    ``path`` untouched."""
     ocp = _try_orbax()
     if ocp is not None and not path.endswith(".npz"):
         ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(os.path.abspath(path), jax.tree.map(np.asarray, tree))
+        leaves, treedef = _flatten(tree)
+        ckptr.save(os.path.abspath(path),
+                   _unflatten(treedef, [np.asarray(x) for x in leaves]))
         return
-    leaves, _ = jax.tree.flatten(tree)
-    arrays = {f"leaf_{i}": np.asarray(x) for i, x in enumerate(leaves)}
-    np.savez(path if path.endswith(".npz") else path + ".npz", **arrays)
+    _write_npz(path if path.endswith(".npz") else path + ".npz", tree)
 
 
 def restore(path: str, like: Any) -> Any:
     """Restore a pytree saved by :func:`save`; ``like`` supplies the
-    structure (and is required for the npz fallback)."""
+    structure (and is required for the npz fallback).  Raises
+    ``ValueError`` with the exact mismatch when ``like`` does not match
+    what the checkpoint holds."""
     ocp = _try_orbax()
     if ocp is not None and os.path.isdir(path):
         ckptr = ocp.PyTreeCheckpointer()
         restored = ckptr.restore(os.path.abspath(path))
-        # reattach the caller's pytree structure (orbax returns nested dicts)
+        import jax
+
         leaves = jax.tree.leaves(restored)
-        return jax.tree.unflatten(jax.tree.structure(like), leaves)
+        like_leaves, treedef = _flatten(like)
+        _check_match(path, like_leaves, [np.asarray(x) for x in leaves])
+        return _unflatten(treedef, leaves)
     if not path.endswith(".npz"):
         path = path + ".npz"
-    data = np.load(path)
-    n = len([k for k in data.files if k.startswith("leaf_")])
-    leaves = [data[f"leaf_{i}"] for i in range(n)]
-    return jax.tree.unflatten(jax.tree.structure(like), leaves)
+    leaves, _ = _read_npz(path)
+    like_leaves, treedef = _flatten(like)
+    _check_match(path, like_leaves, leaves)
+    return _unflatten(treedef, leaves)
+
+
+# ---------------- sharded, committed, generation-stamped ----------------
+
+
+def step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{int(step):08d}")
+
+
+def _shard_path(d: str, rank: int, nshards: int) -> str:
+    return os.path.join(d, f"shard{int(rank)}of{int(nshards)}.npz")
+
+
+def committed_steps(directory: str):
+    """Steps with a committed manifest, ascending.  Manifest-less step
+    directories (a save interrupted mid-flight) are invisible here by
+    design — that is the torn-checkpoint guarantee."""
+    steps = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return steps
+    for name in names:
+        if not name.startswith("step_"):
+            continue
+        if not os.path.exists(os.path.join(directory, name, MANIFEST)):
+            continue
+        try:
+            steps.append(int(name[len("step_"):]))
+        except ValueError:
+            continue
+    return sorted(steps)
+
+
+def latest_step(directory: str):
+    """Newest committed step in ``directory``, or None."""
+    steps = committed_steps(directory)
+    return steps[-1] if steps else None
+
+
+def _resolve_dir(directory):
+    directory = directory or config.ckpt_dir()
+    if not directory:
+        raise ValueError(
+            "no checkpoint directory: pass directory= or set "
+            "MPI4JAX_TPU_CKPT_DIR")
+    return directory
+
+
+def _comm_coords(comm):
+    if comm is None:
+        return 0, 1
+    return int(comm.rank()), int(comm.size())
+
+
+def _barrier(comm) -> None:
+    if comm is None or comm.size() <= 1:
+        return
+    from ..runtime import bridge
+
+    bridge.barrier(comm.handle)
+
+
+def save_sharded(tree: Any, *, step: int, directory: Optional[str] = None,
+                 comm=None, generation: Optional[int] = None,
+                 replicated: bool = True, keep: Optional[int] = None,
+                 _crash_point: Optional[str] = None) -> str:
+    """Write one committed checkpoint for ``step``; returns its
+    directory.  Collective over ``comm`` (None = single process).
+
+    Commit protocol (the torn-checkpoint guarantee): every rank writes
+    its shard atomically (tmp + rename) into the step directory, a
+    barrier confirms all shards are durable, THEN rank 0 atomically
+    writes ``manifest.json`` — the commit point — and a second barrier
+    releases the others.  A kill anywhere in between leaves a
+    manifest-less directory that readers ignore; re-saving the same
+    step later simply overwrites it.
+
+    ``replicated`` records that every rank's tree is identical (the DP
+    pattern); only such checkpoints can restore onto a DIFFERENT world
+    size after elastic recovery.  ``generation`` stamps the world
+    generation (default: the live elastic generation).  ``keep`` prunes
+    all but the newest ``keep`` committed steps after the commit.
+
+    ``_crash_point`` is a test seam for the kill-during-save suite:
+    ``"after_shard"`` dies before the manifest exists, ``"mid_commit"``
+    dies after the manifest tmp file is written but before the rename.
+    """
+    directory = _resolve_dir(directory)
+    rank, nshards = _comm_coords(comm)
+    if generation is None:
+        # the live generation: recover() mirrors every successful
+        # recovery into MPI4JAX_TPU_GENERATION, so the env read needs
+        # no import of the elastic package (which imports this module)
+        generation = config.generation()
+    d = step_dir(directory, step)
+    os.makedirs(d, exist_ok=True)
+    _write_npz(_shard_path(d, rank, nshards), tree,
+               {"step": int(step), "rank": rank, "nshards": nshards,
+                "generation": int(generation)})
+    if _crash_point == "after_shard":
+        os._exit(137)
+    _barrier(comm)
+    if rank == 0:
+        manifest = {
+            "version": 1,
+            "step": int(step),
+            "generation": int(generation),
+            "nshards": nshards,
+            "replicated": bool(replicated),
+            "shards": [os.path.basename(_shard_path(d, r, nshards))
+                       for r in range(nshards)],
+        }
+        tmp = os.path.join(d, f"{MANIFEST}.tmp.{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        if _crash_point == "mid_commit":
+            os._exit(137)
+        os.replace(tmp, os.path.join(d, MANIFEST))
+    _barrier(comm)
+    if keep is not None and rank == 0:
+        import shutil
+
+        for old in committed_steps(directory)[:-max(int(keep), 1)]:
+            shutil.rmtree(step_dir(directory, old), ignore_errors=True)
+    return d
+
+
+def restore_sharded(like: Any, *, directory: Optional[str] = None,
+                    step: Optional[int] = None, comm=None):
+    """Restore the newest committed checkpoint (or ``step``); returns
+    ``(tree, step, manifest)``.  Raises ``FileNotFoundError`` when no
+    committed checkpoint exists.
+
+    A rank reads its own shard when the world size matches the
+    checkpoint; after a shrink (or any size change) only
+    ``replicated`` checkpoints are accepted — every shard holds the
+    same tree, so rank r reads shard ``min(r, nshards-1)``.  A
+    non-replicated (truly sharded) state cannot be resharded here and
+    raises with that explanation.
+    """
+    directory = _resolve_dir(directory)
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {directory} (a directory "
+                "without manifest.json is an interrupted save)")
+    d = step_dir(directory, step)
+    with open(os.path.join(d, MANIFEST)) as f:
+        manifest = json.load(f)
+    nshards = int(manifest["nshards"])
+    rank, size = _comm_coords(comm)
+    if size == nshards:
+        shard = rank
+    elif manifest.get("replicated", False):
+        shard = min(rank, nshards - 1)
+    else:
+        raise ValueError(
+            f"checkpoint {d} holds {nshards} non-replicated shards but "
+            f"the world now has {size} ranks — resharding is not "
+            "supported; save replicated=True state (the DP pattern) to "
+            "survive elastic world-size changes")
+    path = _shard_path(d, shard, nshards)
+    leaves, _ = _read_npz(path)
+    like_leaves, treedef = _flatten(like)
+    _check_match(path, like_leaves, leaves)
+    return _unflatten(treedef, leaves), int(step), manifest

@@ -211,6 +211,57 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 changes the collective schedule; the
                                 launcher exports the same environment
                                 to both, so they agree by default).
+- ``MPI4JAX_TPU_ELASTIC``      — elastic worlds (docs/elasticity.md): a
+                                transport failure raises
+                                :class:`mpi4jax_tpu.elastic.RankFailure`
+                                in Python (after poisoning peers so the
+                                group unblocks) instead of hard-exiting
+                                the process, and ``elastic.recover()``
+                                rebuilds the world over the survivors.
+                                Set by ``launch --elastic``; implies the
+                                host-callback dispatch route (the FFI
+                                fast path bakes comm handles into
+                                compiled programs, which cannot survive
+                                a rebind).
+- ``MPI4JAX_TPU_ELASTIC_DIR``  — coordination directory between the
+                                elastic launcher and the ranks: the
+                                launcher announces each new world
+                                generation as ``gen_<n>.json`` (member
+                                map, re-derived base port) and
+                                survivors poll it from
+                                ``elastic.recover()``.  Set by
+                                ``launch --elastic``.
+- ``MPI4JAX_TPU_ELASTIC_POLICY`` — what the elastic launcher does about
+                                a dead rank: ``shrink`` (default)
+                                renumbers the survivors densely into a
+                                smaller world; ``respawn`` restarts the
+                                dead rank's program in a fresh process
+                                and rebuilds at full size.
+- ``MPI4JAX_TPU_ELASTIC_GRACE_S`` — how long (seconds, default 60) a
+                                surviving rank waits inside
+                                ``elastic.recover()`` for the
+                                launcher's next generation announcement
+                                before giving up (the failure then
+                                propagates and the rank exits — the
+                                launcher counts it lost).
+- ``MPI4JAX_TPU_GENERATION``  — the world generation this process was
+                                born into (0 = the original world; the
+                                elastic launcher exports it to
+                                respawned children).  ``elastic``
+                                tracks the live generation from there;
+                                obs recordings and traces carry it.
+- ``MPI4JAX_TPU_SLOT``        — a rank's original *launcher slot*
+                                identity, when it differs from its
+                                bootstrap rank: the elastic launcher
+                                exports it to respawned children (the
+                                generation maps key on slots, which
+                                never renumber; ``MPI4JAX_TPU_RANK``
+                                carries the dense bootstrap rank).
+- ``MPI4JAX_TPU_CKPT_DIR``    — default checkpoint directory for
+                                ``utils/checkpoint.py``'s sharded
+                                save/restore helpers and the elastic
+                                training loop (unset = the caller must
+                                pass a directory explicitly).
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels
@@ -269,6 +320,13 @@ KNOBS = {
     "MPI4JAX_TPU_PLAN_BUCKET_KB": "gradient allreduce bucket ceiling (KB)",
     "MPI4JAX_TPU_QUEUE_DEPTH": "progress-engine submission-queue depth",
     "MPI4JAX_TPU_PALLAS_COLLECTIVES": "route mesh collectives via Pallas",
+    "MPI4JAX_TPU_ELASTIC": "elastic worlds: RankFailure + recovery",
+    "MPI4JAX_TPU_ELASTIC_DIR": "launcher<->rank generation announcements",
+    "MPI4JAX_TPU_ELASTIC_POLICY": "dead-rank policy: shrink / respawn",
+    "MPI4JAX_TPU_ELASTIC_GRACE_S": "recover() wait for the next generation",
+    "MPI4JAX_TPU_GENERATION": "world generation this process was born into",
+    "MPI4JAX_TPU_SLOT": "launcher-slot identity of a respawned rank",
+    "MPI4JAX_TPU_CKPT_DIR": "default sharded-checkpoint directory",
     "MPI4JAX_TPU_ANALYZE_TIMEOUT_S": "static verifier wall deadline",
     "MPI4JAX_TPU_NATIVE_LIB": "override path of the native transport .so",
 }
@@ -416,6 +474,66 @@ def plan_spec():
     if not raw or raw.lower() in ("0", "false", "off", "no"):
         return None
     return raw
+
+
+def elastic_enabled() -> bool:
+    """Resolved MPI4JAX_TPU_ELASTIC (default False): transport failures
+    raise :class:`mpi4jax_tpu.elastic.RankFailure` instead of
+    hard-exiting the process (``runtime/bridge.py`` reads this on its
+    abort path; ``launch --elastic`` sets it)."""
+    return flag("MPI4JAX_TPU_ELASTIC")
+
+
+def elastic_dir():
+    """MPI4JAX_TPU_ELASTIC_DIR: the launcher<->rank coordination
+    directory for generation announcements, or None."""
+    raw = os.environ.get("MPI4JAX_TPU_ELASTIC_DIR")
+    return raw if raw else None
+
+
+def elastic_policy() -> str:
+    """MPI4JAX_TPU_ELASTIC_POLICY as "shrink" | "respawn" (strict like
+    quant_mode: a typo'd policy must not silently shrink a job whose
+    operator asked for respawn)."""
+    raw = os.environ.get("MPI4JAX_TPU_ELASTIC_POLICY")
+    if raw is None or not raw.strip():
+        return "shrink"
+    v = raw.strip()
+    if v in ("shrink", "respawn"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_ELASTIC_POLICY={raw!r} "
+        "(expected shrink or respawn)")
+
+
+def elastic_grace_s() -> float:
+    """Resolved MPI4JAX_TPU_ELASTIC_GRACE_S (seconds, default 60):
+    how long ``elastic.recover()`` waits for the launcher's next
+    generation announcement."""
+    v = _float_knob("MPI4JAX_TPU_ELASTIC_GRACE_S", 60.0)
+    return v if v > 0 else 60.0
+
+
+def generation() -> int:
+    """The world generation this process was BORN into (default 0; the
+    elastic launcher exports it to respawned children).  The live
+    generation after in-process recoveries is tracked by
+    ``mpi4jax_tpu.elastic`` on top of this."""
+    raw = os.environ.get("MPI4JAX_TPU_GENERATION")
+    if raw is None or not raw.strip():
+        return 0
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse MPI4JAX_TPU_GENERATION={raw!r} as an integer")
+
+
+def ckpt_dir():
+    """MPI4JAX_TPU_CKPT_DIR: the default sharded-checkpoint directory,
+    or None (callers must pass one explicitly)."""
+    raw = os.environ.get("MPI4JAX_TPU_CKPT_DIR")
+    return raw if raw else None
 
 
 def plan_bucket_bytes() -> int:

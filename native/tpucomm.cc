@@ -3911,10 +3911,13 @@ extern "C" {
 
 void tpucomm_set_logging(int enabled) { g_logging = enabled; }
 
-int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
-  fault_init();
-  g_job_rank = rank;
-  fault_fire(nullptr, rank, FP_CONNECT, "connect");
+/* The TCP-mesh bootstrap shared by tpucomm_init and tpucomm_shrink:
+ * listen for higher ranks, dial lower ranks (deadline-bounded with
+ * exponential backoff), exchange rank handshakes, arm non-blocking
+ * mode when a transport deadline is set, and attach the same-host shm
+ * arena.  Returns a registered handle, 0 on failure. */
+static int64_t comm_bootstrap(int rank, int size, int base_port,
+                              const char* hosts) {
   auto* c = new Comm;
   c->rank = rank;
   c->size = size;
@@ -4114,8 +4117,13 @@ int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
   /* same-host groups get the shared-memory collective arena */
   const char* jobid = std::getenv("MPI4JAX_TPU_JOBID");
   char prefix[96];
+  /* the base port is part of the prefix even with a job id: elastic
+   * recovery re-bootstraps a new world GENERATION at a re-derived port
+   * under the same job id, and its arena segments must never collide
+   * with (or attach to) the previous generation's */
   if (jobid && jobid[0])
-    std::snprintf(prefix, sizeof(prefix), "m4jshm_%.64s", jobid);
+    std::snprintf(prefix, sizeof(prefix), "m4jshm_%.48s_p%d", jobid,
+                  base_port);
   else
     std::snprintf(prefix, sizeof(prefix), "m4jshm_p%d", base_port);
   c->shm_prefix = prefix;
@@ -4128,6 +4136,31 @@ int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
   int64_t h = g_next_handle++;
   g_comms[h] = c;
   return h;
+}
+
+int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts) {
+  fault_init();
+  g_job_rank = rank;
+  fault_fire(nullptr, rank, FP_CONNECT, "connect");
+  return comm_bootstrap(rank, size, base_port, hosts);
+}
+
+int64_t tpucomm_shrink(int64_t old_h, int new_rank, int new_size,
+                       int base_port, const char* hosts) {
+  fault_init();
+  /* tear the dead world down first: drain/stop its progress engine and
+   * close its sockets so the rebuilt mesh starts from a clean fd table.
+   * The caller already abandoned the old comm (elastic recovery runs
+   * after abort_all poisoned and shut every socket down, so the drain
+   * fails fast instead of blocking on dead peers).  Sub-communicators
+   * of the old world must be gone before this call — they borrow its
+   * sockets. */
+  if (old_h != 0) tpucomm_finalize(old_h);
+  /* connect-point fault injection keys on the rank this process was
+   * BORN with (g_job_rank), exactly like the send/recv points: a fault
+   * spec must address the same process before and after renumbering */
+  fault_fire(nullptr, g_job_rank, FP_CONNECT, "connect");
+  return comm_bootstrap(new_rank, new_size, base_port, hosts);
 }
 
 void tpucomm_finalize(int64_t h) {

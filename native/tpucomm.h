@@ -70,6 +70,22 @@ enum TpuCollOpKind {
 int64_t tpucomm_init(int rank, int size, int base_port, const char* hosts);
 void tpucomm_finalize(int64_t h);
 
+/* Elastic recovery (mpi4jax_tpu/elastic is the owner): rebuild a
+ * world-tier communicator over the surviving ranks after a rank
+ * failure.  Finalizes `old_h` (drains its engine, closes its sockets;
+ * pass 0 when no old comm exists), then runs the SAME bootstrap as
+ * tpucomm_init — listen/dial with the MPI4JAX_TPU_CONNECT_TIMEOUT_S
+ * deadline, per-rank handshake, shm arena — with the caller-supplied
+ * dense renumbering: `new_rank`/`new_size` describe the shrunk (or
+ * respawned) world and `base_port` is the new generation's re-derived
+ * port block (the launcher's generation announcement carries it).
+ * Every surviving rank must call this at the same recovery point with
+ * agreeing arguments.  Fault injection keyed on rank R keeps firing on
+ * the process BORN as rank R regardless of renumbering.  Returns a new
+ * handle > 0, or 0 on failure (bootstrap timeout, port in use). */
+int64_t tpucomm_shrink(int64_t old_h, int new_rank, int new_size,
+                       int base_port, const char* hosts);
+
 int tpucomm_rank(int64_t h);
 int tpucomm_size(int64_t h);
 int tpucomm_shm_info(int64_t h, int64_t* slot_bytes, int64_t* ring_bytes);

@@ -285,6 +285,116 @@ def test_tsan_progress_engine_three_ranks(shm):
     )
 
 
+# ---- elastic shrink under load (TSan) ------------------------------
+#
+# The recovery bootstrap is the second lifecycle the transport's
+# threads cross (engine shutdown + socket close + a fresh dial/accept
+# mesh while the survivors' writer/progress threads wind down), so it
+# gets a sanitized battery too: a 3-rank engine-armed load loop whose
+# rank 1 vanishes mid-stream; the survivors detect the failure on a
+# live op, abort-propagate, tpucomm_shrink into a 2-rank world at a
+# re-derived port, and run the SAME load to completion — 0 reports
+# required.
+
+_SHRINK_RANK_SRC = r"""
+import ctypes, os, sys
+import numpy as np
+
+so = os.environ["SAN_SO"]
+rank = int(os.environ["SAN_RANK"])
+size = int(os.environ["SAN_SIZE"])
+port = int(os.environ["SAN_PORT"])
+
+lib = ctypes.CDLL(so)
+lib.tpucomm_init.restype = ctypes.c_int64
+lib.tpucomm_init.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                             ctypes.c_char_p]
+lib.tpucomm_shrink.restype = ctypes.c_int64
+lib.tpucomm_shrink.argtypes = [ctypes.c_int64, ctypes.c_int,
+                               ctypes.c_int, ctypes.c_int,
+                               ctypes.c_char_p]
+h = lib.tpucomm_init(rank, size, port, b"")
+assert h > 0, "tpucomm_init failed"
+
+F32, SUM = 11, 0
+n = 256
+p = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+
+def load_iter(h, rank, size, it, must=False):
+    '''One iteration of engine-armed load; returns False on the first
+    transport failure (must=False) or asserts success (must=True).'''
+    buf = np.arange(n, dtype=np.float32) + rank
+    out = np.zeros_like(buf)
+    dest = (rank + 1) % size
+    src = (rank - 1 + size) % size
+    for i in range(6):
+        rc = lib.tpucomm_send(h, p(buf), buf.nbytes, dest, it * 8 + i)
+        if rc:
+            assert not must, f"send failed post-shrink at {it}.{i}"
+            return False
+    for i in range(6):
+        rc = lib.tpucomm_recv(h, p(out), out.nbytes, src, it * 8 + i)
+        if rc:
+            assert not must, f"recv failed post-shrink at {it}.{i}"
+            return False
+    rc = lib.tpucomm_allreduce(h, p(buf), p(out), n, F32, SUM)
+    if rc:
+        assert not must, f"allreduce failed post-shrink at {it}"
+        return False
+    assert out[0] == sum(range(size)), out[0]
+    rc = lib.tpucomm_barrier(h)
+    if rc:
+        assert not must, f"barrier failed post-shrink at {it}"
+        return False
+    return True
+
+failed = False
+for it in range(8):
+    if rank == 1 and it == 3:
+        # the injected death: vanish mid-stream with the mesh live
+        # (peers see a reset on their next op touching this rank)
+        print("san-rank-ok", rank, flush=True)
+        os._exit(0)
+    if not load_iter(h, rank, size, it):
+        failed = True
+        break
+
+assert failed, "survivors must observe the rank death"
+lib.tpucomm_abort_all()
+new_rank = {0: 0, 2: 1}[rank]
+h2 = lib.tpucomm_shrink(h, new_rank, 2, port + 7, b"")
+assert h2 > 0, "tpucomm_shrink bootstrap failed"
+for it in range(6):
+    load_iter(h2, new_rank, 2, 100 + it, must=True)
+lib.tpucomm_finalize(ctypes.c_int64(h2))
+print("san-rank-ok", rank, flush=True)
+"""
+
+
+@pytest.mark.parametrize("shm", ["on", "off"])
+def test_tsan_shrink_under_load_three_ranks(shm):
+    _build("tsan")
+    preload = _preload_path("libtsan.so")
+    so = os.path.join(SO_DIR, "libtpucomm_tsan.so")
+    extra = {
+        "MPI4JAX_TPU_JOBID": f"tsanshr{shm}{os.getpid()}",
+        "MPI4JAX_TPU_PROGRESS_THREAD": "1",
+        "MPI4JAX_TPU_COALESCE_BYTES": "4096",
+        # bound every wait: a survivor parked on the dead rank's
+        # socket (or the shm barrier, shm=on) must fail over, not hang
+        "MPI4JAX_TPU_TIMEOUT_S": "10",
+        "MPI4JAX_TPU_CONNECT_TIMEOUT_S": "30",
+    }
+    if shm == "off":
+        extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    _run_group(
+        _SHRINK_RANK_SRC, 3, so, preload,
+        {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"},
+        48400 + (os.getpid() + (19 if shm == "on" else 0)) % 900,
+        extra,
+    )
+
+
 @pytest.mark.parametrize("shm", ["on", "off"])
 def test_asan_loopback_pair(shm):
     _build("asan")

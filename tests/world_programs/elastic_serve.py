@@ -1,0 +1,79 @@
+"""Bridge-level continuous-batching serving rank program (no jax, so
+it runs in ANY container via the parent-package shim).
+
+Rank 0 is the frontend: it submits a stream of requests — some only
+after serving already started (continuous batching) — and drains them
+through ``mpi4jax_tpu.elastic.serving``.  The toy decode function is a
+deterministic function of the row contents ONLY, so the completed
+transcripts are independent of world size and of how many times an
+iteration was retried: a run that loses a rank mid-stream must print
+the EXACT digest of an uninterrupted run, with every request completed.
+
+Usage (under the launcher): elastic_serve.py [nreq]
+"""
+
+import hashlib
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+
+import numpy as np  # noqa: E402
+
+from mpi4jax_tpu.elastic import serving  # noqa: E402
+from mpi4jax_tpu.runtime import transport  # noqa: E402
+
+NREQ = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+
+
+def decode_fn(toks, lengths, start, stop):
+    """Next token per row: a pure function of the row's tokens."""
+    out = np.zeros(stop - start, np.int32)
+    for i in range(start, stop):
+        n = int(lengths[i])
+        row = toks[i, :n].astype(np.int64)
+        out[i - start] = int((row.sum() * 31 + n * 7 + int(row[-1])) % 997)
+    return out
+
+
+def main():
+    comm = transport.get_world_comm()
+    _ = comm.handle  # connect the mesh before the first broadcast
+    if comm.rank() != 0:
+        serving.serve_worker(comm, decode_fn)
+        print("elastic_serve worker done", flush=True)
+        return
+
+    server = serving.Server(comm, decode_fn, max_batch=4)
+    for i in range(NREQ // 2):
+        server.submit([i + 1, 2 * i + 1], max_new=3 + (i % 3))
+    iters = 0
+    while server.active or len(server.completed) < NREQ:
+        # continuous batching: the second half of the stream arrives
+        # while the first half is already decoding
+        if iters == 2:
+            for i in range(NREQ // 2, NREQ):
+                server.submit([i + 1, 2 * i + 1], max_new=3 + (i % 3))
+        server.step()
+        iters += 1
+        if iters > 500:
+            raise RuntimeError("serving did not drain")
+    server.stop()
+
+    digest = hashlib.sha256()
+    for r in sorted(server.completed, key=lambda r: r.id):
+        assert r.done and len(r.generated) >= 3, (r.id, r.tokens)
+        digest.update(repr((r.id, r.tokens)).encode())
+    print(f"elastic_serve digest {digest.hexdigest()}", flush=True)
+    print(f"elastic_serve OK nreq={len(server.completed)} "
+          f"recoveries={server.recoveries}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

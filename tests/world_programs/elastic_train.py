@@ -1,0 +1,59 @@
+"""Bridge-level elastic DP training rank program (no jax import, so it
+runs in ANY container via the parent-package shim).
+
+Each step allreduce-means a gradient that is IDENTICAL on every rank,
+so the parameter trajectory is invariant to the world size — an
+elastic run that loses a rank mid-job, shrinks (or respawns), restores
+the last committed checkpoint, and finishes must print the EXACT digest
+of an uninterrupted run.  That pins the whole recovery pipeline:
+RankFailure surfacing, generation announcements, the tpucomm_shrink
+bootstrap, and checkpoint commit/restore.
+
+Usage (under the launcher): elastic_train.py [steps]
+Checkpoint directory: MPI4JAX_TPU_CKPT_DIR (set by the test).
+"""
+
+import hashlib
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+
+import numpy as np  # noqa: E402
+
+from mpi4jax_tpu.elastic import training  # noqa: E402
+from mpi4jax_tpu.runtime import bridge, transport  # noqa: E402
+
+STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 12
+
+
+def grad(step):
+    # identical on every rank; synced with a MAX allreduce, whose
+    # result is bit-identical for ANY world size (a SUM-mean would
+    # round differently at np=3 vs the shrunk np=2: (3g)/3 != g in
+    # f64) — so the trajectory survives a shrink bit-for-bit and the
+    # final digest must equal an uninterrupted run's
+    return np.cos(np.arange(8) * (step + 1) * 0.1)
+
+
+def step_fn(state, step, comm):
+    g = bridge.allreduce(comm.handle, grad(step), 2)  # MAX
+    return state - 0.05 * g
+
+
+def main():
+    comm = transport.get_world_comm()
+    state = training.run(step_fn, np.zeros(8), steps=STEPS, save_every=2)
+    digest = hashlib.sha256(np.asarray(state).tobytes()).hexdigest()
+    print(f"elastic_train digest r{comm.rank()} {digest}", flush=True)
+    print("elastic_train OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()
