@@ -7,13 +7,20 @@ CPU mesh); one JSON line per message size.
     python benchmarks/allreduce_sweep.py [--max-mb 256] [--world] [--pallas]
 
 ``--world`` benchmarks the world tier (native transport) instead, under
-the launcher.  ``--algos ring,qring,rd,qrd,tree`` (world tier)
-additionally sweeps each FORCED collective algorithm — including the
-quantized wire formats — and emits one LOGICAL GB/s curve per algorithm
-(``"algo"`` field in every record; quantized records add ``wire_bytes``
-and ``compression``) — the per-algorithm evidence the BENCH artifact,
+the launcher.  ``--algos ring,qring,rd,qrd,tree,hring,htree`` (world
+tier) additionally sweeps each FORCED collective algorithm — including
+the quantized wire formats and the hierarchical (topology-aware)
+schedules — and emits one LOGICAL GB/s curve per algorithm (``"algo"``
+field in every record; quantized records add ``wire_bytes`` and
+``compression``) — the per-algorithm evidence the BENCH artifact,
 the crossover curves in docs/benchmarks.md, and the tune package's
-defaults rest on.  The raw-transport loop runs IN PLACE
+defaults rest on.  When the job discovered a topology every record is
+stamped with its fingerprint (``topology`` / ``islands``), and
+hierarchical records carry the analytic per-leg byte split
+(``intra_bytes`` / ``inter_bytes``); run under
+``launch --fake-hosts 'r0,r1|r2,r3'`` (or a real multi-host layout) to
+measure them for real — on a flat comm they degrade to their flat
+twins.  The raw-transport loop runs IN PLACE
 (sendbuf == recvbuf, the donated-buffer steady state) and reports
 per-call medians.  ``--pallas`` benchmarks
 the Pallas RDMA ring collectives (``ops/pallas_collectives.py``) — on TPU
@@ -101,6 +108,7 @@ def world_tier_rank(max_bytes, sizes=None, algos=None):
     # into ALGO_CODES below
     algo_list = [a if a == "auto" else tune._check_algo(a)
                  for a in (algos or ["auto"])]
+    topology = comm.topology()
     if any(a != "auto" for a in algo_list):
         active, _, _ = bridge.shm_info(comm.handle)
         if active and comm.rank() == 0:
@@ -108,6 +116,13 @@ def world_tier_rank(max_bytes, sizes=None, algos=None):
                   "are no-ops there (every curve measures the arena); set "
                   "MPI4JAX_TPU_DISABLE_SHM=1 to sweep the TCP algorithms",
                   flush=True)
+        if (any(a in tune.HIER_ALGOS for a in algo_list)
+                and (topology is None or not topology.multi)
+                and comm.rank() == 0):
+            print("# WARNING: hring/htree requested on a FLAT comm — they "
+                  "degrade to their flat twins (ring/tree); partition the "
+                  "job with launch --fake-hosts 'r0,r1|r2,r3' (or run "
+                  "multi-host) to measure the hierarchy", flush=True)
     size_list = sizes or []
     if not size_list:
         size = 1024
@@ -257,6 +272,17 @@ def world_tier_rank(max_bytes, sizes=None, algos=None):
                     wb = bridge.quant_packed_bytes(size // 4)
                     extra = {"wire_bytes": wb,
                              "compression": round(size / wb, 3)}
+                if topology is not None and topology.multi:
+                    # the shape this curve was measured on: joinable
+                    # with the topology-keyed tune cache
+                    extra["topology"] = topology.fingerprint()
+                    extra["islands"] = [len(m) for m in topology.islands]
+                    if resolved in tune.HIER_ALGOS:
+                        # analytic per-leg wire-byte split (job total):
+                        # the intra/inter asymmetry the hierarchy buys
+                        leg = topology.leg_bytes(resolved, size)
+                        extra["intra_bytes"] = leg["intra"]
+                        extra["inter_bytes"] = leg["inter"]
                 # shared serializer (obs.bench_record) keeps this curve
                 # field-compatible with BENCH_*.json and profile reports
                 print(json.dumps(obs.bench_record(

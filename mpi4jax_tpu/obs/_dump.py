@@ -106,6 +106,8 @@ def load_events(path: str):
             }
             if "wire_bytes" in args:
                 evd["wire_bytes"] = int(args["wire_bytes"])
+            if args.get("tier"):
+                evd["tier"] = args["tier"]  # hierarchical leg label
             events.append(evd)
         other = data.get("otherData") or {}
         return events, int(other.get("world_size", 1))

@@ -24,7 +24,11 @@ OBS_OP_NAMES = (
 
 #: TpuCollAlgo codes -> names (keep in sync with mpi4jax_tpu/tune)
 ALGO_NAMES = {0: "auto", 1: "ring", 2: "rd", 3: "tree", 4: "shm",
-              5: "qring", 6: "qrd"}
+              5: "qring", 6: "qrd", 7: "hring", 8: "htree"}
+
+#: TpuObsTier codes -> names (0 = flat / whole-op, omitted from the
+#: canonical events; hierarchical per-leg events carry intra/inter)
+TIER_NAMES = {1: "intra", 2: "inter", 3: "ici"}
 
 
 class TpuObsEvent(ctypes.Structure):
@@ -39,6 +43,8 @@ class TpuObsEvent(ctypes.Structure):
         ("peer", ctypes.c_int32),
         ("tag", ctypes.c_int32),
         ("algo", ctypes.c_int32),
+        ("tier", ctypes.c_int32),
+        ("_pad", ctypes.c_int32),
     ]
 
 
@@ -50,16 +56,19 @@ def available(lib) -> bool:
     """True when the loaded .so carries the event ring (a stale prebuilt
     library predating it keeps working, just unobserved).
 
-    ``tpucomm_quant_packed_bytes`` doubles as the layout probe: a
-    library from before the quantized collective engine records events
-    WITHOUT the ``wire_bytes`` field (and pre-progress-engine ones also
-    lack ``queue_s``), which this module would misparse — such a
-    library is treated as unobserved rather than decoded wrong."""
+    ``tpucomm_set_topology`` doubles as the layout probe: a library
+    from before the topology subsystem records events WITHOUT the
+    ``tier`` field (pre-quantization ones also lack ``wire_bytes``,
+    pre-progress-engine ones ``queue_s``), which this module would
+    misparse — such a library is treated as unobserved rather than
+    decoded wrong."""
     if lib is None or not hasattr(lib, "tpucomm_obs_enable"):
         return False
     if not hasattr(lib, "tpucomm_execute"):
         return False
     if not hasattr(lib, "tpucomm_quant_packed_bytes"):
+        return False
+    if not hasattr(lib, "tpucomm_set_topology"):
         return False
     # idempotent signature setup (works for bridge-loaded and
     # standalone-loaded libraries alike)
@@ -126,5 +135,6 @@ def drain(lib, max_events: int = 1 << 20):
             "peer": e.peer,
             "tag": e.tag,
             "algo": ALGO_NAMES.get(e.algo),
+            "tier": TIER_NAMES.get(e.tier),
         })
     return out

@@ -101,7 +101,7 @@ def enabled() -> bool:
 
 def default_capacity_events() -> int:
     """Ring capacity from ``MPI4JAX_TPU_TRACE_BUF_KB`` (default 256 KB
-    of 64-byte native slots = 4096 events; same count on the Python
+    of 72-byte native slots = 3640 events; same count on the Python
     side)."""
     raw = config.setting("MPI4JAX_TPU_TRACE_BUF_KB", "256")
     try:
@@ -219,6 +219,11 @@ def _pull_native() -> None:
         wb = e.get("wire_bytes", e["bytes"])
         if wb != e["bytes"]:
             ev["wire_bytes"] = wb
+        # transport tier: carried only on a hierarchical collective's
+        # per-leg events ("intra"/"inter"), absent on whole-op and
+        # flat events — pre-topology recordings stay schema-identical
+        if e.get("tier"):
+            ev["tier"] = e["tier"]
         canon.append(ev)
     _state.native_acc.extend(canon)
 

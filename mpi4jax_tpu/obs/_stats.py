@@ -12,9 +12,11 @@ Canonical event shape (every producer — the native ring, the ops-layer
      "algo": "ring" | ... | None}
 
 plus an optional ``wire_bytes`` carried ONLY when it differs from
-``bytes`` (quantized collectives: the packed int8+scales payload).
-Every consumer defaults it to ``bytes``, so pre-quantization
-recordings stay schema-compatible.
+``bytes`` (quantized collectives: the packed int8+scales payload), and
+an optional ``tier`` (``"intra"`` / ``"inter"``) carried ONLY on a
+hierarchical collective's per-leg events — the whole-op record stays
+tier-less, so per-leg rows never double-count against it and
+pre-topology recordings stay schema-compatible.
 
 ``dispatch_us`` is the submission-queue delay of an engine-queued op
 (post -> native execution start; 0 for inline execution) — the host
@@ -69,15 +71,23 @@ def summarize(events, dropped=None, rank=None) -> dict:
     payload over wall time, no algorithm factor).
     """
     groups = {}
+    tier_bytes = {}
     for ev in events:
         # src is part of the key: the native ring and the ops-layer
         # span record the SAME call from two vantage points — collapsing
-        # them would double-count every send/recv and dilute wait_frac
+        # them would double-count every send/recv and dilute wait_frac.
+        # tier is part of the key too: a hierarchical collective's
+        # intra/inter leg events must not merge with (or into) the
+        # whole-op record.
         key = (ev.get("name", "?"), ev.get("src", "?"),
-               int(ev.get("peer", -1)), ev.get("algo") or "-")
+               int(ev.get("peer", -1)), ev.get("algo") or "-",
+               ev.get("tier") or "-")
         groups.setdefault(key, []).append(ev)
+        if ev.get("tier"):
+            tier_bytes[ev["tier"]] = (tier_bytes.get(ev["tier"], 0)
+                                      + int(ev.get("bytes", 0)))
     rows = []
-    for (op, src, peer, algo), evs in sorted(groups.items()):
+    for (op, src, peer, algo, tier), evs in sorted(groups.items()):
         durs = [float(e.get("dur_us", 0.0)) for e in evs]
         waits = [float(e.get("wait_us", 0.0)) for e in evs]
         disps = [float(e.get("dispatch_us", 0.0)) for e in evs]
@@ -100,6 +110,10 @@ def summarize(events, dropped=None, rank=None) -> dict:
             "wait_frac": round(sum(waits) / max(sum(durs), 1e-12), 4),
             "eff_GBps": _sig(nbytes / max(seconds, 1e-12) / 1e9),
         }
+        if tier != "-":
+            # hierarchical per-leg row: name the transport tier it
+            # moved on (exact rows stay schema-identical)
+            row["tier"] = tier
         if wire_bytes != nbytes:
             # quantized wire formats: logical vs on-wire payload.  The
             # column appears only when it says something (exact rows
@@ -115,6 +129,12 @@ def summarize(events, dropped=None, rank=None) -> dict:
         "dropped": dict(dropped or {}),
         "per_op": rows,
     }
+    if tier_bytes:
+        # intra- vs inter-island byte split of the hierarchical
+        # collectives (per-leg events only — whole-op records carry no
+        # tier, so nothing is counted twice)
+        out["tier_bytes"] = {k: int(v)
+                             for k, v in sorted(tier_bytes.items())}
     if rank is not None:
         out["rank"] = int(rank)
     return out
@@ -125,6 +145,10 @@ def render_table(stats: dict, *, by=("op", "algo")) -> str:
     cols = ("op", "src", "peer", "algo", "count", "bytes", "p50_us",
             "p95_us", "p99_us", "dispatch_frac", "wait_frac", "eff_GBps")
     rows = stats.get("per_op", [])
+    if any("tier" in r for r in rows):
+        # hierarchical per-leg rows present: show the transport tier
+        # (flat rows render blank)
+        cols = cols + ("tier",)
     if any("compression" in r for r in rows):
         # quantized rows present: show the on-wire compression ratio
         # (exact rows render blank — their wire IS the logical payload)

@@ -52,6 +52,8 @@ def rank_trace_events(events, rank: int):
         }
         if ev.get("algo"):
             args["algo"] = ev["algo"]
+        if ev.get("tier"):
+            args["tier"] = ev["tier"]  # hierarchical leg: intra / inter
         wb = int(ev.get("wire_bytes", ev.get("bytes", 0)))
         if wb != args["bytes"]:
             args["wire_bytes"] = wb  # quantized: compressed payload

@@ -17,7 +17,8 @@ from . import _dispatch, _mesh_impl
 from .reduce_ops import SUM, as_reduce_op
 
 
-def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
+def allreduce(x, op=SUM, *, comm=None, token=None, compression=None,
+              algo=None):
     """Reduce ``x`` with ``op`` across all ranks of ``comm``.
 
     Args:
@@ -29,10 +30,37 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
         compression: ``"int8"`` for the bandwidth-saving quantized path
             (SUM only, ~1e-2 relative error, both tiers;
             ops/quantized.py).
+        algo: force a collective algorithm for THIS call on a world
+            comm (``"ring"``/``"rd"``/``"tree"``/``"qring"``/``"qrd"``/
+            ``"hring"``/``"htree"``) instead of the engine's selection.
+            Every rank must force the same one; ineligible picks
+            degrade exactly like table rows (``mpi4jax_tpu.tune``), and
+            the schedule signature stays plain ``allreduce`` — forcing
+            is invisible to the static verifier.
     """
     op = as_reduce_op(op)
     x = _validation.check_array("x", x)
     comm = _dispatch.resolve_comm(comm)
+
+    if algo is not None:
+        from .. import tune
+
+        algo = tune._check_algo(algo, "allreduce")
+        if _dispatch.is_mesh(comm):
+            _validation.fail(
+                "algo= forces a WORLD-tier transport schedule; the mesh "
+                "tier compiles to one XLA collective",
+                op="allreduce", comm=comm, x=x, exc=NotImplementedError)
+        if compression is not None:
+            _validation.fail(
+                "compression='int8' selects its own wire format; do not "
+                "combine it with algo=",
+                op="allreduce", comm=comm, x=x, exc=ValueError)
+        if op.custom:
+            _validation.fail(
+                f"custom reduce op {op.name} runs as allgather + local "
+                "fold; there is no allreduce schedule to force",
+                op="allreduce", comm=comm, x=x, exc=ValueError)
 
     if compression is not None:
         if compression != "int8":
@@ -77,7 +105,7 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
 
         _validation.check_reduce_dtype("allreduce", op, x, comm)
         _validation.check_wire_dtype("allreduce", x, comm)
-        body = lambda v: _world_impl.allreduce(v, op, comm)
+        body = lambda v: _world_impl.allreduce(v, op, comm, algo=algo)
         if op.custom:  # allgather + local fold, token-chained
             return _dispatch.maybe_tokenized(
                 body, x, token,
@@ -85,5 +113,5 @@ def allreduce(x, op=SUM, *, comm=None, token=None, compression=None):
         return _dispatch.maybe_tokenized(
             body, x, token,
             token_fn=_world_impl.token_variant_fn(
-                "allreduce", comm=comm, op=op))
+                "allreduce", comm=comm, op=op, algo=algo))
     return _dispatch.maybe_tokenized(body, x, token)

@@ -163,6 +163,20 @@ def _finish_lib_setup(lib: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.c_int64, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_char_p,
         ]
+    # topology subsystem (guarded like split/dup: a stale prebuilt .so
+    # keeps the flat transport; discovery then only feeds the Python
+    # probes and the topology-keyed tune cache)
+    if hasattr(lib, "tpucomm_set_topology"):
+        lib.tpucomm_set_topology.restype = ctypes.c_int
+        lib.tpucomm_set_topology.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int64,
+        ]
+        lib.tpucomm_topo_info.restype = ctypes.c_int
+        lib.tpucomm_topo_info.argtypes = [
+            ctypes.c_int64, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
     # guarded: a stale prebuilt .so without split/dup must still serve
     # the other ops (split then fails at call time, not load time)
     if hasattr(lib, "tpucomm_split"):
@@ -432,6 +446,21 @@ def _post_init_setup(lib, handle, rank: int, size: int, *,
                      install_plan: bool) -> None:
     """The selection/telemetry layers every fresh world needs, shared by
     :func:`comm_init` and elastic recovery's :func:`rebuild`."""
+    # topology discovery FIRST (it is collective, and the tune install
+    # below keys the persistent cache on the discovered fingerprint).
+    # MPI4JAX_TPU_TOPO=off skips it entirely; a malformed FAKE_HOSTS
+    # spec stays fail-fast (the native bootstrap already exited on it).
+    topology = None
+    if size > 1 and config.topo_mode() != "off":
+        topology = _install_topology(lib, handle, rank, size)
+    # the tune layer only sees the topology when the native layer can
+    # actually RUN the hierarchical schedules: on a stale .so (no
+    # tpucomm_set_topology) its set_coll_table drops the unknown hring
+    # code, and a flipped default table would silently degrade large
+    # allreduces to the small-payload tree — discovery then serves the
+    # Python probes only, and the flat defaults/caches stay in force
+    tune_topology = (topology
+                     if hasattr(lib, "tpucomm_set_topology") else None)
     # collective algorithm engine: load the persistent autotune cache and
     # push the merged decision table natively — every dispatch path
     # (eager / callback / FFI) then resolves the algorithm per call.
@@ -443,7 +472,7 @@ def _post_init_setup(lib, handle, rank: int, size: int, *,
     try:
         from .. import tune
 
-        tune.install(size)
+        tune.install(size, topology=tune_topology)
     except ValueError:
         raise
     except Exception as e:  # pragma: no cover - defensive
@@ -478,6 +507,110 @@ def _post_init_setup(lib, handle, rank: int, size: int, *,
             warnings.warn(f"schedule-plan install failed: {e}")
 
 
+#: topology sub-communicator handles (intra-island, leaders) cached per
+#: world handle — they borrow the world's sockets, so they must be
+#: finalized BEFORE the world (comm_finalize / rebuild do)
+_topo_handles: dict = {}
+
+
+def _install_topology(lib, handle, rank: int, size: int):
+    """Run the discovery handshake, derive the sub-communicators on a
+    multi-island world, and install the map natively.  COLLECTIVE:
+    every rank runs it at the same position inside comm_init/rebuild.
+    Returns the Topology (registered for ``WorldComm.topology()``), or
+    None when discovery failed soft.
+
+    Failure softness is asymmetric: the collectives themselves abort
+    the job on transport errors (nothing to soften), but a native layer
+    predating the topology exports, or a set_topology rejection, leaves
+    the comm FLAT with a warning — locality awareness must never take
+    down a healthy transport."""
+    from .. import topo
+
+    try:
+        t = topo.discover(handle, rank, size)
+    except Exception as e:
+        # an elastic-mode TRANSPORT failure (peer died mid-handshake)
+        # must propagate as the catchable RankFailure it is — only
+        # discovery-layer problems (unparseable fingerprints, mixed
+        # versions) soften to a flat transport
+        if config.elastic_enabled():
+            from ..elastic import RankFailure
+
+            if isinstance(e, RankFailure):
+                raise
+        import warnings
+
+        warnings.warn(f"topology discovery failed; transport stays "
+                      f"flat: {e}")
+        return None
+    subs = []
+    intra_h = leader_h = None
+    if t.multi and hasattr(lib, "tpucomm_set_topology"):
+        # both splits are collective over the world: EVERY rank calls
+        # both, members or not (color -1 opts out of the leaders comm)
+        my_island = t.island_of[rank]
+        intra_h = split(handle, my_island, rank)
+        am_leader = rank == t.leaders[my_island]
+        leader_h = split(handle, 0 if am_leader else -1, rank)
+        if len(t.islands[my_island]) == 1 and intra_h is not None:
+            # a singleton island's intra comm is a size-1 shell the
+            # schedules never touch; drop it rather than cache it
+            comm_finalize(intra_h)
+            intra_h = None
+        subs = [h for h in (intra_h, leader_h) if h is not None]
+    if hasattr(lib, "tpucomm_set_topology"):
+        arr = (ctypes.c_int32 * size)(*t.island_of)
+        rc = lib.tpucomm_set_topology(
+            _i64(handle), arr, size, _i64(intra_h or 0),
+            _i64(leader_h or 0))
+        if rc != 0:
+            import warnings
+
+            warnings.warn(
+                "native topology install was rejected; hierarchical "
+                "schedules stay degraded to their flat twins")
+    if subs:
+        _topo_handles[int(handle)] = subs
+    topo._register(handle, t)
+    return t
+
+
+def _teardown_topology(handle) -> None:
+    """Finalize the cached topology sub-comms of a world handle (they
+    borrow its sockets — native finalize order requires children
+    first) and forget its registry entries."""
+    for sub in _topo_handles.pop(int(handle), []):
+        try:
+            get_lib().tpucomm_finalize(_i64(sub))
+        except Exception:  # pragma: no cover - teardown path
+            pass
+    try:
+        from .. import topo
+
+        topo._forget(handle)
+    except Exception:  # pragma: no cover - teardown path
+        pass
+
+
+def topo_info(handle):
+    """The NATIVE layer's installed island map for a comm:
+    ``(island_of, n_islands)``, or None when the comm is flat or the
+    loaded .so predates the topology subsystem."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_topo_info"):
+        return None
+    size = comm_size(handle)
+    arr = (ctypes.c_int32 * size)()
+    n = ctypes.c_int32(0)
+    rc = lib.tpucomm_topo_info(_i64(handle), arr, ctypes.byref(n))
+    if rc == -1:
+        raise ValueError(f"bad comm handle {handle}")
+    if rc != 0:
+        return None
+    return list(arr), int(n.value)
+
+
 def shrink_available() -> bool:
     """True when the loaded .so carries the elastic recovery bootstrap."""
     return hasattr(get_lib(), "tpucomm_shrink")
@@ -499,6 +632,13 @@ def rebuild(old_handle, new_rank: int, new_size: int, base_port: int,
         raise RuntimeError(
             "elastic recovery needs a native library with the "
             "tpucomm_shrink bootstrap; rebuild native/")
+    # the dead world's topology sub-comms borrow its sockets: finalize
+    # them BEFORE the native shrink finalizes the world (the documented
+    # sub-comm teardown order), and drop the stale Topology — the
+    # rebuilt world re-discovers below, so a shrink that emptied an
+    # island cleanly re-derives the (possibly now flat) map
+    if old_handle:
+        _teardown_topology(old_handle)
     handle = lib.tpucomm_shrink(
         _i64(old_handle or 0), int(new_rank), int(new_size),
         int(base_port), (hosts or "").encode())
@@ -509,7 +649,9 @@ def rebuild(old_handle, new_rank: int, new_size: int, base_port: int,
 
 
 def comm_finalize(handle) -> None:
-    """Close one native communicator (drains its engine first)."""
+    """Close one native communicator (drains its engine first; cached
+    topology sub-comms go first — they borrow its sockets)."""
+    _teardown_topology(handle)
     get_lib().tpucomm_finalize(_i64(handle))
 
 

@@ -316,6 +316,14 @@ def main(argv=None):
                              "start one process per rank with your "
                              "scheduler and set MPI4JAX_TPU_RANK/SIZE "
                              "plus MPI4JAX_TPU_HOSTS directly.")
+    parser.add_argument("--fake-hosts", default=None, metavar="SPEC",
+                        help="virtual host partition for topology testing "
+                             "(exports MPI4JAX_TPU_FAKE_HOSTS to every "
+                             "rank): 'r0,r1|r2,r3' makes ranks 0-1 and "
+                             "2-3 two islands — intra-island shm arenas, "
+                             "TCP between islands, hierarchical "
+                             "collectives eligible (docs/usage.md "
+                             "§ Transport tiers and topology)")
     parser.add_argument("--verify", action="store_true",
                         help="pre-flight: statically verify the program's "
                              "communication schedule (python -m "
@@ -486,6 +494,8 @@ def main(argv=None):
             env["MPI4JAX_TPU_PLAN"] = plan_path
         if args.hosts:
             env["MPI4JAX_TPU_HOSTS"] = args.hosts
+        if args.fake_hosts:
+            env["MPI4JAX_TPU_FAKE_HOSTS"] = args.fake_hosts
         if args.platform:
             env["JAX_PLATFORMS"] = args.platform
         else:

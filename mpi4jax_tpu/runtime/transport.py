@@ -244,6 +244,16 @@ class WorldComm:
     Clone = dup
     Split = split
 
+    def topology(self):
+        """The discovered :class:`mpi4jax_tpu.topo.Topology` of this
+        communicator (connects the mesh on first use), or None — flat
+        comm, ``MPI4JAX_TPU_TOPO=off``, or a sub-communicator (topology
+        is discovered per WORLD; sub-comms inherit its locality
+        implicitly through the split-level arena gating)."""
+        from .. import topo
+
+        return topo.get_topology(self.handle)
+
     def coll_algo(self, op: str, nbytes: int) -> str:
         """Name of the collective algorithm the engine would run for
         ``op`` ("allreduce"/"allgather") at ``nbytes`` on this comm —

@@ -1,0 +1,316 @@
+"""Topology subsystem: locality discovery and the hierarchical tiers.
+
+The world tier's transport is flat by default — at np8 split across two
+hosts the ring crosses the slow TCP boundary on every hop even though
+six of the eight rank pairs share a shm arena.  This package makes
+locality explicit:
+
+- **discovery** (:func:`discover`, run by ``runtime.bridge.comm_init``
+  at communicator creation): every rank contributes a host fingerprint
+  (hostname + boot id, TPU chip sniff, and the
+  ``MPI4JAX_TPU_FAKE_HOSTS`` virtual partition for single-machine
+  testing) through a bootstrap allgather, and the agreeing result
+  becomes a :class:`Topology`;
+- **sub-communicators**: on a multi-island world the bridge derives an
+  intra-island comm and a leaders comm through the existing ``split``
+  machinery, caches them per world comm, and installs the map natively
+  (``tpucomm_set_topology``) so the transport's dispatch is
+  locality-aware;
+- **hierarchical collectives**: the native engine's ``hring``/``htree``
+  schedules (intra-island shm reduce → leader-tier TCP allreduce —
+  the only leg eligible for the ``qring``/``qrd`` quantized wire
+  formats under ``MPI4JAX_TPU_COLL_QUANT=force`` — → intra-island
+  bcast), first-class rows in the tune decision table, plus
+  hierarchical routing for large ``bcast``/``reduce``;
+- **transport tiers** ``ici > shm > tcp``: each rank's best tier is
+  reported per link (:meth:`Topology.link`), ``ici`` marking ranks
+  backed by a live TPU mesh (device collectives ride
+  ``lax.psum``/Pallas on that tier — see docs/usage.md).
+
+Knobs (``utils/config.py`` is the registry): ``MPI4JAX_TPU_TOPO``
+(auto/off discovery), ``MPI4JAX_TPU_FAKE_HOSTS`` (virtual partition),
+``MPI4JAX_TPU_HIER`` (allow/deny/force hierarchical schedules).
+
+This module is importable without jax, numpy, or the native library
+(pure stdlib), like ``tune``; only :func:`discover` and the numpy
+schedule simulators (lazy re-exports from ``_simulate``) need more.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+from typing import Dict, List, Optional, Sequence
+
+FINGERPRINT_VERSION = 1
+
+#: transport tier names, best first (the promotion order of the
+#: ROADMAP's "ici > shm > tcp" pillar)
+TIERS = ("ici", "shm", "tcp")
+
+
+def parse_fake_hosts(spec: Optional[str], size: int) -> Optional[List[Optional[str]]]:
+    """Parse ``MPI4JAX_TPU_FAKE_HOSTS`` (``r0,r1|r2,r3``: groups of
+    world ranks separated by ``|``, tokens ``rN`` or bare ``N``) into a
+    per-rank virtual host label, ``None`` for unlisted ranks — or
+    ``None`` when the spec is empty.  Mirrors the native parser
+    byte-for-byte: malformed tokens and duplicate ranks raise (loud,
+    like the fault spec — a typo'd partition must not silently test
+    the wrong shape); out-of-range ranks are ignored, so a spec
+    written for np=4 stays valid on a shrunk np=2 world."""
+    if not spec or not spec.strip():
+        return None
+    labels: List[Optional[str]] = [None] * size
+    seen = set()
+    for group_idx, group in enumerate(spec.split("|")):
+        for tok in group.split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            body = tok[1:] if tok[:1] in ("r", "R") else tok
+            try:
+                r = int(body)
+            except ValueError:
+                r = -1
+            if r < 0 or (body and not body.isdigit()):
+                raise ValueError(
+                    f"cannot parse MPI4JAX_TPU_FAKE_HOSTS token {tok!r} "
+                    "(expected rN or N, groups separated by |)")
+            if r < size:
+                # duplicates are tracked for IN-RANGE ranks only, like
+                # the native parser: a spec written for a larger world
+                # may repeat ranks the shrunk world no longer has
+                if r in seen:
+                    raise ValueError(
+                        f"MPI4JAX_TPU_FAKE_HOSTS lists rank {r} twice")
+                seen.add(r)
+                labels[r] = f"fake-host-{group_idx}"
+    return labels
+
+
+def _boot_id() -> str:
+    """A per-boot host identity: two ranks share a host exactly when
+    hostname AND boot id agree (containers can share a hostname string
+    without sharing memory; the boot id disambiguates)."""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def _tpu_chip_count() -> int:
+    """Best-effort count of locally attached TPU chips WITHOUT touching
+    jax (initializing a backend at comm bootstrap could claim the
+    accelerator): the libtpu device-node conventions."""
+    count = 0
+    for pattern in ("/dev/accel", "/dev/vfio/"):
+        base = os.path.dirname(pattern) or "/dev"
+        prefix = os.path.basename(pattern)
+        try:
+            for name in os.listdir(base):
+                if prefix and not name.startswith(prefix):
+                    continue
+                if pattern == "/dev/accel" and name[len(prefix):].isdigit():
+                    count += 1
+        except OSError:
+            pass
+        if count:
+            break
+    return count
+
+
+def local_fingerprint(rank: int, size: int) -> dict:
+    """This rank's host fingerprint — what discovery allgathers."""
+    fake = parse_fake_hosts(os.environ.get("MPI4JAX_TPU_FAKE_HOSTS"), size)
+    return {
+        "v": FINGERPRINT_VERSION,
+        "host": socket.gethostname(),
+        "boot_id": _boot_id(),
+        "fake": fake[rank] if fake else None,
+        "tpu_chips": _tpu_chip_count(),
+    }
+
+
+class Topology:
+    """The discovered locality map of one world communicator.
+
+    ``islands[i]`` is the sorted member-rank list of island ``i`` (ranks
+    sharing a host / shm domain); island ids are dense and ordered by
+    each island's lowest rank (its *leader*) — the ordering the native
+    hierarchical schedules rely on.  ``tiers[r]`` is rank r's best
+    local tier (``ici`` when a live TPU mesh backs it, else ``shm``);
+    :meth:`link` classifies a rank pair."""
+
+    def __init__(self, fingerprints: Sequence[dict]):
+        self.size = len(fingerprints)
+        self.fingerprints = list(fingerprints)
+        self.hosts: List[str] = []
+        for rank, fp in enumerate(fingerprints):
+            key = fp.get("fake") or (
+                f"{fp.get('host', '?')}|{fp.get('boot_id', '')}")
+            self.hosts.append(str(key))
+        order: Dict[str, int] = {}
+        self.island_of: List[int] = []
+        for rank, key in enumerate(self.hosts):
+            if key not in order:
+                order[key] = len(order)
+            self.island_of.append(order[key])
+        self.islands: List[List[int]] = [[] for _ in range(len(order))]
+        for rank, isl in enumerate(self.island_of):
+            self.islands[isl].append(rank)
+        self.leaders = [members[0] for members in self.islands]
+        self.tiers = [
+            "ici" if int(fp.get("tpu_chips") or 0) > 0 else "shm"
+            for fp in fingerprints
+        ]
+
+    @property
+    def n_islands(self) -> int:
+        return len(self.islands)
+
+    @property
+    def multi(self) -> bool:
+        """True when hierarchical schedules have something to exploit."""
+        return self.n_islands > 1
+
+    def island(self, rank: int) -> List[int]:
+        return self.islands[self.island_of[rank]]
+
+    def leader(self, rank: int) -> int:
+        return self.leaders[self.island_of[rank]]
+
+    def link(self, a: int, b: int) -> str:
+        """Transport class of the (a, b) link: ``self``, ``ici`` (both
+        ranks TPU-backed on one host — the device mesh tier), ``shm``
+        (same island), or ``tcp`` (island boundary)."""
+        if a == b:
+            return "self"
+        if self.island_of[a] != self.island_of[b]:
+            return "tcp"
+        if self.tiers[a] == "ici" and self.tiers[b] == "ici":
+            return "ici"
+        return "shm"
+
+    def fingerprint(self) -> str:
+        """Stable 12-hex-digit hash of the topology SHAPE (world size,
+        island sizes in island order, per-island best tier) — the key
+        of the topology-aware persistent tune cache.  Deliberately
+        independent of hostnames: two deployments with the same shape
+        share tuning."""
+        shape = {
+            "v": 1,
+            "size": self.size,
+            "islands": [len(m) for m in self.islands],
+            "tiers": [
+                min((self.tiers[r] for r in members),
+                    key=TIERS.index)
+                for members in self.islands
+            ],
+        }
+        blob = json.dumps(shape, sort_keys=True).encode()
+        return hashlib.sha256(blob).hexdigest()[:12]
+
+    def leg_bytes(self, algo: str, nbytes: int) -> Dict[str, int]:
+        """Analytic per-job wire-byte split of one collective of
+        ``nbytes`` logical payload under ``algo``: total bytes crossing
+        intra-island links vs inter-island (leader-tier) links, summed
+        over all ranks.  Flat algorithms put everything on whichever
+        links the schedule happens to cross; they are reported as
+        ``inter`` when the world spans islands (the pessimal flat
+        placement the hierarchy exists to avoid)."""
+        n, L = self.size, self.n_islands
+        if algo in ("hring", "htree"):
+            intra = 2 * nbytes * sum(len(m) - 1 for m in self.islands)
+            if L <= 1:
+                inter = 0
+            elif algo == "hring":
+                # ring over the leaders: 2*(L-1)/L of the payload per
+                # leader, each way
+                inter = 2 * (L - 1) * nbytes
+            else:
+                # recursive doubling: every butterfly participant sends
+                # the FULL payload per round, plus the non-power-of-two
+                # fold's lend-and-return pair
+                pof2 = 1
+                while pof2 * 2 <= L:
+                    pof2 *= 2
+                rem = L - pof2
+                inter = (pof2 * pof2.bit_length() - pof2 + 2 * rem) * nbytes
+            return {"intra": int(intra), "inter": int(inter)}
+        total = 2 * (n - 1) * nbytes  # ring-style total wire bytes
+        if not self.multi:
+            return {"intra": int(total), "inter": 0}
+        return {"intra": 0, "inter": int(total)}
+
+    def describe(self) -> dict:
+        """Diag/bench-friendly summary."""
+        return {
+            "size": self.size,
+            "n_islands": self.n_islands,
+            "islands": [list(m) for m in self.islands],
+            "leaders": list(self.leaders),
+            "tiers": list(self.tiers),
+            "fingerprint": self.fingerprint(),
+        }
+
+    def render(self) -> str:
+        """One-line island map, e.g.
+        ``island0[r0 r1 (shm)] | island1[r2 r3 (shm)] inter=tcp``."""
+        parts = []
+        for i, members in enumerate(self.islands):
+            tier = min((self.tiers[r] for r in members), key=TIERS.index)
+            ranks = " ".join(f"r{r}" for r in members)
+            parts.append(f"island{i}[{ranks} ({tier})]")
+        joined = " | ".join(parts)
+        return joined + (" inter=tcp" if self.multi else " (single island)")
+
+    def __repr__(self):
+        return (f"Topology(size={self.size}, islands="
+                f"{[len(m) for m in self.islands]}, "
+                f"fingerprint={self.fingerprint()})")
+
+
+def build_topology(fingerprints: Sequence[dict]) -> Topology:
+    """Group allgathered host fingerprints into a :class:`Topology`."""
+    return Topology(fingerprints)
+
+
+#: live Topology per native comm handle (the bridge registers at
+#: discovery, forgets at finalize/rebuild); WorldComm.topology() reads it
+_by_handle: Dict[int, Topology] = {}
+
+
+def get_topology(handle) -> Optional[Topology]:
+    """The discovered topology of a live comm handle, or None (flat /
+    discovery off / pre-topology native library)."""
+    return _by_handle.get(int(handle)) if handle is not None else None
+
+
+def _register(handle, topology: Topology) -> None:
+    _by_handle[int(handle)] = topology
+
+
+def _forget(handle) -> None:
+    _by_handle.pop(int(handle), None)
+
+
+def discover(handle, rank: int, size: int) -> Topology:
+    """Run the bootstrap fingerprint allgather over a live comm and
+    build the topology.  COLLECTIVE: every rank must call at the same
+    program position (``bridge.comm_init`` does, for every rank)."""
+    from ._discover import discover as _impl
+
+    return _impl(handle, rank, size)
+
+
+def __getattr__(name):
+    # lazy numpy-needing re-exports, keeping the package stdlib-importable
+    if name in ("simulate_hring_sum", "simulate_htree_sum",
+                "simulate_ring_sum", "simulate_rd_sum"):
+        from . import _simulate
+
+        return getattr(_simulate, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,46 @@
+"""The discovery handshake: allgather host fingerprints over a fresh
+world comm (two collectives: fixed-width length row, then padded JSON
+blobs — allgather needs equal shapes) and build the Topology.
+
+Runs inside ``bridge.comm_init`` BEFORE the tune-table install and the
+obs clock handshake, so the decision table can be keyed on the
+discovered fingerprint.  Uses only numpy + the bridge (no jax): the
+handshake must work for bridge-level programs and on containers where
+the package's jax gate blocks the op layer."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from . import FINGERPRINT_VERSION, Topology, local_fingerprint
+
+
+def discover(handle, rank: int, size: int) -> Topology:
+    from ..runtime import bridge
+
+    fp = local_fingerprint(rank, size)
+    blob = json.dumps(fp, sort_keys=True).encode()
+    lens = bridge.allgather(
+        handle, np.array([len(blob)], np.int64), size).ravel()
+    width = int(lens.max())
+    mine = np.zeros(width, np.uint8)
+    mine[: len(blob)] = np.frombuffer(blob, np.uint8)
+    rows = bridge.allgather(handle, mine, size)
+    fingerprints = []
+    for r in range(size):
+        raw = bytes(rows[r][: int(lens[r])])
+        try:
+            parsed = json.loads(raw.decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise RuntimeError(
+                f"topology discovery: rank {r}'s fingerprint is "
+                f"unparseable ({e}); mixed framework versions?") from e
+        if int(parsed.get("v", -1)) != FINGERPRINT_VERSION:
+            raise RuntimeError(
+                f"topology discovery: rank {r} speaks fingerprint "
+                f"version {parsed.get('v')!r}, this rank "
+                f"{FINGERPRINT_VERSION} — mixed framework versions")
+        fingerprints.append(parsed)
+    return Topology(fingerprints)

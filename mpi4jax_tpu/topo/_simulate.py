@@ -1,0 +1,136 @@
+"""Numpy schedule simulators for the hierarchical collectives.
+
+These replay the EXACT arithmetic the native schedules perform — the
+same association order, in f32 — so tests can bit-compare the native
+transport against an independent model (the same contract
+``ops/quantized.py``'s ``simulate_qring_sum`` established for the
+quantized schedules):
+
+- intra-island reduce: sequential member-order folding (both native
+  intra paths — the shm arena's ``vertical_reduce`` and the serial TCP
+  reduce — combine in member order, so ONE simulator covers shm on and
+  off);
+- ``hring`` leader leg: the chunked ring reduce-scatter/allgather
+  (every chunk accumulates contributions in ring arrival order);
+- ``htree`` leader leg: recursive doubling with the standard
+  non-power-of-two fold (pairwise exchange; IEEE f32 addition is
+  commutative, so both sides of a pair hold identical bits).
+
+SUM only: MAX/MIN and integer reductions are association-free, so the
+native result is bit-identical to the flat schedules and needs no
+simulator.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+
+def _chunk_lo(count: int, size: int, i: int) -> int:
+    per = (count + size - 1) // size
+    return min(per * i, count)
+
+
+def _f32(a) -> np.ndarray:
+    return np.asarray(a, np.float32)
+
+
+def simulate_ring_sum(inputs: Sequence[np.ndarray]) -> np.ndarray:
+    """The chunked-ring allreduce's f32 SUM association
+    (native ``ring_allreduce``): reduce-scatter accumulates chunk
+    contributions in ring arrival order, the allgather copies bytes —
+    every rank finishes with identical bits, returned once."""
+    n = len(inputs)
+    if n == 1:
+        return _f32(inputs[0]).copy()
+    bufs = [_f32(v).copy() for v in inputs]
+    count = bufs[0].size
+    for step in range(n - 1):
+        # every rank sends BEFORE it receives within a step: snapshot
+        # the outgoing chunks, then fold
+        outgoing = []
+        for r in range(n):
+            sc = (r - step) % n
+            lo, hi = _chunk_lo(count, n, sc), _chunk_lo(count, n, sc + 1)
+            outgoing.append((r, sc, bufs[r][lo:hi].copy()))
+        for r, sc, data in outgoing:
+            dst = (r + 1) % n
+            rc = (dst - step - 1) % n
+            assert rc == sc
+            lo, hi = _chunk_lo(count, n, sc), _chunk_lo(count, n, sc + 1)
+            bufs[dst][lo:hi] = (bufs[dst][lo:hi] + data).astype(np.float32)
+    out = np.empty_like(bufs[0])
+    for c in range(n):
+        # after n-1 steps rank r's chunk (r+1)%n holds the full
+        # reduction (the native comment's invariant), so chunk c is
+        # complete at rank (c-1)%n — the allgather copies those bytes
+        owner = (c - 1) % n
+        lo, hi = _chunk_lo(count, n, c), _chunk_lo(count, n, c + 1)
+        out[lo:hi] = bufs[owner][lo:hi]
+    return out
+
+
+def simulate_rd_sum(inputs: Sequence[np.ndarray]) -> np.ndarray:
+    """Recursive doubling's f32 SUM association (native
+    ``rd_allreduce``), including the non-power-of-two fold."""
+    n = len(inputs)
+    if n == 1:
+        return _f32(inputs[0]).copy()
+    bufs = [_f32(v).copy() for v in inputs]
+    pof2 = 1
+    while pof2 * 2 <= n:
+        pof2 *= 2
+    rem = n - pof2
+    participants = {}  # newrank -> rank
+    for r in range(n):
+        if r < 2 * rem:
+            if r % 2 == 1:
+                # odd member folds the even neighbor: acc_odd += even
+                bufs[r] = (bufs[r] + bufs[r - 1]).astype(np.float32)
+                participants[r // 2] = r
+        else:
+            participants[r - rem] = r
+    mask = 1
+    while mask < pof2:
+        snapshot = {nr: bufs[pr].copy() for nr, pr in participants.items()}
+        for nr, pr in participants.items():
+            bufs[pr] = (bufs[pr] + snapshot[nr ^ mask]).astype(np.float32)
+        mask <<= 1
+    for r in range(2 * rem):
+        if r % 2 == 0:
+            bufs[r] = bufs[r + 1].copy()
+    return bufs[0]
+
+
+def _island_sums(inputs: Sequence[np.ndarray],
+                 islands: Sequence[Sequence[int]]) -> List[np.ndarray]:
+    """Phase 1: sequential member-order f32 fold per island (the
+    association both native intra paths share)."""
+    sums = []
+    for members in islands:
+        acc = _f32(inputs[members[0]]).copy()
+        for m in members[1:]:
+            acc = (acc + _f32(inputs[m])).astype(np.float32)
+        sums.append(acc)
+    return sums
+
+
+def simulate_hring_sum(inputs: Sequence[np.ndarray],
+                       islands: Sequence[Sequence[int]]) -> np.ndarray:
+    """Bit-exact model of the native ``hring`` f32 SUM allreduce:
+    ``inputs`` is one array per world rank, ``islands`` the member-rank
+    lists in island order (``Topology.islands``).  Returns the result
+    every rank holds (phase 3 broadcasts the leader's bytes verbatim,
+    so all ranks are identical)."""
+    sums = _island_sums(inputs, islands)
+    return simulate_ring_sum(sums)
+
+
+def simulate_htree_sum(inputs: Sequence[np.ndarray],
+                       islands: Sequence[Sequence[int]]) -> np.ndarray:
+    """Bit-exact model of the native ``htree`` f32 SUM allreduce
+    (recursive-doubling leader leg)."""
+    sums = _island_sums(inputs, islands)
+    return simulate_rd_sum(sums)

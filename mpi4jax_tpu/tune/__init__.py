@@ -41,10 +41,23 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 # keep in sync with native/tpucomm.h (TpuCollAlgo / TpuCollOpKind)
 ALGO_CODES = {"auto": 0, "ring": 1, "rd": 2, "tree": 3, "shm": 4,
-              "qring": 5, "qrd": 6}
+              "qring": 5, "qrd": 6, "hring": 7, "htree": 8}
 ALGO_NAMES = {v: k for k, v in ALGO_CODES.items()}
 OPS = ("allreduce", "allgather")
 OP_KIND = {"allreduce": 0, "allgather": 1}
+
+#: hierarchical (topology-aware) schedules: intra-island reduce ->
+#: leader-tier allreduce (ring for hring, recursive doubling for
+#: htree; the one leg eligible for the quantized wire formats under
+#: MPI4JAX_TPU_COLL_QUANT=force) -> intra-island bcast.  Selected by
+#: the native engine only on comms with a discovered multi-island
+#: topology (mpi4jax_tpu/topo); anywhere else they silently degrade to
+#: their flat twins, and MPI4JAX_TPU_HIER (allow | deny | force) gates
+#: them process-wide.  Valid for allreduce AND allgather.
+HIER_ALGOS = frozenset(("hring", "htree"))
+#: the flat degrade twins (hring -> ring, htree -> tree) live in the
+#: NATIVE resolver only; ``WorldComm.coll_algo`` reports the resolved
+#: pick, so the Python side never re-derives them
 
 #: quantized wire-format algorithms (EQuARX-style int8 codes + f32
 #: absmax scales inside every collective frame) — allreduce only,
@@ -54,10 +67,16 @@ OP_KIND = {"allreduce": 0, "allgather": 1}
 QUANT_ALGOS = frozenset(("qring", "qrd"))
 #: exact counterpart a quantized algorithm degrades to, and the
 #: quantized twin an exact pick promotes to (tree's broadcast shape has
-#: no quantized schedule; its latency regime maps to qrd)
+#: no quantized schedule; its latency regime maps to qrd).  A
+#: hierarchical pick maps to the flat quantized twin of its leader leg:
+#: the compression="int8" route forces ONE native algorithm per call,
+#: and there is no whole-schedule quantized hierarchical code — the
+#: hierarchy's quantized inter-host leg rides COLL_QUANT=force instead
+#: (docs/usage.md § Transport tiers and topology).
 EXACT_TWIN = {"qring": "ring", "qrd": "rd"}
 QUANT_TWIN = {"ring": "qring", "rd": "qrd", "tree": "qrd",
-              "qring": "qring", "qrd": "qrd"}
+              "qring": "qring", "qrd": "qrd",
+              "hring": "qring", "htree": "qrd"}
 
 #: --from-trace promotion thresholds: an exact allreduce winner at or
 #: above this payload whose recorded wire share (dur - wait - dispatch)
@@ -81,6 +100,11 @@ def _usable_trace_event(ev):
     if (op not in OPS or ev.get("src") != "native"
             or ev.get("algo") not in TRACE_ALGOS):
         return None
+    if ev.get("tier"):
+        # a hierarchical collective's per-LEG event (intra reduce /
+        # leader allreduce): it times one leg, not the algorithm named
+        # in its label — only the whole-op record carries tuning signal
+        return None
     nbytes = int(ev.get("bytes", 0))
     dur_s = float(ev.get("dur_us", 0.0)) / 1e6
     if nbytes <= 0 or dur_s <= 0:
@@ -99,9 +123,22 @@ _DEFAULT_TABLE: Table = {
     "allgather": [(0, "ring")],
 }
 
+#: defaults on a comm with a discovered MULTI-ISLAND topology
+#: (install() flips to these): bandwidth-bound payloads take the
+#: hierarchical ring — only the leader leg crosses the slow inter-host
+#: tier — while small payloads keep the flat tree's log2(n) hops.  The
+#: allgather default stays flat ring (hring/htree are selectable rows;
+#: the sweep decides per deployment).  Cache/API/env still override.
+_HIER_DEFAULT_TABLE: Table = {
+    "allreduce": [(0, "tree"), (64 * 1024, "hring")],
+    "allgather": [(0, "ring")],
+}
+
 _overrides: Dict[str, Dict[int, str]] = {op: {} for op in OPS}
 _cache_table: Optional[Table] = None
 _cache_origin: Optional[str] = None  # path the cache table came from
+_topo_multi: bool = False            # install() saw a multi-island topology
+_cache_loaded_for = None             # (world_size, topo_fp) of _cache_table
 
 
 def _check_op(op: str) -> str:
@@ -117,7 +154,7 @@ def _check_algo(algo: str, op: Optional[str] = None) -> str:
     if name not in ALGO_CODES or name == "shm":
         raise ValueError(
             f"unknown collective algorithm {algo!r} "
-            "(expected auto, ring, rd, tree, qring, or qrd)"
+            "(expected auto, ring, rd, tree, qring, qrd, hring, or htree)"
         )
     if op == "allgather" and name in QUANT_ALGOS:
         raise ValueError(
@@ -127,14 +164,22 @@ def _check_algo(algo: str, op: Optional[str] = None) -> str:
     return name
 
 
-def cache_path(world_size: int) -> str:
+def cache_path(world_size: int,
+               topo_fingerprint: Optional[str] = None) -> str:
     """Path of the persistent autotune cache for a world size.
 
     ``MPI4JAX_TPU_TUNE_CACHE`` overrides the full path (tests, shared
     clusters); otherwise ``$XDG_CACHE_HOME``-aware
-    ``~/.cache/mpi4jax_tpu/tune_<size>.json``.  The file records the
-    world size it was measured at; loading it for a different size is
-    rejected (install() then warns and runs on defaults).
+    ``~/.cache/mpi4jax_tpu/tune_<size>[_<topohash>].json``.  The
+    topology fingerprint (``Topology.fingerprint()``: a hash of world
+    size, island sizes, and per-island tiers) keys the cache on the
+    SHAPE the sweep was measured on — a table tuned on one host layout
+    must not silently govern another (2x4 and 8x1 have different
+    winners).  ``install`` still falls back to the legacy un-keyed
+    ``tune_<size>.json`` when no topology-keyed file exists.  The file
+    records the world size it was measured at; loading it for a
+    different size is rejected (install() then warns and runs on
+    defaults).
     """
     forced = os.environ.get("MPI4JAX_TPU_TUNE_CACHE")
     if forced:
@@ -142,7 +187,9 @@ def cache_path(world_size: int) -> str:
     base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
         os.path.expanduser("~"), ".cache"
     )
-    return os.path.join(base, "mpi4jax_tpu", f"tune_{world_size}.json")
+    suffix = f"_{topo_fingerprint}" if topo_fingerprint else ""
+    return os.path.join(base, "mpi4jax_tpu",
+                        f"tune_{world_size}{suffix}.json")
 
 
 def _validate_table(raw) -> Table:
@@ -163,12 +210,18 @@ def _validate_table(raw) -> Table:
     return table
 
 
-def load_cache(world_size: int, path: Optional[str] = None) -> Table:
+def load_cache(world_size: int, path: Optional[str] = None,
+               topo_fingerprint: Optional[str] = None) -> Table:
     """Parse + validate a persistent cache file; raises ``ValueError`` on
     malformed content (a missing file raises ``FileNotFoundError``).
-    On success the table becomes the process's cache layer."""
+    On success the table becomes the process's cache layer.
+
+    ``topo_fingerprint`` keys the default path AND cross-checks a
+    topology-stamped file: a cache measured on one topology shape must
+    not govern another.  Legacy files without a topology stamp load for
+    any shape (the documented fallback)."""
     global _cache_table, _cache_origin
-    p = path or cache_path(world_size)
+    p = path or cache_path(world_size, topo_fingerprint)
     with open(p) as f:
         data = json.load(f)
     if not isinstance(data, dict) or "table" not in data:
@@ -185,6 +238,13 @@ def load_cache(world_size: int, path: Optional[str] = None) -> Table:
             f"tune cache {p} was measured at world size "
             f"{data.get('world_size')!r}, this job has {world_size}"
         )
+    stamped = data.get("topology")
+    if (stamped and topo_fingerprint and
+            str(stamped) != str(topo_fingerprint)):
+        raise ValueError(
+            f"tune cache {p} was measured on topology {stamped!r}, "
+            f"this job discovered {topo_fingerprint!r}"
+        )
     table = _validate_table(data["table"])
     _cache_table = table
     _cache_origin = p
@@ -192,9 +252,10 @@ def load_cache(world_size: int, path: Optional[str] = None) -> Table:
 
 
 def save_cache(world_size: int, table: Table, measurements=(),
-               path: Optional[str] = None, transport: str = "tcp") -> str:
+               path: Optional[str] = None, transport: str = "tcp",
+               topo_fingerprint: Optional[str] = None) -> str:
     """Atomically write the cache file; returns its path."""
-    p = path or cache_path(world_size)
+    p = path or cache_path(world_size, topo_fingerprint)
     table = _validate_table(table)
     os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
     payload = {
@@ -205,6 +266,8 @@ def save_cache(world_size: int, table: Table, measurements=(),
                   for op, entries in table.items()},
         "measurements": list(measurements),
     }
+    if topo_fingerprint:
+        payload["topology"] = str(topo_fingerprint)
     tmp = f"{p}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(payload, f, indent=1, sort_keys=True)
@@ -256,8 +319,12 @@ def clear_overrides() -> None:
 
 
 def decision_table() -> Table:
-    """The merged (defaults <- cache <- API overrides <- env) table."""
-    table: Table = {op: list(_DEFAULT_TABLE[op]) for op in OPS}
+    """The merged (defaults <- cache <- API overrides <- env) table.
+    The default layer is topology-aware: once ``install`` has seen a
+    multi-island topology, bandwidth-bound allreduces default to the
+    hierarchical ring (``_HIER_DEFAULT_TABLE``)."""
+    base = _HIER_DEFAULT_TABLE if _topo_multi else _DEFAULT_TABLE
+    table: Table = {op: list(base[op]) for op in OPS}
     if _cache_table:
         for op, entries in _cache_table.items():
             table[op] = list(entries)
@@ -318,7 +385,7 @@ def default_algorithm(op: str, nbytes: int) -> str:
 
 def sources() -> List[str]:
     """Which layers contribute to the current decision table."""
-    out = ["defaults"]
+    out = ["defaults:topology" if _topo_multi else "defaults"]
     if _cache_table is not None:
         out.append(f"cache:{_cache_origin}")
     if any(_overrides[op] for op in OPS):
@@ -510,21 +577,54 @@ def cache_from_trace(paths: Sequence[str], world_size: Optional[int] = None,
                       transport="tcp:from-trace")
 
 
-def install(world_size: Optional[int] = None) -> bool:
+def install(world_size: Optional[int] = None, topology=None) -> bool:
     """Load the persistent cache (if present) and push the merged
     decision table into the native layer.  Called by
     ``runtime.bridge.comm_init`` at communicator creation; safe to call
     again after overrides.  Returns True when the native table was
-    pushed (False: native lib unavailable or too old)."""
-    if world_size is not None and _cache_table is None:
-        try:
-            load_cache(world_size)
-        except FileNotFoundError:
-            pass
-        except ValueError as e:
-            import warnings
+    pushed (False: native lib unavailable or too old).
 
-            warnings.warn(f"ignoring unusable tune cache: {e}")
+    ``topology`` (a ``topo.Topology``, when discovery ran) does two
+    things: a multi-island map flips the default layer to the
+    hierarchical table, and its fingerprint keys the cache lookup —
+    ``tune_<size>_<topohash>.json`` first, the legacy un-keyed
+    ``tune_<size>.json`` as a fallback."""
+    global _topo_multi, _cache_table, _cache_origin, _cache_loaded_for
+    topo_fp = None
+    if topology is not None:
+        _topo_multi = bool(getattr(topology, "multi", False))
+        if _topo_multi:
+            topo_fp = topology.fingerprint()
+    if world_size is not None:
+        want = (int(world_size), topo_fp)
+        if _cache_loaded_for is not None and _cache_loaded_for != want:
+            # an elastic rebuild changed the world shape: the in-memory
+            # cache belongs to the old one — drop it and reload below
+            _cache_table = None
+            _cache_origin = None
+            _cache_loaded_for = None
+        if _cache_table is None:
+            candidates = []
+            if topo_fp:
+                candidates.append((cache_path(world_size, topo_fp),
+                                   topo_fp))
+            legacy = cache_path(world_size)
+            if not candidates or candidates[0][0] != legacy:
+                candidates.append((legacy, None))
+            for path, fp in candidates:
+                try:
+                    load_cache(world_size, path=path, topo_fingerprint=fp)
+                    _cache_loaded_for = want
+                    break
+                except FileNotFoundError:
+                    continue
+                except ValueError as e:
+                    import warnings
+
+                    warnings.warn(f"ignoring unusable tune cache: {e}")
+                    break
+            else:
+                _cache_loaded_for = want  # nothing on disk for this shape
     return _push_native()
 
 

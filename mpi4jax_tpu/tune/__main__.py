@@ -139,7 +139,6 @@ def _from_trace(args) -> int:
 def _driver(args) -> int:
     """Re-exec under the launcher, then report the written cache."""
     np_ = args.np_ or 4
-    cache = args.cache or tune.cache_path(np_)
     cmd = [sys.executable, "-m", "mpi4jax_tpu.runtime.launch",
            "-n", str(np_)]
     if args.port:
@@ -150,17 +149,26 @@ def _driver(args) -> int:
                       ("--ops", args.ops)):
         if val:
             cmd += [flag, str(val)]
-    cmd += ["--cache", cache]
+    # only forward an EXPLICIT cache path: the default path may be
+    # topology-keyed (tune_<size>_<topohash>.json), and only the ranks
+    # know the discovered fingerprint — rank 0 prints where it wrote
+    if args.cache:
+        cmd += ["--cache", args.cache]
+    cache = args.cache
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # tune the TCP path: the arena would hide every algorithm behind the
-    # same-host fast path (the selector governs TCP/multi-host)
-    env["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    # same-host fast path (the selector governs TCP/multi-host).  Under
+    # a MPI4JAX_TPU_FAKE_HOSTS partition the WORLD arena is already
+    # withheld by the virtual host split, and the intra-island arenas
+    # are part of what the hierarchical rows measure — leave shm alone.
+    if not os.environ.get("MPI4JAX_TPU_FAKE_HOSTS", "").strip():
+        env["MPI4JAX_TPU_DISABLE_SHM"] = "1"
     # a forced algorithm would make every sweep point measure one
     # schedule — the sweep must be free to force its own
     env.pop("MPI4JAX_TPU_COLL_ALGO", None)
     rc = subprocess.run(cmd, env=env).returncode
-    if rc == 0:
+    if rc == 0 and cache:
         print(f"tune: cache written to {cache}")
     return rc
 
@@ -221,6 +229,19 @@ def _rank(args) -> int:
     sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
              else DEFAULT_SIZES)
     ops = [tune._check_op(o.strip()) for o in args.ops.split(",") if o.strip()]
+    # hierarchical rows: only a comm with a discovered multi-island
+    # topology runs them for real (anywhere else they degrade to their
+    # flat twins and the sweep would time ring/tree twice under
+    # different labels — noise dressed up as measurements)
+    from mpi4jax_tpu import topo as _topo
+
+    topology = _topo.get_topology(comm.handle)
+    hier_ok = (topology is not None and topology.multi
+               and hasattr(bridge.get_lib(), "tpucomm_set_topology"))
+    from mpi4jax_tpu.utils.config import hier_mode, quant_mode
+
+    if hier_mode() == "deny":
+        hier_ok = False
     measurements = []
     best = {op: {} for op in ops}
     for op in ops:
@@ -228,8 +249,9 @@ def _rank(args) -> int:
             repeats = args.repeats or max(3, min(30, int(3e6 / max(nbytes, 1))))
             per_algo = {}
             cands = CANDIDATES[op]
-            from mpi4jax_tpu.utils.config import quant_mode
-
+            if hier_ok:
+                cands = cands + tuple(a for a in ("hring", "htree")
+                                      if a not in cands)
             if quant_mode() == "deny":
                 cands = tuple(a for a in cands
                               if a not in tune.QUANT_ALGOS)
@@ -250,7 +272,13 @@ def _rank(args) -> int:
 
     if comm.rank() == 0:
         table = {op: tune.entries_from_measurements(best[op]) for op in ops}
-        path = tune.save_cache(n, table, measurements, path=args.cache)
+        # a multi-island sweep's winners are only valid on that shape:
+        # stamp + key the cache on the topology fingerprint (flat
+        # sweeps keep the legacy un-keyed name)
+        topo_fp = (topology.fingerprint()
+                   if topology is not None and topology.multi else None)
+        path = tune.save_cache(n, table, measurements, path=args.cache,
+                               topo_fingerprint=topo_fp)
         print(f"tune: wrote {path}", flush=True)
     bridge.barrier(comm.handle)  # cache is on disk before any rank exits
     return 0

@@ -148,7 +148,7 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 arms a collective clock-alignment
                                 handshake at communicator creation.
 - ``MPI4JAX_TPU_TRACE_BUF_KB`` — event-ring size in KB (default 256;
-                                64-byte slots, so 4096 events), for
+                                72-byte slots, so 3640 events), for
                                 both the native transport ring and the
                                 Python span ring.  Overflow keeps the
                                 newest events and counts exactly how
@@ -262,6 +262,48 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 save/restore helpers and the elastic
                                 training loop (unset = the caller must
                                 pass a directory explicitly).
+- ``MPI4JAX_TPU_TOPO``        — topology discovery at communicator
+                                creation (``mpi4jax_tpu/topo``,
+                                docs/usage.md § Transport tiers and
+                                topology): ``auto`` (default) runs the
+                                bootstrap fingerprint allgather, derives
+                                the intra-island / leader
+                                sub-communicators on multi-island
+                                worlds, and installs the map natively;
+                                ``off`` skips discovery entirely (flat
+                                transport, the pre-topology behavior).
+                                Must agree across ranks (the handshake
+                                is collective).
+- ``MPI4JAX_TPU_FAKE_HOSTS``  — virtual host partition for topology
+                                testing on one machine
+                                (``r0,r1|r2,r3``: groups of world ranks
+                                separated by ``|``): ranks in one group
+                                share a (virtual) host — they get an
+                                intra-island shm arena — while ranks in
+                                different groups are treated as
+                                host-separated even over loopback (the
+                                world arena is withheld).  Read
+                                natively at bootstrap AND by the Python
+                                discovery; indexes CURRENT world ranks
+                                (an elastic rebuild re-applies it to
+                                the dense new ranks; out-of-range
+                                ranks are ignored).  Malformed specs
+                                abort loudly.  Must agree across ranks.
+- ``MPI4JAX_TPU_HIER``        — gate over the hierarchical collective
+                                schedules (``hring``/``htree`` and the
+                                hierarchical bcast/reduce routing; read
+                                natively): ``allow`` (default) lets the
+                                decision table / env / API select them
+                                on a multi-island comm (bcast/reduce
+                                route hierarchically at >= 64 KiB);
+                                ``deny`` degrades every hierarchical
+                                pick to its flat twin (ring/tree) — a
+                                routing kill-switch; ``force`` upgrades
+                                every eligible allreduce/allgather to a
+                                hierarchical twin and routes
+                                bcast/reduce hierarchically at any
+                                size.  Must agree across ranks (the
+                                schedules exchange different frames).
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels
@@ -320,6 +362,9 @@ KNOBS = {
     "MPI4JAX_TPU_PLAN_BUCKET_KB": "gradient allreduce bucket ceiling (KB)",
     "MPI4JAX_TPU_QUEUE_DEPTH": "progress-engine submission-queue depth",
     "MPI4JAX_TPU_PALLAS_COLLECTIVES": "route mesh collectives via Pallas",
+    "MPI4JAX_TPU_TOPO": "topology discovery at comm creation: auto/off",
+    "MPI4JAX_TPU_FAKE_HOSTS": "virtual host partition for topology tests",
+    "MPI4JAX_TPU_HIER": "hierarchical schedules: allow/deny/force",
     "MPI4JAX_TPU_ELASTIC": "elastic worlds: RankFailure + recovery",
     "MPI4JAX_TPU_ELASTIC_DIR": "launcher<->rank generation announcements",
     "MPI4JAX_TPU_ELASTIC_POLICY": "dead-rank policy: shrink / respawn",
@@ -374,6 +419,45 @@ def quant_mode() -> str:
     raise ValueError(
         f"cannot parse MPI4JAX_TPU_COLL_QUANT={raw!r} "
         "(expected allow, deny, or force)")
+
+
+def topo_mode() -> str:
+    """``MPI4JAX_TPU_TOPO`` as "auto" | "off" (strict like quant_mode:
+    a typo'd mode must not silently skip — or run — the collective
+    discovery handshake on a subset of ranks)."""
+    raw = os.environ.get("MPI4JAX_TPU_TOPO")
+    if raw is None or not raw.strip():
+        return "auto"
+    v = raw.strip()
+    if v in ("auto", "off"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_TOPO={raw!r} (expected auto or off)")
+
+
+def hier_mode() -> str:
+    """``MPI4JAX_TPU_HIER`` as "allow" | "deny" | "force" — the Python
+    mirror of the native gate over the hierarchical schedules, matching
+    its parser byte-for-byte (the native layer exits loudly on anything
+    else, so this must never quietly read the same value as allow)."""
+    raw = os.environ.get("MPI4JAX_TPU_HIER")
+    if raw is None:
+        return "allow"
+    v = raw.strip()
+    if not v:
+        return "allow"
+    if v in ("allow", "deny", "force"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_HIER={raw!r} "
+        "(expected allow, deny, or force)")
+
+
+def fake_hosts_spec():
+    """The raw MPI4JAX_TPU_FAKE_HOSTS spec, or None (parsed by
+    ``topo.parse_fake_hosts`` and, independently, natively)."""
+    raw = os.environ.get("MPI4JAX_TPU_FAKE_HOSTS")
+    return raw if raw and raw.strip() else None
 
 
 def debug_enabled() -> bool:

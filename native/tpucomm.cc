@@ -170,6 +170,25 @@ struct PendingMsg {
 };
 
 struct Comm;
+
+/* Locality map installed by tpucomm_set_topology (mpi4jax_tpu/topo is
+ * the discovering owner): which member ranks share an island (a host /
+ * shm domain), each island's leader (its lowest member rank), and the
+ * intra-island + leaders sub-communicators the hierarchical collective
+ * schedules compose over.  The sub-comms are tpucomm_split children of
+ * this comm (they borrow its sockets); the Python bridge creates them
+ * and tears them down before the world. */
+struct TopoInfo {
+  std::vector<int32_t> island_of;             // member rank -> island id
+  std::vector<int32_t> leaders;               // island id -> leader rank
+  std::vector<std::vector<int32_t>> members;  // island id -> sorted ranks
+  Comm* intra = nullptr;   // my island's sub-comm (null: singleton island)
+  Comm* leader = nullptr;  // leaders' sub-comm (null: not a leader)
+  int n_islands = 0;
+  int my_island = -1;
+  int my_leader = -1;      // member rank of my island's leader
+};
+
 /* shm p2p rings (defined in the arena section below) */
 bool ring_p2p_on(const Comm* c);
 int shm_try_send(Comm* c, int dest, int tag, const void* buf,
@@ -196,6 +215,14 @@ struct Comm {
    * running inline, or the progress thread — never both at once). */
   std::map<int, std::deque<PendingMsg>> pending;
   int32_t comm_id = 0;     // deterministic across ranks (world = 0)
+  /* effective host of every member — the real host table with the
+   * MPI4JAX_TPU_FAKE_HOSTS virtual partition applied; arena eligibility
+   * (bootstrap AND split subsets) is decided on THIS view, so a
+   * virtually partitioned loopback job behaves like the multi-host
+   * shape it models.  Inherited (subsetted) by split/dup children. */
+  std::vector<std::string> member_hosts;
+  /* discovered locality map (tpucomm_set_topology); null = flat */
+  TopoInfo* topo = nullptr;
   bool owns_socks = true;  // split/dup comms borrow the parent's sockets
   int32_t next_split_seq = 1;  // collective-call counter, agrees rank-wide
   Comm* lock_root = this;  // sub-comms serialize on the socket owner's mu:
@@ -232,6 +259,7 @@ struct Comm {
       writer.join();
     }
     if (arena) arena_destroy(arena);
+    delete topo;
   }
 };
 
@@ -331,6 +359,9 @@ struct ObsScope {
   /* quantized collectives: the payload's on-wire representation is the
    * packed codec size, not the logical bytes */
   void set_wire(int64_t wb) { ev.wire_bytes = wb; }
+  /* hierarchical collectives: label a per-leg event with its transport
+   * tier (intra-island vs inter-island) so stats split the bytes */
+  void set_tier(int tier) { ev.tier = tier; }
   ~ObsScope() {
     if (!on) return;
     double t1 = now_s();
@@ -407,6 +438,67 @@ double connect_timeout_s() {
     return t > 0 ? t : 0.0;
   }();
   return v;
+}
+
+/* MPI4JAX_TPU_FAKE_HOSTS=r0,r1|r2,r3 — virtual host partition for
+ * topology testing on one machine: ranks in one '|'-separated group are
+ * treated as sharing a (virtual) host for arena eligibility, ranks in
+ * different groups as host-separated even over loopback.  Tokens are
+ * `rN` or bare `N`, indexing CURRENT world ranks (an elastic rebuild
+ * re-applies the spec against the dense new ranks).  Ranks not listed
+ * keep their real host.  Malformed specs exit loudly (same contract as
+ * MPI4JAX_TPU_FAULT: a typo'd partition must not silently test the
+ * wrong shape).  Out-of-range ranks are ignored (a spec written for
+ * np=4 stays valid on a shrunk np=2 world). */
+void apply_fake_hosts(std::vector<std::string>& hosts, int size) {
+  const char* e = std::getenv("MPI4JAX_TPU_FAKE_HOSTS");
+  if (!e || !e[0]) return;
+  std::vector<int> seen(hosts.size(), 0);
+  int group = 0;
+  const char* p = e;
+  std::string tok;
+  auto flush_tok = [&]() {
+    /* trim whitespace */
+    size_t b = tok.find_first_not_of(" \t");
+    if (b == std::string::npos) {
+      tok.clear();
+      return;
+    }
+    tok = tok.substr(b, tok.find_last_not_of(" \t") - b + 1);
+    const char* t = tok.c_str();
+    if (*t == 'r' || *t == 'R') t++;
+    char* end = nullptr;
+    long r = std::strtol(t, &end, 10);
+    /* digits only ('+5' / ' 5' inside a token would diverge from the
+     * Python mirror, which accepts bare digits) */
+    if (end == t || *end || r < 0 || !(*t >= '0' && *t <= '9')) {
+      std::fprintf(stderr,
+                   "tpucomm: cannot parse MPI4JAX_TPU_FAKE_HOSTS token "
+                   "%s (expected rN or N, groups separated by |)\n",
+                   tok.c_str());
+      std::exit(2);
+    }
+    if (r < size) {
+      if (seen[(size_t)r]) {
+        std::fprintf(stderr,
+                     "tpucomm: MPI4JAX_TPU_FAKE_HOSTS lists rank %ld "
+                     "twice\n", r);
+        std::exit(2);
+      }
+      seen[(size_t)r] = 1;
+      hosts[(size_t)r] = "fake-host-" + std::to_string(group);
+    }
+    tok.clear();
+  };
+  for (;; p++) {
+    if (*p == ',' || *p == '|' || *p == '\0') {
+      flush_tok();
+      if (*p == '|') group++;
+      if (*p == '\0') break;
+    } else {
+      tok.push_back(*p);
+    }
+  }
 }
 
 /* progress detail for the caller's diagnostic when a deadline fires */
@@ -2296,8 +2388,56 @@ const char* coll_algo_name(int algo) {
     case TPU_COLL_SHM: return "shm";
     case TPU_COLL_QRING: return "qring";
     case TPU_COLL_QRD: return "qrd";
+    case TPU_COLL_HRING: return "hring";
+    case TPU_COLL_HTREE: return "htree";
     default: return "auto";
   }
+}
+
+/* MPI4JAX_TPU_HIER: process-wide gate over the hierarchical schedules.
+ * allow (default) = table/env/API selection may pick hring/htree (and
+ * large bcast/reduce route hierarchically) on a multi-island comm;
+ * deny = every hierarchical pick degrades to its flat twin (a routing
+ * kill-switch; frames still match because the degradation keys on the
+ * installed topology, which agrees across ranks); force = every
+ * eligible allreduce/allgather upgrades to a hierarchical twin and
+ * bcast/reduce route hierarchically at any size.  Must agree across
+ * ranks (like COLL_ALGO/COLL_QUANT: the schedules exchange different
+ * frames). */
+enum { HIER_ALLOW = 0, HIER_DENY = 1, HIER_FORCE = 2 };
+
+int hier_mode() {
+  static int v = [] {
+    const char* e = std::getenv("MPI4JAX_TPU_HIER");
+    if (!e) return HIER_ALLOW;
+    std::string s(e);
+    const size_t b = s.find_first_not_of(" \t\r\n");
+    if (b == std::string::npos) return HIER_ALLOW;
+    s = s.substr(b, s.find_last_not_of(" \t\r\n") - b + 1);
+    if (s == "allow") return HIER_ALLOW;
+    if (s == "deny") return HIER_DENY;
+    if (s == "force") return HIER_FORCE;
+    std::fprintf(stderr,
+                 "tpucomm: cannot parse MPI4JAX_TPU_HIER=%s "
+                 "(expected allow, deny, or force)\n", e);
+    std::exit(2);  // a typo'd gate must not silently change routing
+  }();
+  return v;
+}
+
+/* bcast/reduce route hierarchically above this payload under
+ * hier=allow (below it the flat binomial tree's log2(n) hops win on
+ * latency); force removes the floor, deny the routing */
+constexpr int64_t kHierMinBytes = 64 * 1024;
+
+/* hierarchical schedules need a discovered multi-island topology */
+bool hier_eligible(const Comm* c) {
+  return c->topo != nullptr && c->topo->n_islands > 1;
+}
+
+bool hier_routable(const Comm* c, int64_t nbytes) {
+  if (!hier_eligible(c) || hier_mode() == HIER_DENY) return false;
+  return hier_mode() == HIER_FORCE || nbytes >= kHierMinBytes;
 }
 
 /* quantized wire formats (codec + schedules defined below) */
@@ -2357,6 +2497,30 @@ int resolve_coll_algo(Comm* c, int op_kind, int64_t nbytes, int64_t count,
     else
       algo = TPU_COLL_RING;
   }
+  /* hierarchical eligibility: needs a discovered multi-island topology
+   * on this comm.  A hierarchical pick on a flat comm (or under
+   * MPI4JAX_TPU_HIER=deny) degrades to its flat twin; =force upgrades
+   * every eligible flat pick.  The topology agrees across ranks (every
+   * member installed the same map), so the degradation is consistent
+   * and the schedules still match.  BEFORE the quant block: the
+   * quantized wire format applies to a hierarchical schedule's
+   * inter-island LEG (inside hier_allreduce), never to the whole-op
+   * code. */
+  {
+    const bool h_ok = hier_eligible(c);
+    if (algo == TPU_COLL_HRING || algo == TPU_COLL_HTREE) {
+      if (!h_ok || hier_mode() == HIER_DENY)
+        algo = algo == TPU_COLL_HRING ? TPU_COLL_RING : TPU_COLL_TREE;
+    } else if (hier_mode() == HIER_FORCE && h_ok &&
+               algo != TPU_COLL_SHM && algo != TPU_COLL_QRING &&
+               algo != TPU_COLL_QRD) {
+      /* an explicitly selected quantized wire format is NOT upgraded:
+       * the hierarchical leader leg only re-quantizes under
+       * COLL_QUANT=force, so rewriting qring -> hring here would
+       * silently move ~4x the bytes on the slow tier */
+      algo = algo == TPU_COLL_RING ? TPU_COLL_HRING : TPU_COLL_HTREE;
+    }
+  }
   /* quantized eligibility: allreduce, real floating dtype, SUM.  An
    * ineligible (dtype, op) or the deny gate degrades the quantized
    * code to its exact counterpart — dtype agrees across ranks, so the
@@ -2370,7 +2534,8 @@ int resolve_coll_algo(Comm* c, int op_kind, int64_t nbytes, int64_t count,
     if (algo == TPU_COLL_QRING || algo == TPU_COLL_QRD) {
       if (!q_ok || quant_mode() == QUANT_DENY)
         algo = algo == TPU_COLL_QRING ? TPU_COLL_RING : TPU_COLL_RD;
-    } else if (quant_mode() == QUANT_FORCE && q_ok) {
+    } else if (quant_mode() == QUANT_FORCE && q_ok &&
+               algo != TPU_COLL_HRING && algo != TPU_COLL_HTREE) {
       algo = algo == TPU_COLL_RING ? TPU_COLL_QRING : TPU_COLL_QRD;
     }
   }
@@ -3228,6 +3393,321 @@ int qrd_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype, int op) {
   return 0;
 }
 
+/* ============ hierarchical (topology-aware) schedules ============
+ *
+ * hring / htree compose the flat kernels above over the sub-groups a
+ * discovered topology provides (tpucomm_set_topology): an intra-island
+ * reduce to the island leader (the shm arena when the island shares a
+ * host, a serial member-order reduce over TCP otherwise), a
+ * leader-tier allreduce across the slow inter-island links (ring for
+ * hring, recursive doubling for htree; upgraded to the qring/qrd
+ * quantized twin on that leg only under MPI4JAX_TPU_COLL_QUANT=force),
+ * and an intra-island bcast of the result.  At np8 split 2x4 the flat
+ * ring crosses the inter-host boundary on every hop; here only the
+ * leader leg does — 2*(L-1)/L of the payload per LEADER instead of
+ * 2*(n-1)/n per RANK on the slow tier.
+ *
+ * Determinism: both intra reduce paths combine in island member order
+ * (the serial TCP reduce mirrors vertical_reduce's source order), so
+ * shm-on and shm-off runs produce identical bits and ONE numpy
+ * schedule simulator (topo.simulate_hring_sum) models both.  Every
+ * rank of an island receives the leader's bytes verbatim in phase 3,
+ * so ranks are always bit-consistent.
+ *
+ * Every leg additionally records one observability event labeled with
+ * its transport tier (TPU_TIER_INTRA / TPU_TIER_INTER) inside the
+ * whole-op record, so obs.stats() splits intra- from inter-island
+ * bytes. */
+
+/* Serial reduce to sub-comm rank 0 in member order: root starts from
+ * its own buffer and folds rank 1, 2, ... sequentially — the same
+ * association as the shm arena's vertical_reduce, which is what makes
+ * the two intra paths bit-identical.  Islands are host-sized (a few
+ * ranks), so the serial fan-in is not the bottleneck leg. */
+int serial_reduce0(Comm* c, void* buf, int64_t count, int dtype, int op) {
+  const int64_t nbytes = count * dtype_size(dtype);
+  if (c->rank == 0) {
+    std::vector<char> tmp((size_t)std::min<int64_t>(nbytes,
+                                                    kCombineBlockBytes));
+    for (int r = 1; r < c->size; r++)
+      if (recv_combine_msg(c, r, static_cast<char*>(buf), tmp, count,
+                           dtype, op))
+        return 1;
+    return 0;
+  }
+  return send_msg(c, 0, kCollectiveTag, buf, nbytes);
+}
+
+/* The leader-tier leg of a hierarchical allreduce: `leg` is ring or
+ * rd, upgraded to its quantized twin when the force gate and the
+ * (dtype, op) eligibility allow.  Returns the algorithm that ran via
+ * *ran (for tracing). */
+int leader_allreduce_leg(Comm* lead, void* buf, int64_t count, int dtype,
+                         int op, int leg, int* ran) {
+  const bool q_ok = quant_dtype_ok(dtype) && op == TPU_SUM;
+  if (quant_mode() == QUANT_FORCE && q_ok)
+    leg = leg == TPU_COLL_RING ? TPU_COLL_QRING : TPU_COLL_QRD;
+  *ran = leg;
+  switch (leg) {
+    case TPU_COLL_QRING: return qring_allreduce(lead, buf, count, dtype, op);
+    case TPU_COLL_QRD: return qrd_allreduce(lead, buf, count, dtype, op);
+    case TPU_COLL_RD: return rd_allreduce(lead, buf, count, dtype, op);
+    default: return ring_allreduce(lead, buf, count, dtype, op);
+  }
+}
+
+int intra_bcast(Comm* intra, void* buf, int64_t nbytes, int root) {
+  if (intra->arena) return shm_bcast(intra, buf, nbytes, root);
+  return bcast_internal(intra, buf, nbytes, root);
+}
+
+/* Ring-aware point-to-point send for the hierarchical hops: a comm
+ * with shm p2p rings delivers user messages through them (recv_msg
+ * waits on the ring), so a bare send_msg_tcp would never match —
+ * mirror the engine's SEND routing. */
+int p2p_send(Comm* c, int dest, int tag, const void* buf, int64_t nbytes) {
+  if (ring_p2p_on(c) && dest != c->rank && dest >= 0 && dest < c->size) {
+    bool inlined = false;
+    if (shm_try_send(c, dest, tag, buf, nbytes, &inlined)) return 1;
+    if (inlined) return 0;
+    return send_msg_tcp(c, dest, tag, buf, nbytes);
+  }
+  return send_msg(c, dest, tag, buf, nbytes);
+}
+
+/* Hierarchical allreduce (TPU_COLL_HRING / HTREE): recvbuf already
+ * holds this rank's contribution (the dispatch site memcpy'd sendbuf
+ * in, like every flat algorithm). */
+int hier_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype,
+                   int op, int leg_algo) {
+  TopoInfo* t = c->topo;
+  Comm* intra = t->intra;
+  Comm* lead = t->leader;
+  const int64_t nbytes = count * dtype_size(dtype);
+  /* phase 1: intra-island reduce to the island leader (intra rank 0 —
+   * split keyed on rank, leader = lowest member) */
+  if (intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_REDUCE, t->my_leader, 0, nbytes,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    int rc = intra->arena
+                 ? shm_allreduce_like(intra, recvbuf, recvbuf, count,
+                                      dtype, op, 0, false)
+                 : serial_reduce0(intra, recvbuf, count, dtype, op);
+    if (rc) return 1;
+  }
+  /* phase 2: leaders allreduce the island sums over the slow tier */
+  if (lead && lead->size > 1) {
+    int ran = leg_algo;
+    ObsScope obs(TPU_OBS_ALLREDUCE, -1, 0, nbytes, leg_algo);
+    obs.set_tier(TPU_TIER_INTER);
+    int rc = leader_allreduce_leg(lead, recvbuf, count, dtype, op,
+                                  leg_algo, &ran);
+    obs.set_algo(ran);
+    if (ran == TPU_COLL_QRING || ran == TPU_COLL_QRD)
+      obs.set_wire(quant_packed_bytes(count));
+    if (rc) return 1;
+  }
+  /* phase 3: the leader broadcasts the result within its island */
+  if (intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_BCAST, t->my_leader, 0, nbytes,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    if (intra_bcast(intra, recvbuf, nbytes, 0)) return 1;
+  }
+  return 0;
+}
+
+/* Hierarchical allgather: intra gather to the leader (member order),
+ * leader-tier ring allgatherv of the variable-size island blocks
+ * (uneven islands are first-class: block sizes come from the member
+ * map), intra bcast of the assembled payload, then a local scatter
+ * from island-block order into world-rank order. */
+int hier_allgather(Comm* c, const void* sendbuf, int64_t nbytes,
+                   void* recvbuf) {
+  TopoInfo* t = c->topo;
+  Comm* intra = t->intra;
+  Comm* lead = t->leader;
+  const int L = t->n_islands;
+  char* out = static_cast<char*>(recvbuf);
+  /* island-block staging: island i's members are contiguous at ioff[i] */
+  std::vector<int64_t> ioff((size_t)L + 1, 0);
+  for (int i = 0; i < L; i++)
+    ioff[(size_t)i + 1] =
+        ioff[(size_t)i] + (int64_t)t->members[(size_t)i].size() * nbytes;
+  std::vector<char> stage((size_t)ioff[(size_t)L]);
+  char* myblock = stage.data() + ioff[(size_t)t->my_island];
+  /* phase 1: intra gather to the leader, member order */
+  if (intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_GATHER, t->my_leader, 0, nbytes,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    if (intra->arena) {
+      if (shm_allgather(intra, sendbuf, nbytes, myblock, 0, false))
+        return 1;
+    } else if (intra->rank == 0) {
+      std::memcpy(myblock, sendbuf, (size_t)nbytes);
+      for (int r = 1; r < intra->size; r++)
+        if (recv_msg(intra, r, kCollectiveTag,
+                     myblock + (int64_t)r * nbytes, nbytes))
+          return 1;
+    } else {
+      if (send_msg(intra, 0, kCollectiveTag, sendbuf, nbytes)) return 1;
+    }
+  } else {
+    std::memcpy(myblock, sendbuf, (size_t)nbytes);
+  }
+  /* phase 2: leader ring allgatherv of the island blocks (the ring
+   * allgather schedule with per-island block sizes) */
+  if (lead && lead->size > 1) {
+    ObsScope obs(TPU_OBS_ALLGATHER, -1, 0,
+                 ioff[(size_t)L] - (ioff[(size_t)t->my_island + 1] -
+                                    ioff[(size_t)t->my_island]),
+                 TPU_COLL_RING);
+    obs.set_tier(TPU_TIER_INTER);
+    const int lr = lead->rank;  // == island id (leaders sorted by rank)
+    const int next = (lr + 1) % L, prev = (lr - 1 + L) % L;
+    for (int round = 0; round < L - 1; round++) {
+      int sb = (lr - round + L) % L;
+      int rb = (lr - round - 1 + L) % L;
+      SendJob job;
+      if (async_send(lead, &job, next, kCollectiveTag,
+                     stage.data() + ioff[(size_t)sb],
+                     ioff[(size_t)sb + 1] - ioff[(size_t)sb]))
+        return 1;
+      int rc = recv_msg(lead, prev, kCollectiveTag,
+                        stage.data() + ioff[(size_t)rb],
+                        ioff[(size_t)rb + 1] - ioff[(size_t)rb]);
+      if (wait_send(lead, &job) || rc) return 1;
+    }
+  }
+  /* phase 3: the leader broadcasts the whole assembled payload */
+  if (intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_BCAST, t->my_leader, 0, ioff[(size_t)L],
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    if (intra_bcast(intra, stage.data(), ioff[(size_t)L], 0)) return 1;
+  }
+  /* island-block order -> world-rank order (islands need not be
+   * contiguous rank ranges: FAKE_HOSTS partitions are arbitrary) */
+  for (int i = 0; i < L; i++)
+    for (size_t m = 0; m < t->members[(size_t)i].size(); m++)
+      std::memcpy(out + (int64_t)t->members[(size_t)i][m] * nbytes,
+                  stage.data() + ioff[(size_t)i] + (int64_t)m * nbytes,
+                  (size_t)nbytes);
+  return 0;
+}
+
+/* Hierarchical bcast: root's island first (so its leader holds the
+ * payload), then the leader tier, then the remaining islands. */
+int hier_bcast(Comm* c, void* buf, int64_t nbytes, int root) {
+  TopoInfo* t = c->topo;
+  Comm* intra = t->intra;
+  Comm* lead = t->leader;
+  const int ri = t->island_of[(size_t)root];
+  if (t->my_island == ri && intra && intra->size > 1) {
+    const auto& mem = t->members[(size_t)ri];
+    int rloc = 0;
+    for (size_t m = 0; m < mem.size(); m++)
+      if (mem[m] == root) rloc = (int)m;
+    ObsScope obs(TPU_OBS_BCAST, root, 0, nbytes,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    if (intra_bcast(intra, buf, nbytes, rloc)) return 1;
+  }
+  if (lead && lead->size > 1) {
+    ObsScope obs(TPU_OBS_BCAST, ri, 0, nbytes, TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTER);
+    if (bcast_internal(lead, buf, nbytes, ri)) return 1;
+  }
+  if (t->my_island != ri && intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_BCAST, t->my_leader, 0, nbytes,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    if (intra_bcast(intra, buf, nbytes, 0)) return 1;
+  }
+  return 0;
+}
+
+/* Hierarchical reduce: intra reduce to the leaders, leader-tier serial
+ * reduce to the root island's leader, then a final intra hop to the
+ * root when it is not its island's leader.  The flat contract is
+ * preserved: only the root's recvbuf holds the reduction; every other
+ * rank's recvbuf keeps its input copy (leaders fold into a scratch
+ * accumulator, never into the caller's buffer). */
+int hier_reduce(Comm* c, const void* sendbuf, void* recvbuf, int64_t count,
+                int dtype, int op, int root) {
+  TopoInfo* t = c->topo;
+  Comm* intra = t->intra;
+  Comm* lead = t->leader;
+  const int64_t nbytes = count * dtype_size(dtype);
+  const int ri = t->island_of[(size_t)root];
+  const bool am_leader = t->my_leader == c->rank;
+  /* leaders accumulate island (then global) sums in scratch */
+  std::vector<char> acc;
+  if (am_leader) {
+    acc.resize((size_t)nbytes);
+    std::memcpy(acc.data(), sendbuf, (size_t)nbytes);
+  }
+  if (recvbuf != sendbuf) std::memcpy(recvbuf, sendbuf, (size_t)nbytes);
+  /* phase 1: intra reduce to the leader (member-order association) */
+  if (intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_REDUCE, t->my_leader, 0, nbytes,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    int rc;
+    if (intra->arena) {
+      rc = shm_allreduce_like(intra, sendbuf, am_leader ? acc.data()
+                                                        : recvbuf,
+                              count, dtype, op, 0, false);
+    } else if (am_leader) {
+      rc = serial_reduce0(intra, acc.data(), count, dtype, op);
+    } else {
+      rc = send_msg(intra, 0, kCollectiveTag, sendbuf, nbytes);
+    }
+    if (rc) return 1;
+  }
+  /* phase 2: leaders reduce to the root island's leader (leader-rank
+   * order, root island's own sum first) */
+  if (lead && lead->size > 1) {
+    ObsScope obs(TPU_OBS_REDUCE, ri, 0, nbytes, TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTER);
+    if (lead->rank == ri) {
+      std::vector<char> tmp((size_t)nbytes);
+      for (int r = 0; r < lead->size; r++) {
+        if (r == ri) continue;
+        if (recv_msg(lead, r, kCollectiveTag, tmp.data(), nbytes))
+          return 1;
+        if (combine(acc.data(), tmp.data(), count, dtype, op, c)) return 1;
+      }
+    } else {
+      if (send_msg(lead, ri, kCollectiveTag, acc.data(), nbytes)) return 1;
+    }
+  }
+  /* phase 3: land the result in the root's recvbuf */
+  const int root_leader = t->leaders[(size_t)ri];
+  if (root == root_leader) {
+    if (c->rank == root) std::memcpy(recvbuf, acc.data(), (size_t)nbytes);
+    return 0;
+  }
+  if (c->rank == root_leader || c->rank == root) {
+    ObsScope obs(TPU_OBS_SEND, root, 0, nbytes,
+                 intra && intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    const auto& mem = t->members[(size_t)ri];
+    int rloc = 0;
+    for (size_t m = 0; m < mem.size(); m++)
+      if (mem[m] == root) rloc = (int)m;
+    if (c->rank == root_leader) {
+      if (p2p_send(intra, rloc, kCollectiveTag, acc.data(), nbytes))
+        return 1;
+    } else {
+      if (recv_msg(intra, 0, kCollectiveTag, recvbuf, nbytes)) return 1;
+    }
+  }
+  return 0;
+}
+
 /* ================= async progress engine =================
  *
  * One dedicated progress thread per socket-owning communicator drives
@@ -3476,6 +3956,11 @@ int engine_run_body(EngineOp* o) {
                std::to_string(o->peer);
       });
       if (c->arena) return shm_bcast(c, o->rbuf, o->rnb, o->peer);
+      /* multi-island worlds route large bcasts through the island
+       * leaders (MPI4JAX_TPU_HIER; force drops the size floor, deny
+       * the routing) — only the inter-island leg rides the slow tier */
+      if (hier_routable(c, o->rnb))
+        return hier_bcast(c, o->rbuf, o->rnb, o->peer);
       return bcast_internal(c, o->rbuf, o->rnb, o->peer);
     }
     case TPU_OBS_GATHER: {
@@ -3538,6 +4023,9 @@ int engine_run_body(EngineOp* o) {
           return tree_allgather(c, o->sbuf, o->snb, o->rbuf);
         case TPU_COLL_RD:
           return rd_allgather(c, o->sbuf, o->snb, o->rbuf);
+        case TPU_COLL_HRING:
+        case TPU_COLL_HTREE:
+          return hier_allgather(c, o->sbuf, o->snb, o->rbuf);
         default:
           return ring_allgather(c, o->sbuf, o->snb, o->rbuf);
       }
@@ -3597,6 +4085,12 @@ int engine_run_body(EngineOp* o) {
           return qring_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
         case TPU_COLL_QRD:
           return qrd_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
+        case TPU_COLL_HRING:
+          return hier_allreduce(c, o->rbuf, o->count, o->dtype, o->rop,
+                                TPU_COLL_RING);
+        case TPU_COLL_HTREE:
+          return hier_allreduce(c, o->rbuf, o->count, o->dtype, o->rop,
+                                TPU_COLL_RD);
         default:
           return tree_allreduce(c, o->rbuf, o->count, o->dtype, o->rop);
       }
@@ -3618,6 +4112,12 @@ int engine_run_body(EngineOp* o) {
                                   o->rop, root, false);
       }
       int64_t nbytes = o->count * esize;
+      /* multi-island worlds fold within each island first, then across
+       * the leaders (same gate as bcast; float association changes
+       * like any algorithm switch — docs/usage.md) */
+      if (hier_routable(c, nbytes))
+        return hier_reduce(c, o->sbuf, o->rbuf, o->count, o->dtype,
+                           o->rop, root);
       if (c->rank == root) {
         if (o->rbuf != o->sbuf) std::memcpy(o->rbuf, o->sbuf, nbytes);
         std::vector<char> tmp(nbytes);
@@ -4127,9 +4627,18 @@ static int64_t comm_bootstrap(int rank, int size, int base_port,
   else
     std::snprintf(prefix, sizeof(prefix), "m4jshm_p%d", base_port);
   c->shm_prefix = prefix;
+  /* arena eligibility keys on the EFFECTIVE host view: the real host
+   * table with the MPI4JAX_TPU_FAKE_HOSTS virtual partition applied —
+   * a partitioned loopback job loses the world arena exactly like the
+   * multi-host shape it models (its intra-island sub-comms get their
+   * own arenas through the same check in tpucomm_split).  Sockets
+   * always dial the REAL hosts; only locality decisions change. */
+  std::vector<std::string> eff_hosts = host_list;
+  apply_fake_hosts(eff_hosts, size);
+  c->member_hosts = eff_hosts;
   bool same_host = true;
   for (int i = 1; i < size; i++)
-    if (host_list[i] != host_list[0]) same_host = false;
+    if (eff_hosts[i] != eff_hosts[0]) same_host = false;
   if (same_host) arena_init(c);
 
   std::lock_guard<std::mutex> lock(g_comms_mu);
@@ -4185,6 +4694,19 @@ void tpucomm_finalize(int64_t h) {
         if (kv.second->engine) engine_quiesce(kv.second);
         break;
       }
+  }
+  /* a finalized comm may be referenced as another comm's topology
+   * sub-communicator (intra-island / leaders): drop that topology
+   * entirely — every rank of the owning comm tears its sub-comms down
+   * at the same point (the Python bridge owns them), so the map
+   * disappears consistently and hierarchical picks degrade to their
+   * flat twins everywhere instead of on a subset of ranks */
+  for (auto& kv : g_comms) {
+    Comm* w = kv.second;
+    if (w->topo && (w->topo->intra == c || w->topo->leader == c)) {
+      delete w->topo;
+      w->topo = nullptr;
+    }
   }
   if (c->owns_socks)
     for (int fd : c->socks)
@@ -4247,11 +4769,26 @@ int64_t tpucomm_split(int64_t h, int color, int key) {
   nc->comm_id = (int32_t)(id & 0x7fffffff);
   if (nc->comm_id == 0) nc->comm_id = 1;  // 0 is reserved for the world
 
-  /* a subset of a same-host group is same-host: inherit the arena path.
-   * arena_init's nonce bcast writes the shared sockets, so it must hold
-   * the socket owner's lock like every other op on borrowed fds. */
+  /* a subset of a same-(effective-)host group is same-host: a child
+   * whose members all share one entry of the parent's member_hosts view
+   * gets its own arena even when the PARENT spans hosts — this is what
+   * gives an intra-island sub-comm of a multi-host (or FAKE_HOSTS-
+   * partitioned) world the shm fast path the hierarchical collectives
+   * ride.  arena_init's nonce bcast writes the shared sockets, so it
+   * must hold the socket owner's lock like every other op on borrowed
+   * fds. */
   nc->shm_prefix = c->shm_prefix;
-  if (c->arena) {
+  if (!c->member_hosts.empty()) {
+    nc->member_hosts.resize((size_t)nc->size);
+    for (int nr = 0; nr < nc->size; nr++)
+      nc->member_hosts[(size_t)nr] =
+          c->member_hosts[(size_t)members[(size_t)nr].second];
+  }
+  bool sub_same_host = nc->size > 1 && !nc->member_hosts.empty();
+  for (int nr = 1; sub_same_host && nr < nc->size; nr++)
+    if (nc->member_hosts[(size_t)nr] != nc->member_hosts[0])
+      sub_same_host = false;
+  if (c->arena || sub_same_host) {
     std::lock_guard<std::mutex> lock(comm_mu(nc));
     /* arena bootstrap writes the shared sockets directly (nonce bcast):
      * the progress thread must be idle first — two writers on one
@@ -4272,6 +4809,75 @@ int64_t tpucomm_dup(int64_t h) {
   /* split with one shared color, keyed by rank: same membership and
    * ordering, fresh comm_id (isolated message space) */
   return tpucomm_split(h, 0, c->rank);
+}
+
+/* ---- topology installation (mpi4jax_tpu/topo is the owner) ---- */
+
+int tpucomm_set_topology(int64_t h, const int32_t* island_of, int n,
+                         int64_t intra_h, int64_t leader_h) {
+  Comm* c = get_comm(h);
+  if (!c || !island_of || n != c->size) return 1;
+  std::unique_ptr<TopoInfo> t(new TopoInfo);
+  t->island_of.assign(island_of, island_of + n);
+  int max_id = -1;
+  for (int r = 0; r < n; r++) {
+    if (island_of[r] < 0 || island_of[r] >= n) return 1;
+    if (island_of[r] > max_id) max_id = island_of[r];
+  }
+  t->n_islands = max_id + 1;
+  t->members.assign((size_t)t->n_islands, {});
+  for (int r = 0; r < n; r++)
+    t->members[(size_t)island_of[r]].push_back(r);
+  t->leaders.resize((size_t)t->n_islands);
+  for (int i = 0; i < t->n_islands; i++) {
+    if (t->members[(size_t)i].empty()) return 1;  // ids must be dense
+    t->leaders[(size_t)i] = t->members[(size_t)i][0];
+    /* island ids ordered by leader rank: the leaders' sub-comm (split
+     * keyed on rank) then has leader-comm rank == island id, which the
+     * hierarchical schedules rely on */
+    if (i > 0 && t->leaders[(size_t)i] <= t->leaders[(size_t)i - 1])
+      return 1;
+  }
+  t->my_island = island_of[c->rank];
+  t->my_leader = t->leaders[(size_t)t->my_island];
+  const auto& mine = t->members[(size_t)t->my_island];
+  Comm* intra = intra_h > 0 ? get_comm(intra_h) : nullptr;
+  Comm* lead = leader_h > 0 ? get_comm(leader_h) : nullptr;
+  /* a single-island (flat) topology installs for the probes only — no
+   * sub-comms needed, the hierarchical schedules never become eligible */
+  if (mine.size() > 1 && t->n_islands > 1) {
+    int idx = -1;
+    for (size_t m = 0; m < mine.size(); m++)
+      if (mine[m] == c->rank) idx = (int)m;
+    if (!intra || intra->size != (int)mine.size() || intra->rank != idx)
+      return 1;
+    t->intra = intra;
+  }
+  if (t->my_leader == c->rank && t->n_islands > 1) {
+    if (!lead || lead->size != t->n_islands ||
+        lead->rank != t->my_island)
+      return 1;
+    t->leader = lead;
+  }
+  /* swap in under the op lock with the engine quiesced: dispatch reads
+   * c->topo without a lock, so no op may be mid-flight */
+  std::lock_guard<std::mutex> lock(comm_mu(c));
+  engine_quiesce(c->lock_root);
+  delete c->topo;
+  c->topo = t.release();
+  return 0;
+}
+
+int tpucomm_topo_info(int64_t h, int32_t* out_island_of,
+                      int32_t* out_n_islands) {
+  Comm* c = get_comm(h);
+  if (!c) return -1;
+  if (!c->topo) return 1;
+  if (out_island_of)
+    for (int r = 0; r < c->size; r++)
+      out_island_of[r] = c->topo->island_of[(size_t)r];
+  if (out_n_islands) *out_n_islands = c->topo->n_islands;
+  return 0;
 }
 
 int tpucomm_rank(int64_t h) {
@@ -4553,7 +5159,7 @@ void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
   std::vector<std::pair<int64_t, int32_t>> entries;
   for (int i = 0; i < n; i++) {
     int32_t a = algos[i];
-    if (a < TPU_COLL_AUTO || a > TPU_COLL_QRD || a == TPU_COLL_SHM)
+    if (a < TPU_COLL_AUTO || a > TPU_COLL_HTREE || a == TPU_COLL_SHM)
       continue;  // SHM not forcible; unknown codes dropped
     entries.emplace_back(min_bytes[i], a);
   }

@@ -57,6 +57,20 @@ enum TpuCollAlgo {
    * (allow | deny | force) gates them process-wide. */
   TPU_COLL_QRING = 5, /* quantized chunked ring */
   TPU_COLL_QRD = 6,   /* quantized recursive doubling */
+  /* Hierarchical (topology-aware) schedules: intra-island reduce to the
+   * island leader (shm arena when the island shares a host, serial TCP
+   * otherwise) -> leader-tier allreduce over the inter-island links
+   * (ring for HRING, recursive doubling for HTREE; upgraded to the
+   * qring/qrd quantized twin on THAT LEG ONLY under
+   * MPI4JAX_TPU_COLL_QUANT=force) -> intra-island bcast.  Require a
+   * multi-island topology installed via tpucomm_set_topology; degrade
+   * to their flat counterparts (ring / tree) on a flat comm or under
+   * MPI4JAX_TPU_HIER=deny, and MPI4JAX_TPU_HIER=force upgrades every
+   * eligible flat pick.  Also valid for allgather (intra gather ->
+   * leader ring allgatherv of island blocks -> intra bcast, any island
+   * shapes).  Must agree across ranks like every other algorithm. */
+  TPU_COLL_HRING = 7, /* hierarchical: intra reduce + leader ring + bcast */
+  TPU_COLL_HTREE = 8, /* hierarchical: intra reduce + leader rd + bcast */
 };
 
 /* op kinds for the per-op decision tables */
@@ -98,6 +112,39 @@ void tpucomm_set_logging(int enabled);
  * sibling comms abort instead of corrupting. */
 int64_t tpucomm_split(int64_t h, int color, int key);
 int64_t tpucomm_dup(int64_t h);
+
+/* ---- topology (mpi4jax_tpu/topo is the owner) ----
+ *
+ * Install the discovered locality map on a communicator:
+ * `island_of[r]` assigns member rank r to an island (ranks sharing a
+ * host/shm domain; ids must be dense 0..n_islands-1, ordered by each
+ * island's lowest member rank).  `intra_h` is this rank's intra-island
+ * sub-communicator (0/-1 when its island is a singleton), `leader_h`
+ * the leaders' sub-communicator (0/-1 on non-leader ranks); both come
+ * from tpucomm_split over `h` with (color=island, key=rank) and
+ * (color=leader?0:-1, key=rank) respectively — the Python bridge
+ * performs the splits and this call wires them up.  With more than one
+ * island installed, the hierarchical algorithms (TPU_COLL_HRING/HTREE)
+ * become eligible and bcast/reduce route hierarchically for large
+ * payloads (>= 64 KiB, always under MPI4JAX_TPU_HIER=force, never
+ * under =deny).  Returns 0 on success, nonzero on an inconsistent map.
+ * Every rank of the communicator must install an AGREEING topology
+ * (divergence fails fast on the transport's frame checks).
+ *
+ * MPI4JAX_TPU_FAKE_HOSTS=r0,r1|r2,r3 partitions the ranks of a
+ * single-machine job into virtual hosts (read natively at bootstrap):
+ * the shm arena is granted per virtual host instead of per real host,
+ * so every multi-island shape is testable over loopback.  Ranks not
+ * listed keep their real host. */
+int tpucomm_set_topology(int64_t h, const int32_t* island_of, int n,
+                         int64_t intra_h, int64_t leader_h);
+
+/* Probe the installed topology: writes island_of (size ints; caller
+ * allocates) and the island count.  Returns 0 when a topology is
+ * installed, 1 when the comm is flat (outputs untouched), -1 on a bad
+ * handle. */
+int tpucomm_topo_info(int64_t h, int32_t* out_island_of,
+                      int32_t* out_n_islands);
 
 /* Human-readable text for the most recent failure in this process (the
  * analog of MPI_Error_string); "" if none. */
@@ -231,6 +278,20 @@ enum TpuObsOp {
   TPU_OBS_REDUCE, TPU_OBS_SCAN,
 };
 
+/* transport tier an event's bytes moved on (TpuObsEvent.tier).  FLAT is
+ * every non-hierarchical op (the whole-op record of a hierarchical
+ * collective is also FLAT — its per-leg children carry the split).
+ * INTRA/INTER label the legs a hierarchical collective emits in
+ * addition to its whole-op record, so obs.stats() splits intra- from
+ * inter-island bytes.  ICI is reserved for device-mesh collectives
+ * (lax.psum / Pallas RDMA) routed outside this host transport. */
+enum TpuObsTier {
+  TPU_TIER_FLAT = 0,
+  TPU_TIER_INTRA = 1,  /* within one island (shm arena / same host) */
+  TPU_TIER_INTER = 2,  /* between island leaders (TCP / DCN) */
+  TPU_TIER_ICI = 3,    /* reserved: on-device ICI mesh */
+};
+
 struct TpuObsEvent {
   double t_start;  /* seconds on the recorder clock (tpucomm_obs_clock);
                     * for engine-queued ops this is the POST time, so the
@@ -250,6 +311,10 @@ struct TpuObsEvent {
   int32_t peer;    /* peer/root rank; -1 when not applicable */
   int32_t tag;     /* user tag; 0 when not applicable */
   int32_t algo;    /* TpuCollAlgo that served the call; -1 when n/a */
+  int32_t tier;    /* TpuObsTier: 0 flat/whole-op, 1 intra-island leg,
+                    * 2 inter-island leg (hierarchical collectives emit
+                    * one extra event per leg carrying the tier) */
+  int32_t _pad;    /* keep the slot 8-byte aligned (72-byte slots) */
 };
 
 /* Arm (enabled=1) or disarm (0) recording.  `capacity` is the ring size

@@ -21,8 +21,13 @@ def test_diag_cpu_checks():
     assert data["failed"] == 0
     names = {r["check"] for r in data["results"]}
     assert names == {"native_build", "ffi_fast_path", "coll_algo_engine",
-                     "observability", "static_verify", "transport_loopback",
-                     "failure_detection"}
+                     "observability", "static_verify", "schedule_plan",
+                     "topology", "transport_loopback", "failure_detection",
+                     "elasticity"}
+    # the topology probe renders the island map and the live pick
+    topo_check = next(r for r in data["results"] if r["check"] == "topology")
+    assert "island0[" in topo_check["detail"]
+    assert "algo16mb=" in topo_check["detail"]
     # the static verifier check proves both verdict directions
     sv = next(r for r in data["results"] if r["check"] == "static_verify")
     assert "tag_mismatch flagged" in sv["detail"]

@@ -285,6 +285,100 @@ def test_tsan_progress_engine_three_ranks(shm):
     )
 
 
+# ---- hierarchical collectives under TSan ---------------------------
+#
+# The topology subsystem adds a third concurrency shape: one engine op
+# fans out across THREE communicators (the world op runs intra-island
+# shm reduces, leader-tier TCP rounds, and intra bcasts on sub-comms
+# borrowing the world's sockets, with per-leg observability events
+# appended from whichever thread executes).  A three-rank two-island
+# (r0,r1 | r2) loop drives forced hring/htree allreduces plus
+# hierarchically routed bcasts, queue armed, shm on and off — 0
+# reports required.
+
+_HIER_RANK_SRC = r"""
+import ctypes, os, sys
+import numpy as np
+
+so = os.environ["SAN_SO"]
+rank = int(os.environ["SAN_RANK"])
+size = int(os.environ["SAN_SIZE"])
+port = int(os.environ["SAN_PORT"])
+
+lib = ctypes.CDLL(so)
+lib.tpucomm_init.restype = ctypes.c_int64
+lib.tpucomm_init.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                             ctypes.c_char_p]
+lib.tpucomm_split.restype = ctypes.c_int64
+lib.tpucomm_split.argtypes = [ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+lib.tpucomm_set_topology.restype = ctypes.c_int
+lib.tpucomm_set_topology.argtypes = [
+    ctypes.c_int64, ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+    ctypes.c_int64, ctypes.c_int64]
+h = lib.tpucomm_init(rank, size, port, b"")
+assert h > 0, "tpucomm_init failed"
+
+# islands r0,r1 | r2 (MPI4JAX_TPU_FAKE_HOSTS in the env governs the
+# arena gating; this mirrors it for the native map)
+islands = [0, 0, 1]
+intra_h = lib.tpucomm_split(h, islands[rank], rank)
+lead_h = lib.tpucomm_split(h, 0 if rank in (0, 2) else -1, rank)
+arr = (ctypes.c_int32 * size)(*islands)
+rc = lib.tpucomm_set_topology(
+    h, arr, size, intra_h if rank < 2 else 0, lead_h if rank != 1 else 0)
+assert rc == 0, f"set_topology failed rc={rc}"
+
+F32, SUM = 11, 0
+HRING, HTREE = 7, 8
+n = 3000
+p = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+buf = (np.arange(n, dtype=np.float32) % 13) * (rank + 1)
+expect = (np.arange(n, dtype=np.float32) % 13) * sum(
+    r + 1 for r in range(size))
+out = np.zeros_like(buf)
+big = np.zeros(70000, np.float32)
+for it in range(12):
+    for algo in (HRING, HTREE):
+        rc = lib.tpucomm_allreduce_algo(h, p(buf), p(out), n, F32, SUM,
+                                        algo)
+        assert rc == 0, f"hier allreduce failed at iter {it}"
+        assert np.array_equal(out, expect), f"iter {it} algo {algo}"
+    # >= 64 KiB bcast routes hierarchically (leader tier + islands)
+    if rank == 1:
+        big[:] = np.arange(70000, dtype=np.float32) + it
+    rc = lib.tpucomm_bcast(h, p(big), ctypes.c_int64(big.nbytes), 1)
+    assert rc == 0
+    assert big[7] == 7.0 + it, big[7]
+    assert lib.tpucomm_barrier(h) == 0
+lib.tpucomm_finalize(ctypes.c_int64(intra_h))
+lib.tpucomm_finalize(ctypes.c_int64(lead_h))
+lib.tpucomm_finalize(ctypes.c_int64(h))
+print("san-rank-ok", rank, flush=True)
+"""
+
+
+@pytest.mark.parametrize("shm", ["on", "off"])
+def test_tsan_hier_two_islands_three_ranks(shm):
+    _build("tsan")
+    preload = _preload_path("libtsan.so")
+    so = os.path.join(SO_DIR, "libtpucomm_tsan.so")
+    extra = {
+        "MPI4JAX_TPU_JOBID": f"tsanhier{shm}{os.getpid()}",
+        "MPI4JAX_TPU_PROGRESS_THREAD": "1",
+        # the virtual partition is what grants the intra-island arena
+        # while withholding the world one
+        "MPI4JAX_TPU_FAKE_HOSTS": "r0,r1|r2",
+    }
+    if shm == "off":
+        extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    _run_group(
+        _HIER_RANK_SRC, 3, so, preload,
+        {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"},
+        48500 + (os.getpid() + (17 if shm == "on" else 0)) % 900,
+        extra,
+    )
+
+
 # ---- elastic shrink under load (TSan) ------------------------------
 #
 # The recovery bootstrap is the second lifecycle the transport's

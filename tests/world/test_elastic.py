@@ -128,13 +128,25 @@ def test_rank_failure_surfaces_as_exception(tmp_path):
         "from mpi4jax_tpu.elastic import RankFailure\n"
         "from mpi4jax_tpu.runtime import bridge, transport\n"
         "c = transport.get_world_comm()\n"
-        "h = c.handle\n"
+        # comm creation itself is in the try block: the after=0 recv
+        # fault fires inside the topology-discovery allgather at init
+        # (comm_init's first collective), and the failure must surface
+        # as a catchable RankFailure from WHEREVER the transport first
+        # touches the dead peer
         "if c.rank() == 0:\n"
         "    try:\n"
+        "        h = c.handle\n"
         "        bridge.recv(h, (4,), np.float64, 1, 7)\n"
         "        print('UNREACHABLE', flush=True)\n"
         "    except RankFailure as e:\n"
         "        print(f'caught RankFailure op={e.op}', flush=True)\n"
+        # stay up long enough for the launcher to process rank 1's
+        # death while this rank is alive: a survivor that handles the
+        # failure itself and winds down is a completed job, not a
+        # zero-survivor loss
+        "    import time; time.sleep(2)\n"
+        "else:\n"
+        "    h = c.handle\n"
     )
     env = {"MPI4JAX_TPU_FAULT": "rank=1,point=recv,after=0,action=exit",
            "MPI4JAX_TPU_TIMEOUT_S": "6"}
@@ -145,7 +157,7 @@ def test_rank_failure_surfaces_as_exception(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu",
              "MPI4JAX_TPU_DISABLE_SHM": "1", **env}, cwd=REPO)
     assert res.returncode == 0, res.stderr[-1500:]
-    assert "caught RankFailure op=Recv" in res.stdout
+    assert "caught RankFailure op=" in res.stdout
     assert "UNREACHABLE" not in res.stdout
 
 

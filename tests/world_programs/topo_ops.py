@@ -1,0 +1,191 @@
+"""Cross-algorithm equivalence for the hierarchical (topology-aware)
+schedules — the hring/htree sibling of ``coll_algo_ops.py``.
+
+Run under the launcher with ``MPI4JAX_TPU_FAKE_HOSTS`` partitioning the
+ranks into islands (the test drives 2x2 at np=4 and uneven 4+2 at
+np=6, shm on and off).  Asserts:
+
+- discovery: the Topology matches the partition, the WORLD arena is
+  withheld, each multi-member island's intra sub-comm has one exactly
+  when shm is enabled, and the native layer reports the installed map;
+- hring/htree x {f32, bf16} x {SUM, MAX} vs the flat default path:
+  association-free cases (MAX, integer-valued floats) bit-identical;
+  f32 SUM additionally bit-identical to the numpy schedule simulators
+  (``topo.simulate_hring_sum`` — ONE simulator covers shm on and off,
+  because both native intra paths fold in island member order);
+  bf16 SUM inside the documented fp tolerance;
+- rank consistency: every rank holds identical bits after a
+  hierarchical allreduce (phase 3 broadcasts the leader's bytes);
+- allgather under hring/htree: pure data movement, bit-for-bit,
+  including the island-block -> world-rank reorder on non-contiguous
+  partitions;
+- large bcast/reduce route hierarchically (>= 64 KiB) with flat-equal
+  results (exact payloads);
+- MPI4JAX_TPU_HIER=deny degrades hring to the flat ring bit-for-bit.
+
+Bridge-level with the parent-package shim (no jax import): runs in ANY
+container, like the coalescing bridge programs.
+"""
+
+import os
+import sys
+import types
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+
+import numpy as np  # noqa: E402
+
+from mpi4jax_tpu import topo, tune  # noqa: E402
+from mpi4jax_tpu.runtime import bridge, transport  # noqa: E402
+
+# wire codes (native/tpucomm.h)
+F32, BF16, I32 = 11, 10, 3
+SUM, MAX = 0, 2
+
+
+def f32_to_bf16_bits(a32):
+    bits = a32.view(np.uint32)
+    rounded = bits + np.uint32(0x7FFF) + ((bits >> np.uint32(16))
+                                          & np.uint32(1))
+    return (rounded >> np.uint32(16)).astype(np.uint16)
+
+
+def bf16_bits_to_f32(b):
+    return (b.astype(np.uint32) << 16).view(np.float32)
+
+
+def main():
+    comm = transport.get_world_comm()
+    rank, size = comm.rank(), comm.size()
+    h = comm.handle
+    shm_on = os.environ.get("MPI4JAX_TPU_DISABLE_SHM", "") in ("", "0")
+
+    # ---- discovery assertions -------------------------------------
+    t = comm.topology()
+    assert t is not None and t.multi, f"expected a multi-island map, got {t}"
+    expect = [int(x) for x in os.environ["TOPO_EXPECT_ISLANDS"].split(",")]
+    assert t.island_of == expect, (t.island_of, expect)
+    active, _, _ = bridge.shm_info(h)
+    assert not active, "world arena must be withheld under FAKE_HOSTS"
+    info = bridge.topo_info(h)
+    assert info == (expect, t.n_islands), info
+    # the intra sub-comm's arena follows the shm axis (registered by
+    # the bridge; probe through the cached handles)
+    subs = bridge._topo_handles.get(int(h), [])
+    my_members = t.island(rank)
+    if len(my_members) > 1:
+        intra_active, _, _ = bridge.shm_info(subs[0])
+        assert intra_active == shm_on, (intra_active, shm_on)
+    if not os.environ.get("MPI4JAX_TPU_COLL_ALGO"):
+        assert comm.coll_algo("allreduce", 16 << 20) == "hring"
+        assert comm.coll_algo("allreduce", 1024) == "tree"
+
+    deny = os.environ.get("MPI4JAX_TPU_HIER", "allow").strip() == "deny"
+
+    rng = np.random.RandomState(5)
+    for count in (3, 513, 70000):  # < n_islands, odd small, > 64KB f32
+        base_f = rng.randn(size, count).astype(np.float32) * 2
+        base_i = rng.randint(-900, 900, size=(size, count)).astype(np.int32)
+        base_x = base_i.astype(np.float32)  # integer-valued: exact SUM
+        bf_bits = f32_to_bf16_bits(base_f)
+
+        for algo in ("hring", "htree"):
+            code = tune.ALGO_CODES[algo]
+            # exact cases: bit-identical to the flat default path
+            for dcode, base, op in ((I32, base_i, SUM), (F32, base_x, SUM),
+                                    (F32, base_f, MAX),
+                                    (BF16, bf_bits, MAX)):
+                x = base[rank].copy()
+                ref = np.empty_like(x)
+                bridge.allreduce_raw(h, x, ref, dcode, op)
+                out = np.empty_like(x)
+                bridge.allreduce_raw(h, x, out, dcode, op, algo=code)
+                assert np.array_equal(out, ref), (
+                    f"{algo} dcode={dcode} op={op} count={count}: not "
+                    "bit-identical to the flat default")
+
+            # f32 SUM on random floats: bit-parity with the simulator
+            # (under MPI4JAX_TPU_HIER=deny the forced code DEGRADES to
+            # its flat twin — the degrade contract is asserted below
+            # instead, and here against the flat ring simulator)
+            x = base_f[rank].copy()
+            out = np.empty_like(x)
+            bridge.allreduce_raw(h, x, out, F32, SUM, algo=code)
+            if deny:
+                if algo == "hring":
+                    want = topo.simulate_ring_sum(
+                        [base_f[r] for r in range(size)])
+                    assert np.array_equal(out, want), (
+                        f"denied {algo}: not the flat ring")
+            else:
+                sim_fn = (topo.simulate_hring_sum if algo == "hring"
+                          else topo.simulate_htree_sum)
+                want = sim_fn([base_f[r] for r in range(size)], t.islands)
+                assert np.array_equal(out, want), (
+                    f"{algo} count={count}: native diverges from the "
+                    f"numpy simulator (maxdiff "
+                    f"{np.max(np.abs(out - want))})")
+            # ...and within fp tolerance of the flat default
+            ref = np.empty_like(x)
+            bridge.allreduce_raw(h, x, ref, F32, SUM)
+            assert np.allclose(out, ref, rtol=1e-5, atol=1e-5 * size)
+            # rank consistency: every rank holds the same bits
+            rows = bridge.allgather(h, out, size)
+            for r in range(size):
+                assert np.array_equal(rows[r], out), (
+                    f"{algo} count={count}: rank {r} diverged")
+
+            # bf16 SUM: error-bound vs f64 + rank consistency
+            xb = bf_bits[rank].copy()
+            outb = np.empty_like(xb)
+            bridge.allreduce_raw(h, xb, outb, BF16, SUM, algo=code)
+            exact = np.sum(bf16_bits_to_f32(bf_bits).astype(np.float64),
+                           axis=0)
+            denom = max(np.max(np.abs(exact)), 1e-6)
+            err = np.max(np.abs(bf16_bits_to_f32(outb) - exact)) / denom
+            assert err < 4e-2, f"{algo} bf16 SUM rel err {err:.2e}"
+            rows = bridge.allgather(h, outb, size)
+            for r in range(size):
+                assert np.array_equal(rows[r], outb), f"{algo} bf16 diverged"
+
+        # allgather: pure data movement — bit-for-bit under both
+        xg = (base_i[rank, :count] + 13 * rank).astype(np.int32)
+        ref = bridge.allgather(h, xg, size)
+        for algo in ("hring", "htree"):
+            got = bridge.allgather(h, xg, size,
+                                   algo=tune.ALGO_CODES[algo])
+            assert np.array_equal(got, ref), f"allgather {algo}"
+
+    # ---- hierarchical bcast / reduce routing (>= 64 KiB) -----------
+    big = np.arange(70000, dtype=np.float32)
+    buf = big.copy() if rank == 1 else np.zeros_like(big)
+    got = bridge.bcast(h, buf, 1)
+    assert np.array_equal(got, big), "hier bcast payload wrong"
+    xr = np.full(70000, float(rank + 1), np.float32)
+    root = size - 1
+    outr = bridge.reduce(h, xr, SUM, root)
+    if rank == root:
+        assert np.all(outr == sum(range(1, size + 1))), outr[:4]
+    else:
+        assert np.all(outr == rank + 1), "non-root reduce buf must stay input"
+
+    # ---- deny gate: hring degrades to the flat ring bit-for-bit ----
+    # (same process: the native gate is read per dispatch via the env
+    # at startup, so drive the degrade through a FLAT-vs-forced pair
+    # instead — forced ring vs forced hring on integer floats)
+    xi = base_x[rank][:513].copy()
+    a = np.empty_like(xi)
+    b = np.empty_like(xi)
+    bridge.allreduce_raw(h, xi, a, F32, SUM, algo=tune.ALGO_CODES["ring"])
+    bridge.allreduce_raw(h, xi, b, F32, SUM, algo=tune.ALGO_CODES["hring"])
+    assert np.array_equal(a, b), "exact-int hring != ring"
+
+    print(f"topo_ops OK (shm={int(shm_on)})", flush=True)
+
+
+if __name__ == "__main__":
+    main()
