@@ -370,7 +370,31 @@ def world_latency_rank(sizes=None):
         if rc != 0:
             raise RuntimeError(f"native allreduce failed (rc={rc})")
 
+        # syscalls-per-message (the submit-batching column): a short
+        # untimed pass with the obs recorder armed averages the native
+        # per-event `syscalls` field; None on a pre-uring .so, which
+        # never writes it (the timing loops above stay unperturbed)
+        sys_per_msg = None
+        from mpi4jax_tpu.obs import _native as _obs_native
+
+        if (_obs_native.available(lib)
+                and _obs_native.syscalls_available(lib)):
+            obs.reset() if obs.enabled() else obs.start(lib=lib)
+            obs.events()  # drain anything stale
+            for _ in range(min(100, raw_reps)):
+                rc |= fn(*args)
+            evs = [e for e in obs.events()
+                   if e.get("src") == "native" and e["name"] == "Allreduce"]
+            if evs:
+                sys_per_msg = round(
+                    sum(int(e.get("syscalls", 0)) for e in evs)
+                    / len(evs), 3)
+            obs.stop()
+            if rc != 0:
+                raise RuntimeError(f"native allreduce failed (rc={rc})")
+
         if comm.rank() == 0:
+            uring = bridge.uring_status() or "unavailable(pre-uring .so)"
             rec = obs.bench_record(
                 op="allreduce", nbytes=size,
                 seconds=obs.percentile(jit_us, 50) / 1e6, ranks=n,
@@ -382,6 +406,8 @@ def world_latency_rank(sizes=None):
                 raw_p95_us=round(obs.percentile(raw_us, 95), 3),
                 raw_p99_us=round(obs.percentile(raw_us, 99), 3),
                 resolved_algo=comm.coll_algo("allreduce", size),
+                uring=uring,
+                syscalls_per_msg=sys_per_msg,
             )
             print(json.dumps(rec), flush=True)
 

@@ -49,6 +49,34 @@ def timeit(fn, reps):
     return mean, times, warmup
 
 
+def syscalls_per_op(fn, op_name, reps=200):
+    """Mean transport syscalls per op, measured OUTSIDE the timing loop
+    (recording costs clock reads that would perturb the latency rows):
+    a short pass with the obs recorder armed averages the per-event
+    ``syscalls`` field (None on a pre-uring native library, which never
+    writes it), cross-checked against the process-total counter."""
+    lib = bridge.get_lib()
+    from mpi4jax_tpu.obs import _native
+
+    if not (_native.available(lib) and _native.syscalls_available(lib)):
+        return None, None
+    obs.reset() if obs.enabled() else obs.start(lib=lib)
+    obs.events()  # drain warmup noise
+    t0 = bridge.syscall_count()
+    for _ in range(reps):
+        fn()
+    total = bridge.syscall_count() - t0
+    evs = [e for e in obs.events()
+           if e.get("src") == "native" and e["name"] == op_name]
+    per_event = (sum(int(e.get("syscalls", 0)) for e in evs) / len(evs)
+                 if evs else None)
+    # disarm before returning: the NEXT row's timeit() loop must run
+    # with the recorder off, or its latency figures carry the per-event
+    # clock reads this pass just paid
+    obs.stop()
+    return per_event, round(total / reps, 3)
+
+
 def main():
     comm = transport.get_world_comm()
     handle, rank, size = comm.handle, comm.rank(), comm.size()
@@ -71,6 +99,12 @@ def main():
             **extra,
         )
 
+    # the submit-batching column: uring state + syscalls-per-message
+    # (obs `syscalls` field; None on a pre-uring .so) stamped into
+    # every row so the BENCH artifacts carry the transport-floor
+    # attribution, not just wall time
+    uring = bridge.uring_status() or "unavailable(pre-uring .so)"
+
     # sendrecv round: each rank sends to the peer and receives back —
     # one full round of the persistent-writer (or eager inline) path
     for nbytes in (1024, 65536):
@@ -82,8 +116,39 @@ def main():
                             peer, peer, 7)
 
         mean, times, warmup = timeit(round_trip, reps)
+        sys_ev, sys_total = syscalls_per_op(round_trip, "Sendrecv",
+                                            min(200, reps))
         rows.append(record("sendrecv_round", nbytes, mean, times, warmup,
-                           reps))
+                           reps, uring=uring, syscalls_per_msg=sys_ev,
+                           syscalls_per_msg_total=sys_total))
+
+    # small-send burst: 32 adjacent sends to one peer — the engine's
+    # coalescing/batching shape; syscalls-per-message is the headline
+    # submit-batching number here
+    for nbytes in (512, 8192):
+        buf = np.ones(nbytes // 4, np.float32)
+        burst = 32
+
+        def burst_round():
+            if rank == 0:
+                for i in range(burst):
+                    bridge.send(handle, buf, peer, 100 + i)
+                for i in range(burst):
+                    bridge.recv(handle, buf.shape, buf.dtype, peer, 200 + i)
+            else:
+                out = [bridge.recv(handle, buf.shape, buf.dtype, peer,
+                                   100 + i) for i in range(burst)]
+                for i in range(burst):
+                    bridge.send(handle, out[i], peer, 200 + i)
+
+        reps = 100
+        mean, times, warmup = timeit(burst_round, reps)
+        _, sys_total = syscalls_per_op(burst_round, "Send", 50)
+        rows.append(record(
+            "send_burst32", nbytes, mean, times, warmup, reps, uring=uring,
+            burst=burst,
+            syscalls_per_msg_total=(round(sys_total / (2 * burst), 4)
+                                    if sys_total is not None else None)))
 
     # allreduce: the doc table's three sizes
     for nbytes, reps in ((1024, 2000), (65536, 300), (16 << 20, 5)):
@@ -93,9 +158,14 @@ def main():
             bridge.allreduce(handle, buf, 0)  # 0 = SUM
 
         mean, times, warmup = timeit(reduce_once, reps)
+        sys_ev, sys_total = syscalls_per_op(reduce_once, "Allreduce",
+                                            min(100, reps))
         rows.append(record("allreduce", nbytes, mean, times, warmup, reps,
-                           ranks=size))
+                           ranks=size, uring=uring, syscalls_per_msg=sys_ev,
+                           syscalls_per_msg_total=sys_total))
 
+    if obs.enabled():
+        obs.stop()
     bridge.barrier(handle)
     if rank == 0:
         for r in rows:

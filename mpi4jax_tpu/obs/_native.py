@@ -44,7 +44,12 @@ class TpuObsEvent(ctypes.Structure):
         ("tag", ctypes.c_int32),
         ("algo", ctypes.c_int32),
         ("tier", ctypes.c_int32),
-        ("_pad", ctypes.c_int32),
+        # transport syscalls issued while the op executed (the uring
+        # generation's submit-batching attribution); occupies the former
+        # padding slot, so the layout is unchanged — but a pre-uring .so
+        # never writes it, which is why drain() gates the field on
+        # syscalls_available()
+        ("syscalls", ctypes.c_int32),
     ]
 
 
@@ -80,6 +85,15 @@ def available(lib) -> bool:
     lib.tpucomm_obs_drain.restype = ctypes.c_int64
     lib.tpucomm_obs_clock.restype = ctypes.c_double
     return True
+
+
+def syscalls_available(lib) -> bool:
+    """True when the loaded .so writes ``TpuObsEvent.syscalls`` —
+    ``tpucomm_uring_status`` is the layout probe for the uring
+    generation.  A pre-uring library's slot is always 0 (the former
+    padding), and reporting a fake 0 as a measurement would poison the
+    syscalls-per-message benchmarks, so the field is omitted instead."""
+    return lib is not None and hasattr(lib, "tpucomm_uring_status")
 
 
 def enable(lib, capacity_events: int) -> None:
@@ -120,11 +134,12 @@ def drain(lib, max_events: int = 1 << 20):
         return []
     buf = (TpuObsEvent * n)()
     got = lib.tpucomm_obs_drain(buf, ctypes.c_int64(n))
+    syscalls_ok = syscalls_available(lib)
     out = []
     for i in range(got):
         e = buf[i]
         op = OBS_OP_NAMES[e.op] if 0 <= e.op < len(OBS_OP_NAMES) else "?"
-        out.append({
+        ev = {
             "name": op,
             "t": e.t_start,
             "dur_s": e.dur_s,
@@ -136,5 +151,10 @@ def drain(lib, max_events: int = 1 << 20):
             "tag": e.tag,
             "algo": ALGO_NAMES.get(e.algo),
             "tier": TIER_NAMES.get(e.tier),
-        })
+        }
+        if syscalls_ok:
+            # only a uring-generation library writes the field; a
+            # pre-uring .so's slot is stale padding, never a count
+            ev["syscalls"] = e.syscalls
+        out.append(ev)
     return out

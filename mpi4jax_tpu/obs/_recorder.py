@@ -224,6 +224,11 @@ def _pull_native() -> None:
         # flat events — pre-topology recordings stay schema-identical
         if e.get("tier"):
             ev["tier"] = e["tier"]
+        # transport syscall count: carried only when the native library
+        # writes it (uring-generation .so) — pre-uring recordings stay
+        # schema-identical, and a fake 0 never masquerades as data
+        if "syscalls" in e:
+            ev["syscalls"] = e["syscalls"]
         canon.append(ev)
     _state.native_acc.extend(canon)
 

@@ -122,6 +122,13 @@ def summarize(events, dropped=None, rank=None) -> dict:
             # number comparable across compressed and exact runs.
             row["wire_bytes"] = wire_bytes
             row["compression"] = _sig(nbytes / max(wire_bytes, 1))
+        if any("syscalls" in e for e in evs):
+            # transport syscalls (uring-generation recordings only):
+            # total + per-op mean, the submit-batching attribution —
+            # pre-uring recordings stay schema-identical
+            total_sys = sum(int(e.get("syscalls", 0)) for e in evs)
+            row["syscalls"] = total_sys
+            row["syscalls_per_op"] = _sig(total_sys / max(len(evs), 1))
         rows.append(row)
     out = {
         "schema": STATS_SCHEMA,
@@ -153,6 +160,9 @@ def render_table(stats: dict, *, by=("op", "algo")) -> str:
         # quantized rows present: show the on-wire compression ratio
         # (exact rows render blank — their wire IS the logical payload)
         cols = cols + ("compression",)
+    if any("syscalls_per_op" in r for r in rows):
+        # uring-generation rows: syscalls per op (submit batching)
+        cols = cols + ("syscalls_per_op",)
     if not rows:
         return "(no events recorded)"
     widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))

@@ -54,6 +54,10 @@ def rank_trace_events(events, rank: int):
             args["algo"] = ev["algo"]
         if ev.get("tier"):
             args["tier"] = ev["tier"]  # hierarchical leg: intra / inter
+        if "syscalls" in ev:
+            # transport syscalls of this op (uring-generation events):
+            # the submit-batching win, visible per span in Perfetto
+            args["syscalls"] = int(ev["syscalls"])
         wb = int(ev.get("wire_bytes", ev.get("bytes", 0)))
         if wb != args["bytes"]:
             args["wire_bytes"] = wb  # quantized: compressed payload

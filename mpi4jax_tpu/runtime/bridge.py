@@ -319,6 +319,34 @@ def coll_algo_for(handle, op_kind: int, nbytes: int):
 
 
 
+def uring_status():
+    """Resolved state of the native io_uring submission backend:
+    ``"on"``, ``"on(no-zerocopy)"``, ``"off"`` (MPI4JAX_TPU_URING=0),
+    or ``"unavailable(<reason>)"`` — or None when the loaded .so
+    predates the uring generation entirely (the layout probe: such a
+    build has no uring path and never writes the obs ``syscalls``
+    field, so it must read as uring-unavailable, not be misparsed)."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_uring_status"):
+        return None
+    fn = lib.tpucomm_uring_status
+    fn.restype = ctypes.c_char_p
+    return (fn() or b"").decode(errors="replace")
+
+
+def syscall_count():
+    """Process-total transport syscalls since load (write/read/writev/
+    poll/io_uring_enter; futexes excluded) — benchmarks read deltas of
+    this for their syscalls-per-message column.  None on a pre-uring
+    .so (no counter)."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_syscall_count"):
+        return None
+    fn = lib.tpucomm_syscall_count
+    fn.restype = ctypes.c_int64
+    return int(fn())
+
+
 def quant_available() -> bool:
     """True when the loaded native library carries the quantized
     collective engine (qring/qrd wire formats + the codec exports) —

@@ -203,8 +203,14 @@ def check_transport_loopback(port):
         "assert np.allclose(np.asarray(got), np.arange(3.0) + 1 - c.rank())\n"
         "from mpi4jax_tpu.runtime import bridge\n"
         "act, slot, ring = bridge.shm_info(c.handle)\n"
-        "print('loopback-ok shm=%%d ring_kb=%%d algo16mb=%%s' %% "
-        "(act, ring // 1024, c.coll_algo('allreduce', 16 << 20)))\n"
+        # the transport-floor state: on / off / unavailable(<reason>);
+        # a pre-uring .so (no status symbol) reads as unavailable, never
+        # as a misparsed guess
+        "us = bridge.uring_status()\n"
+        "if us is None:\n"
+        "    us = 'unavailable(native library predates the uring backend)'\n"
+        "print('loopback-ok shm=%%d ring_kb=%%d algo16mb=%%s uring=%%s' %% "
+        "(act, ring // 1024, c.coll_algo('allreduce', 16 << 20), us))\n"
         % REPO
     )
     with tempfile.NamedTemporaryFile(

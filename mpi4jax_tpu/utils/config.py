@@ -177,6 +177,31 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 two; posting parks when full — bounded
                                 memory, never unbounded buffering; read
                                 natively).
+- ``MPI4JAX_TPU_URING``       — io_uring submission backend under the
+                                progress engine (docs/sharp-bits.md
+                                § "The transport floor"; read natively,
+                                strict ``auto|0|1`` parser — a typo'd
+                                knob aborts loudly): one batched
+                                ``io_uring_enter`` moves a whole frame
+                                (or descriptor burst), a registered
+                                staging pool backs small frames, and
+                                sends past the kernel's buffering
+                                ceiling go out as MSG_ZEROCOPY with
+                                the completion consumed as a CQE.
+                                ``auto`` (default) probes the kernel
+                                (needs io_uring with EXT_ARG, ~5.11+);
+                                ``0`` keeps the poll-driven path
+                                bit-for-bit (sanitizer builds, old
+                                kernels); ``1`` demands it and warns
+                                loudly when the kernel cannot.  Wire
+                                bytes, deadlines, poison, and fault
+                                injection are identical on both paths;
+                                results are bit-for-bit either way.
+                                ``config.uring_mode()`` mirrors the
+                                parser; the RESOLVED state (on / off /
+                                unavailable + reason) is native —
+                                ``bridge.uring_status()`` reports it
+                                and the diag transport check prints it.
 - ``MPI4JAX_TPU_PLAN``        — schedule-plan execution (the analysis
                                 layer's verified comm-program rewriting,
                                 docs/analysis.md § "From verifier to
@@ -361,6 +386,7 @@ KNOBS = {
     "MPI4JAX_TPU_PLAN": "schedule-plan execution (off / plan file / api)",
     "MPI4JAX_TPU_PLAN_BUCKET_KB": "gradient allreduce bucket ceiling (KB)",
     "MPI4JAX_TPU_QUEUE_DEPTH": "progress-engine submission-queue depth",
+    "MPI4JAX_TPU_URING": "io_uring submission backend: auto/0/1",
     "MPI4JAX_TPU_PALLAS_COLLECTIVES": "route mesh collectives via Pallas",
     "MPI4JAX_TPU_TOPO": "topology discovery at comm creation: auto/off",
     "MPI4JAX_TPU_FAKE_HOSTS": "virtual host partition for topology tests",
@@ -541,6 +567,40 @@ def coalesce_bytes() -> int:
         raise ValueError(
             f"cannot parse MPI4JAX_TPU_COALESCE_BYTES={raw!r} as bytes")
     return max(0, min(v, 64 * 1024))
+
+
+def uring_mode() -> str:
+    """``MPI4JAX_TPU_URING`` as "auto" | "0" | "1" — the Python mirror
+    of the native parser, byte-for-byte (whitespace-trimmed, loud on
+    anything else: the native layer exits on a typo'd knob, so this
+    must never quietly read the same value as "auto").  Whether the
+    backend is ACTUALLY active is resolved natively by the kernel
+    probe — ``runtime.bridge.uring_status()`` reports on/off/
+    unavailable(<reason>)."""
+    raw = os.environ.get("MPI4JAX_TPU_URING")
+    if raw is None:
+        return "auto"
+    v = raw.strip()
+    if not v:
+        return "auto"
+    if v in ("auto", "0", "1"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_URING={raw!r} (expected auto, 0, or 1)")
+
+
+def uring_active() -> bool:
+    """True when the loaded native transport resolved the io_uring
+    backend ON (knob allows it AND the kernel probe succeeded).  False
+    on ``MPI4JAX_TPU_URING=0``, an incapable kernel, or a pre-uring
+    native library.  Mirror for diagnostics/tooling — the native layer
+    is the single authority."""
+    if uring_mode() == "0":
+        return False
+    from ..runtime import bridge
+
+    status = bridge.uring_status()
+    return status is not None and status.startswith("on")
 
 
 def trace_path():

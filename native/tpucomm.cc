@@ -36,6 +36,7 @@
 #include <fcntl.h>
 #include <immintrin.h>
 #include <linux/futex.h>
+#include <linux/io_uring.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <poll.h>
@@ -43,6 +44,7 @@
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -276,12 +278,31 @@ Comm* get_comm(int64_t h) {
   return it == g_comms.end() ? nullptr : it->second;
 }
 
+void count_sys_fwd();  // transport syscall counter (obs section below)
+
+/* EAGAIN here is reachable only when the uring backend put the mesh on
+ * non-blocking fds (a ring-creation failure then lands a direct caller
+ * on these loops); park in poll() instead of spinning.  On the URING=0
+ * path the fds are blocking unless a deadline is armed — and then the
+ * _dl variants serve — so this branch is dead there and the historic
+ * byte-for-byte behavior is untouched. */
+int io_wait_ready(int fd, bool wr) {
+  pollfd pf{fd, (short)(wr ? POLLOUT : POLLIN), 0};
+  count_sys_fwd();
+  return ::poll(&pf, 1, 60000);
+}
+
 int write_all(int fd, const void* buf, int64_t n) {
   const char* p = static_cast<const char*>(buf);
   while (n > 0) {
+    count_sys_fwd();
     ssize_t w = ::write(fd, p, (size_t)n);
     if (w <= 0) {
       if (w < 0 && (errno == EINTR)) continue;
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (io_wait_ready(fd, true) < 0 && errno != EINTR) return 1;
+        continue;
+      }
       return 1;
     }
     p += w;
@@ -293,9 +314,14 @@ int write_all(int fd, const void* buf, int64_t n) {
 int read_all(int fd, void* buf, int64_t n) {
   char* p = static_cast<char*>(buf);
   while (n > 0) {
+    count_sys_fwd();
     ssize_t r = ::read(fd, p, (size_t)n);
     if (r <= 0) {
       if (r < 0 && (errno == EINTR)) continue;
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (io_wait_ready(fd, false) < 0 && errno != EINTR) return 1;
+        continue;
+      }
       if (r == 0) errno = ECONNRESET;  // EOF: don't report stale "Success"
       return 1;
     }
@@ -321,6 +347,21 @@ int64_t g_obs_total = 0;              // appended since enable (kept + dropped)
 int64_t g_obs_dropped = 0;            // overwritten by overflow
 thread_local double g_obs_wait_acc = 0.0;
 
+/* Transport syscall counter: every socket-moving syscall (write/read/
+ * writev/send/recv/poll and io_uring_enter; futex parks excluded — they
+ * are scheduling, not wire) bumps it, so events carry a per-op syscall
+ * count and benchmarks read the process total (tpucomm_syscall_count).
+ * Process-global (relaxed) rather than thread-local: the writer/
+ * progress threads issue syscalls on BEHALF of the op executing on
+ * another thread, and a per-op window over the global counter
+ * attributes them to that op — exact for the serialized-op case the
+ * benchmarks measure, conserved in total always. */
+std::atomic<int64_t> g_syscalls{0};
+
+inline void count_sys() { g_syscalls.fetch_add(1, std::memory_order_relaxed); }
+
+void count_sys_fwd() { count_sys(); }  // callable from above-definition code
+
 void obs_append(const TpuObsEvent& ev) {
   std::lock_guard<std::mutex> lock(g_obs_mu);
   if (g_obs_ring.empty()) return;  // disabled raced with the op's scope
@@ -340,6 +381,7 @@ void obs_append(const TpuObsEvent& ev) {
 struct ObsScope {
   bool on;
   double t0 = 0, wait0 = 0, post = -1;
+  int64_t sys0 = 0;
   TpuObsEvent ev{};
   ObsScope(int op, int peer, int tag, int64_t nbytes, int algo = -1,
            double t_post = -1) {
@@ -352,6 +394,7 @@ struct ObsScope {
     ev.wire_bytes = nbytes;  // exact ops: the wire carries the payload
     ev.algo = algo;
     wait0 = g_obs_wait_acc;
+    sys0 = g_syscalls.load(std::memory_order_relaxed);
     post = t_post;
     t0 = now_s();
   }
@@ -371,6 +414,8 @@ struct ObsScope {
     ev.queue_s = t0 - start;
     ev.wait_s = g_obs_wait_acc - wait0;
     if (ev.wait_s > ev.dur_s - ev.queue_s) ev.wait_s = ev.dur_s - ev.queue_s;
+    int64_t ds = g_syscalls.load(std::memory_order_relaxed) - sys0;
+    ev.syscalls = ds > INT32_MAX ? INT32_MAX : (int32_t)(ds < 0 ? 0 : ds);
     obs_append(ev);
   }
 };
@@ -514,14 +559,24 @@ thread_local int64_t g_io_want = 0;
  * after which the usual any-progress-resets-the-clock rule applies. */
 thread_local double g_dl_post_anchor = 0;
 
+/* io_uring submission backend (defined after the fault section; probed
+ * once per process, one ring per thread).  uring_io_all implements the
+ * exact deadline/progress/anchor semantics of the poll loop below over
+ * submitted SQEs instead of poll+read/write pairs. */
+bool uring_ready();
+int uring_io_all(int fd, void* buf, int64_t n, bool wr, double t);
+
 /* Deadline-bounded read/write of exactly n bytes.  Returns 0 on
  * success, 1 on a socket error (errno describes it), 2 when the
  * deadline passed with zero bytes of progress (g_io_done / g_io_want
  * hold the transfer state).  `t` defaults to the job-wide knob; with
- * that unset this IS read_all/write_all. */
+ * that unset this IS read_all/write_all.  With MPI4JAX_TPU_URING
+ * active the transfer is submitted to the thread's io_uring instead
+ * (same deadline semantics, fewer syscalls). */
 template <bool kWrite>
 int io_all_deadline(int fd, void* buf, int64_t n, double t = -1.0) {
   if (t < 0) t = transport_timeout_s();
+  if (uring_ready()) return uring_io_all(fd, buf, n, kWrite, t);
   if (t <= 0)
     return kWrite ? write_all(fd, buf, n) : read_all(fd, buf, n);
   char* p = static_cast<char*>(buf);
@@ -542,12 +597,14 @@ int io_all_deadline(int fd, void* buf, int64_t n, double t = -1.0) {
       return 2;
     }
     pollfd pf{fd, (short)(kWrite ? POLLOUT : POLLIN), 0};
+    count_sys();
     int pr = ::poll(&pf, 1, (int)std::min(remain * 1000.0 + 1, 60000.0));
     if (pr < 0) {
       if (errno == EINTR) continue;
       return 1;
     }
     if (pr == 0) continue;  // loop re-checks the deadline
+    count_sys();
     ssize_t m = kWrite ? ::write(fd, p, (size_t)left)
                        : ::read(fd, p, (size_t)left);
     if (m <= 0) {
@@ -717,6 +774,721 @@ void fault_fire(Comm* c, int rank, int point, const char* what) {
   }
 }
 
+/* ============== zero-copy submission backend (UringEngine) ==============
+ *
+ * The transport floor below the progress engine: when MPI4JAX_TPU_URING
+ * resolves to on, every deadline-bounded transfer routes through a
+ * per-thread io_uring instead of the poll+read/write pairs — one
+ * io_uring_enter both submits and waits, so a small send (header and
+ * payload staged into one registered-buffer frame) or a small receive
+ * (header + payload speculatively read in one submission) costs ONE
+ * syscall where the poll path pays four; the drain loop's descriptor
+ * bursts ride single vectored submissions; and oversized sends go out
+ * as MSG_ZEROCOPY (IORING_OP_SEND_ZC) with the kernel's buffer-release
+ * notification consumed as a CQE before the op returns, so large
+ * payloads skip the kernel copy while the caller keeps the historic
+ * buffer-ownership contract.
+ *
+ * Everything layered above the byte movers is untouched: deadlines are
+ * progress-based and anchored at post time (the same g_dl_post_anchor
+ * handoff), poison frames and fault injection fire at the same logical
+ * points, the coalesced-frame wire format is byte-identical, and
+ * MPI4JAX_TPU_URING=0 keeps the poll-driven path for sanitizer builds
+ * and old kernels.  One ring per thread (rings are not thread-safe;
+ * the calling thread, the progress thread, and the writer thread each
+ * lazily own one), torn down at thread exit; a ring that loses track
+ * of an in-flight completion is marked broken and rebuilt. */
+
+/* ABI constants newer than the build host's kernel headers (the
+ * io_uring ABI is append-only; values from include/uapi/linux) */
+constexpr uint8_t kOpSendZc = 47;           /* IORING_OP_SEND_ZC (6.0) */
+#ifndef IORING_CQE_F_NOTIF
+#define IORING_CQE_F_NOTIF (1U << 3)
+#endif
+/* sqe->ioprio flag (6.2+): the buffer-release NOTIF cqe reports in its
+ * res whether the kernel actually avoided the copy */
+constexpr uint16_t kSendZcReportUsage = 1U << 3;
+constexpr uint32_t kNotifZcCopied = 1U << 31; /* IORING_NOTIF_USAGE_ZC_COPIED */
+
+constexpr int64_t kZcBytes = 64 * 1024;     /* MSG_ZEROCOPY chunk floor
+                                             * (op gate: zc_min_bytes) */
+constexpr int64_t kUringSmall = 32 * 1024;  /* staged single-frame ceiling
+                                             * (mirrors kEagerBytes) */
+constexpr size_t kUringStageBytes =
+    (size_t)kUringSmall + 4096;             /* frame staging + recv stash */
+
+struct KernelTimespec {  /* __kernel_timespec (s64/s64) */
+  int64_t tv_sec;
+  int64_t tv_nsec;
+};
+
+int g_uring_avail = 0;          /* resolved by uring_probe() */
+bool g_uring_zc = false;        /* kernel supports IORING_OP_SEND_ZC */
+char g_uring_reason[160] = "not probed";
+
+/* Adaptive MSG_ZEROCOPY: the kernel pins the pages but may still COPY
+ * at delivery (loopback and NIC-without-SG paths go through
+ * skb_orphan_frags_rx) and then the zero-copy send is all overhead —
+ * pinning plus a notification per send for nothing.  The NOTIF cqe
+ * reports which happened (kSendZcReportUsage); a streak of copied
+ * notifications with no true zero-copy turns SEND_ZC off process-wide
+ * and large sends ride plain submitted sends instead.  Kernels older
+ * than 6.2 reject the report flag (-EINVAL, retried once without), and
+ * then there is no signal — ZC stays on as probed. */
+std::atomic<bool> g_zc_report_ok{true};
+std::atomic<int> g_zc_copied_streak{0};
+std::atomic<bool> g_zc_fallback{false};
+constexpr int kZcCopiedStreakOff = 4;
+
+void zc_note_usage(int32_t res) {
+  if (!g_zc_report_ok.load(std::memory_order_relaxed)) return;
+  if ((uint32_t)res & kNotifZcCopied) {
+    int s = g_zc_copied_streak.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (s >= kZcCopiedStreakOff)
+      /* visible through tpucomm_uring_status() as
+       * "on(zerocopy-fallback)" — diag and the bench rows stamp it */
+      g_zc_fallback.store(true, std::memory_order_relaxed);
+  } else {
+    g_zc_copied_streak.store(0, std::memory_order_relaxed);
+  }
+}
+
+bool zc_enabled() {
+  return g_uring_zc && !g_zc_fallback.load(std::memory_order_relaxed);
+}
+
+/* Completion-envelope equivalence: a plain send completes once the
+ * kernel ACCEPTS the bytes (sndbuf plus whatever the receiver's kernel
+ * absorbs in flight), but a SEND_ZC's buffer release waits for the
+ * skbs to be FREED — past that window, for the receiving APPLICATION
+ * to consume.  Engaging zero-copy for a payload the kernel could have
+ * buffered would turn a buffered send into a rendezvous and deadlock
+ * cyclic schedules that the poll path (and the analysis match model)
+ * accept.  So ZC is gated to sends that exceed the kernel's maximum
+ * possible buffering — the TCP autotune ceilings tcp_wmem[2] +
+ * tcp_rmem[2] — where the poll path would also have waited on the
+ * receiver and the completion envelopes coincide. */
+int64_t proc_tcp_ceiling(const char* path, int64_t dflt) {
+  FILE* f = std::fopen(path, "re");
+  if (!f) return dflt;
+  long long lo = 0, mid = 0, hi = 0;
+  int n = std::fscanf(f, "%lld %lld %lld", &lo, &mid, &hi);
+  std::fclose(f);
+  return n == 3 && hi > 0 ? (int64_t)hi : dflt;
+}
+
+int64_t zc_min_bytes() {
+  static int64_t v = [] {
+    int64_t w = proc_tcp_ceiling("/proc/sys/net/ipv4/tcp_wmem", 4 << 20);
+    int64_t r = proc_tcp_ceiling("/proc/sys/net/ipv4/tcp_rmem", 6 << 20);
+    return std::max(kZcBytes, w + r);
+  }();
+  return v;
+}
+
+/* MPI4JAX_TPU_URING: auto (-1, probe) | 0 (off) | 1 (on, loud when the
+ * kernel cannot).  Strict: a typo'd knob must not silently change the
+ * submission path under a sanitizer build or a benchmark. */
+int uring_mode() {
+  static int m = [] {
+    const char* e = std::getenv("MPI4JAX_TPU_URING");
+    if (!e) return -1;
+    /* whitespace-trimmed, like config.uring_mode() (the Python mirror
+     * pins byte-for-byte parity) and the sibling knob parsers */
+    const char* b = e;
+    while (*b && std::isspace((unsigned char)*b)) ++b;
+    const char* t = b + std::strlen(b);
+    while (t > b && std::isspace((unsigned char)t[-1])) --t;
+    std::string v(b, t);
+    if (v.empty() || v == "auto") return -1;
+    if (v == "0") return 0;
+    if (v == "1") return 1;
+    std::fprintf(stderr,
+                 "tpucomm: cannot parse MPI4JAX_TPU_URING=%s (expected "
+                 "auto, 0, or 1)\n", e);
+    std::exit(2);
+    return 0;
+  }();
+  return m;
+}
+
+struct Uring {
+  int fd = -1;
+  void* ring_mem = MAP_FAILED;
+  size_t ring_bytes = 0;
+  void* sqe_mem = MAP_FAILED;
+  size_t sqe_bytes = 0;
+  unsigned* sq_head = nullptr;
+  unsigned* sq_tail = nullptr;
+  unsigned sq_mask = 0;
+  unsigned* sq_array = nullptr;
+  unsigned* cq_head = nullptr;
+  unsigned* cq_tail = nullptr;
+  unsigned cq_mask = 0;
+  io_uring_sqe* sqes = nullptr;
+  io_uring_cqe* cqes = nullptr;
+  uint64_t seq = 0;          /* user_data generator */
+  bool broken = false;       /* lost an in-flight CQE: rebuild the ring */
+  bool fixed_ok = true;      /* READ/WRITE_FIXED accepted on sockets */
+  bool registered = false;   /* stage is an IORING_REGISTER_BUFFERS pool */
+  std::vector<char> stage;   /* hot payload pool: staged small frames,
+                              * speculative receive stash */
+  std::vector<uint64_t> notifs; /* SEND_ZC buffer-release notifications
+                                 * still in flight (deferred: collected
+                                 * opportunistically by every CQE scan,
+                                 * forced by u_flush_notifs before a
+                                 * zero-copy send returns) */
+  ~Uring() {
+    if (sqe_mem != MAP_FAILED) ::munmap(sqe_mem, sqe_bytes);
+    if (ring_mem != MAP_FAILED) ::munmap(ring_mem, ring_bytes);
+    if (fd >= 0) ::close(fd);
+  }
+};
+
+/* `why` (optional) receives the failure reason.  Only the call_once
+ * probe passes the g_uring_reason global — per-thread ring creation in
+ * uring_acquire runs concurrently and must not race writers/readers of
+ * the process-wide status string. */
+Uring* uring_make(unsigned entries, char* why = nullptr,
+                  size_t why_len = 0) {
+  io_uring_params p{};
+  count_sys();
+  int fd = (int)::syscall(__NR_io_uring_setup, entries, &p);
+  if (fd < 0) {
+    if (why)
+      std::snprintf(why, why_len, "io_uring_setup: %s",
+                    std::strerror(errno));
+    return nullptr;
+  }
+  if (!(p.features & IORING_FEAT_SINGLE_MMAP) ||
+      !(p.features & IORING_FEAT_EXT_ARG) ||
+      !(p.features & IORING_FEAT_NODROP)) {
+    if (why)
+      std::snprintf(why, why_len,
+                    "kernel io_uring lacks SINGLE_MMAP/EXT_ARG/NODROP "
+                    "(features 0x%x; needs >= 5.11)", p.features);
+    ::close(fd);
+    return nullptr;
+  }
+  auto u = std::make_unique<Uring>();
+  u->fd = fd;
+  u->ring_bytes = std::max<size_t>(
+      p.sq_off.array + p.sq_entries * sizeof(unsigned),
+      p.cq_off.cqes + p.cq_entries * sizeof(io_uring_cqe));
+  u->ring_mem = ::mmap(nullptr, u->ring_bytes, PROT_READ | PROT_WRITE,
+                       MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQ_RING);
+  u->sqe_bytes = p.sq_entries * sizeof(io_uring_sqe);
+  u->sqe_mem = ::mmap(nullptr, u->sqe_bytes, PROT_READ | PROT_WRITE,
+                      MAP_SHARED | MAP_POPULATE, fd, IORING_OFF_SQES);
+  if (u->ring_mem == MAP_FAILED || u->sqe_mem == MAP_FAILED) {
+    if (why)
+      std::snprintf(why, why_len, "io_uring ring mmap: %s",
+                    std::strerror(errno));
+    return nullptr;
+  }
+  char* r = static_cast<char*>(u->ring_mem);
+  u->sq_head = reinterpret_cast<unsigned*>(r + p.sq_off.head);
+  u->sq_tail = reinterpret_cast<unsigned*>(r + p.sq_off.tail);
+  u->sq_mask = *reinterpret_cast<unsigned*>(r + p.sq_off.ring_mask);
+  u->sq_array = reinterpret_cast<unsigned*>(r + p.sq_off.array);
+  u->cq_head = reinterpret_cast<unsigned*>(r + p.cq_off.head);
+  u->cq_tail = reinterpret_cast<unsigned*>(r + p.cq_off.tail);
+  u->cq_mask = *reinterpret_cast<unsigned*>(r + p.cq_off.ring_mask);
+  u->cqes = reinterpret_cast<io_uring_cqe*>(r + p.cq_off.cqes);
+  u->sqes = static_cast<io_uring_sqe*>(u->sqe_mem);
+  u->stage.resize(kUringStageBytes);
+  struct iovec iov {u->stage.data(), u->stage.size()};
+  count_sys();
+  if (::syscall(__NR_io_uring_register, fd, IORING_REGISTER_BUFFERS, &iov,
+                1) == 0)
+    u->registered = true;  /* pinned pool: READ/WRITE_FIXED skip per-op
+                            * page pinning; soft — plain ops serve */
+  return u.release();
+}
+
+/* One-time probe: resolves availability + SEND_ZC support.  mode 1 on
+ * an incapable kernel warns loudly and serves the poll path — the CI
+ * legs probe tpucomm_uring_status first and SKIP visibly instead. */
+void uring_probe() {
+  static std::once_flag once;
+  std::call_once(once, [] {
+    if (uring_mode() == 0) {
+      std::snprintf(g_uring_reason, sizeof(g_uring_reason),
+                    "disabled (MPI4JAX_TPU_URING=0)");
+      return;
+    }
+    std::unique_ptr<Uring> probe(
+        uring_make(8, g_uring_reason, sizeof(g_uring_reason)));
+    if (!probe) {
+      if (uring_mode() == 1)
+        std::fprintf(stderr,
+                     "tpucomm: MPI4JAX_TPU_URING=1 but io_uring is "
+                     "unavailable (%s); serving the poll path\n",
+                     g_uring_reason);
+      return;
+    }
+    struct {
+      io_uring_probe p;   /* ops[0] flexible member lands on ops below */
+      io_uring_probe_op ops[64];
+    } pr{};
+    count_sys();
+    if (::syscall(__NR_io_uring_register, probe->fd, IORING_REGISTER_PROBE,
+                  &pr, 64) == 0 &&
+        pr.p.ops_len > kOpSendZc &&
+        (pr.ops[kOpSendZc].flags & IO_URING_OP_SUPPORTED))
+      g_uring_zc = true;
+    g_uring_avail = 1;
+  });
+}
+
+/* The calling thread's ring, or null (knob off, kernel can't, or this
+ * thread's ring creation failed).  A broken ring (lost CQE after a
+ * failed cancel) is torn down — the kernel reaps its in-flight state
+ * at fd close — and rebuilt once per breakage. */
+Uring* uring_acquire() {
+  uring_probe();
+  if (g_uring_avail != 1) return nullptr;
+  static thread_local std::unique_ptr<Uring> tl;
+  static thread_local bool tried = false;
+  if (tl && tl->broken) {
+    tl.reset();
+    tried = false;
+  }
+  if (!tried) {
+    tried = true;
+    tl.reset(uring_make(64));
+  }
+  return tl.get();
+}
+
+bool uring_ready() { return uring_acquire() != nullptr; }
+
+io_uring_sqe* u_sqe(Uring* u, uint8_t opcode, int fd, const void* addr,
+                    uint32_t len) {
+  unsigned tail = __atomic_load_n(u->sq_tail, __ATOMIC_RELAXED);
+  io_uring_sqe* s = &u->sqes[tail & u->sq_mask];
+  std::memset(s, 0, sizeof(*s));
+  s->opcode = opcode;
+  s->fd = fd;
+  s->addr = (uint64_t)(uintptr_t)addr;
+  s->len = len;
+  s->user_data = ++u->seq;
+  u->sq_array[tail & u->sq_mask] = tail & u->sq_mask;
+  __atomic_store_n(u->sq_tail, tail + 1, __ATOMIC_RELEASE);
+  return s;
+}
+
+bool u_cqe(Uring* u, io_uring_cqe* out) {
+  unsigned head = __atomic_load_n(u->cq_head, __ATOMIC_RELAXED);
+  if (head == __atomic_load_n(u->cq_tail, __ATOMIC_ACQUIRE)) return false;
+  *out = u->cqes[head & u->cq_mask];
+  __atomic_store_n(u->cq_head, head + 1, __ATOMIC_RELEASE);
+  return true;
+}
+
+/* submit + wait in one syscall.  wait_s < 0 waits unbounded; >= 0 uses
+ * the EXT_ARG timeout.  Returns 0 (caller re-checks CQEs/deadline) or
+ * -1 on a hard enter failure. */
+int u_enter(Uring* u, unsigned to_submit, unsigned min_complete,
+            double wait_s) {
+  io_uring_getevents_arg arg{};
+  KernelTimespec ts{};
+  unsigned flags = IORING_ENTER_GETEVENTS;
+  void* argp = nullptr;
+  size_t argsz = 0;
+  if (min_complete > 0 && wait_s >= 0) {
+    double w = std::min(std::max(wait_s, 0.0), 60.0);
+    ts.tv_sec = (int64_t)w;
+    ts.tv_nsec = (int64_t)((w - (double)ts.tv_sec) * 1e9);
+    arg.ts = (uint64_t)(uintptr_t)&ts;
+    flags |= IORING_ENTER_EXT_ARG;
+    argp = &arg;
+    argsz = sizeof(arg);
+  }
+  count_sys();
+  long r = ::syscall(__NR_io_uring_enter, u->fd, to_submit, min_complete,
+                     flags, argp, argsz);
+  if (r < 0 && errno != ETIME && errno != EINTR) return -1;
+  return 0;
+}
+
+/* A CQE that is not the op currently waited on: either a stale CQE of
+ * a cancelled earlier op (dropped) or a DEFERRED SEND_ZC buffer-release
+ * notification (consumed — and its usage report feeds the adaptive
+ * zero-copy fallback).  Every CQE scan routes misses through here so a
+ * deferred notification can never be mistaken for garbage. */
+void u_note_stale(Uring* u, const io_uring_cqe& c) {
+  if (!(c.flags & IORING_CQE_F_NOTIF)) return;
+  auto it = std::find(u->notifs.begin(), u->notifs.end(), c.user_data);
+  if (it == u->notifs.end()) return;
+  u->notifs.erase(it);
+  zc_note_usage(c.res);
+}
+
+/* Collect every deferred SEND_ZC notification — called before a
+ * zero-copy send_msg returns so the caller's buffer-ownership contract
+ * holds (the kernel has released the pinned pages), WITHOUT having
+ * serialized each chunk against the receiver mid-stream.  Bounded: a
+ * notification that never arrives marks the ring broken (rebuilt on
+ * next acquire; fd close releases the kernel-side state). */
+int u_flush_notifs(Uring* u, double budget_s) {
+  if (u->notifs.empty()) return 0;
+  double limit = now_s() + (budget_s > 0 ? budget_s : 60.0);
+  for (;;) {
+    io_uring_cqe c;
+    bool any = false;
+    while (u_cqe(u, &c)) {
+      any = true;
+      u_note_stale(u, c);
+    }
+    if (u->notifs.empty()) return 0;
+    if (!any && now_s() > limit) break;
+    if (u_enter(u, 0, 1, 0.2) < 0) break;
+  }
+  u->broken = true;
+  u->notifs.clear();
+  return 1;
+}
+
+/* Submit one I/O SQE and wait for its completion — and, for SEND_ZC,
+ * for the kernel's buffer-release notification CQE (the MSG_ZEROCOPY
+ * errqueue event surfaced through the ring), so the caller's buffer-
+ * ownership contract survives the zero-copy send.  With `defer_notif`
+ * the notification is NOT waited for here: it is parked on u->notifs
+ * (collected opportunistically by later CQE scans, forced by
+ * u_flush_notifs before the enclosing send returns) so back-to-back
+ * zero-copy chunks pipeline instead of serializing on the receiver.
+ * Returns the op's res (> 0 bytes; 0 = EOF on a receive; < 0 =
+ * -errno).  When the progress deadline expires first the in-flight SQE
+ * is cancelled (and its CQE drained) and *timed_out is set; a drain
+ * that fails marks the ring broken, so a recycled user_data can never
+ * be mis-matched. */
+int64_t u_do(Uring* u, uint8_t opcode, int fd, const void* p, int64_t len,
+             uint16_t buf_index, double deadline, bool* timed_out,
+             bool defer_notif = false) {
+  *timed_out = false;
+  io_uring_sqe* s = u_sqe(u, opcode, fd, p,
+                          (uint32_t)std::min<int64_t>(len, 1 << 30));
+  if (opcode == IORING_OP_READ_FIXED || opcode == IORING_OP_WRITE_FIXED)
+    s->buf_index = buf_index;
+  if (opcode == kOpSendZc &&
+      g_zc_report_ok.load(std::memory_order_relaxed))
+    s->ioprio = kSendZcReportUsage;  /* NOTIF res reports copied vs zc */
+  const uint64_t ud = s->user_data;
+  unsigned to_submit = 1;
+  bool got_main = false, need_notif = false;
+  int64_t res = 0;
+  for (;;) {
+    io_uring_cqe c;
+    while (u_cqe(u, &c)) {
+      if (c.user_data != ud) {
+        u_note_stale(u, c);  /* deferred notif or cancelled-op residue */
+        continue;
+      }
+      if (c.flags & IORING_CQE_F_NOTIF) {
+        need_notif = false;
+        zc_note_usage(c.res);
+        continue;
+      }
+      got_main = true;
+      res = c.res;
+      if (c.flags & IORING_CQE_F_MORE) need_notif = true;
+    }
+    if (got_main && need_notif && defer_notif && res > 0) {
+      u->notifs.push_back(ud);
+      return res;
+    }
+    if (got_main && !need_notif) return res;
+    double wait_s = -1.0;
+    if (deadline > 0) {
+      double remain = deadline - now_s();
+      if (remain <= 0) {
+        if (!got_main) {
+          /* cancel the in-flight SQE and drain its CQE so the ring
+           * stays coherent for the next op */
+          io_uring_sqe* cs = u_sqe(u, IORING_OP_ASYNC_CANCEL, -1, nullptr, 0);
+          cs->addr = ud;
+          const uint64_t cud = cs->user_data;
+          bool seen_cancel = false;
+          double limit = now_s() + 5.0;
+          unsigned sub = 1;
+          while (!(got_main && !need_notif) || !seen_cancel) {
+            io_uring_cqe d;
+            bool any = false;
+            while (u_cqe(u, &d)) {
+              any = true;
+              if (d.user_data == cud) {
+                seen_cancel = true;
+              } else if (d.user_data == ud) {
+                if (d.flags & IORING_CQE_F_NOTIF) need_notif = false;
+                else {
+                  got_main = true;
+                  if (d.flags & IORING_CQE_F_MORE) need_notif = true;
+                }
+              } else {
+                u_note_stale(u, d);
+              }
+            }
+            if ((got_main && !need_notif) && seen_cancel) break;
+            if (!any && now_s() > limit) {
+              u->broken = true;
+              break;
+            }
+            if (u_enter(u, sub, 1, 0.2) < 0) {
+              u->broken = true;
+              break;
+            }
+            sub = 0;
+          }
+        }
+        *timed_out = true;
+        return 0;
+      }
+      wait_s = std::min(remain + 0.001, 60.0);
+    }
+    if (u_enter(u, to_submit, 1, wait_s) < 0) {
+      u->broken = true;
+      return -EIO;
+    }
+    to_submit = 0;
+  }
+}
+
+/* The poll loop's exact deadline/progress/anchor semantics over
+ * submitted SQEs.  `stage_fixed` marks transfers whose buffer lives in
+ * the registered staging pool (READ/WRITE_FIXED, no per-op pinning);
+ * writes past the buffering ceiling (zc_min_bytes) go out as SEND_ZC
+ * when the kernel supports it. */
+int u_io_all(Uring* u, int fd, char* p, int64_t n, bool wr, double t,
+             bool stage_fixed = false) {
+  int64_t left = n;
+  double deadline = 0;
+  if (t > 0) {
+    deadline = now_s() + t;
+    if (g_dl_post_anchor > 0) {
+      double anchored = g_dl_post_anchor + t;
+      if (anchored < deadline) deadline = anchored;
+      g_dl_post_anchor = 0;
+    }
+  }
+  /* zero-copy only past the kernel's autotune buffering ceiling (see
+   * zc_min_bytes): below it a plain send completes without the
+   * receiver, a ZC buffer release cannot, and the mismatch deadlocks
+   * cyclic schedules the poll path accepts */
+  const bool zc_ok = wr && !stage_fixed && zc_enabled() && n > zc_min_bytes();
+  while (left > 0) {
+    uint8_t op;
+    uint16_t bidx = 0;
+    if (wr) {
+      if (zc_ok && left >= kZcBytes)
+        op = kOpSendZc;
+      else if (stage_fixed && u->registered && u->fixed_ok)
+        op = IORING_OP_WRITE_FIXED;
+      else
+        op = IORING_OP_SEND;
+    } else {
+      op = (stage_fixed && u->registered && u->fixed_ok)
+               ? IORING_OP_READ_FIXED
+               : IORING_OP_RECV;
+    }
+    bool timed_out = false;
+    /* zero-copy chunks defer their buffer-release notification (the
+     * flush below collects them) — waiting per chunk would serialize
+     * the whole payload against the receiver's consumption */
+    int64_t m = u_do(u, op, fd, p, left, bidx, deadline, &timed_out,
+                     op == kOpSendZc);
+    if (timed_out) {
+      g_io_done = n - left;
+      g_io_want = n;
+      u_flush_notifs(u, 0.5);  /* best effort: the job is tearing down */
+      return 2;
+    }
+    if (m <= 0) {
+      if (m == -EINTR || m == -EAGAIN) continue;
+      if ((m == -EINVAL || m == -EOPNOTSUPP) &&
+          (op == IORING_OP_WRITE_FIXED || op == IORING_OP_READ_FIXED)) {
+        u->fixed_ok = false;  /* kernel rejects fixed ops here: fall back */
+        continue;
+      }
+      if (m == -EINVAL && op == kOpSendZc &&
+          g_zc_report_ok.load(std::memory_order_relaxed)) {
+        /* kernel < 6.2: no REPORT_USAGE ioprio flag — retry without
+         * (and without the adaptive copied signal, see zc_note_usage) */
+        g_zc_report_ok.store(false, std::memory_order_relaxed);
+        continue;
+      }
+      if (m == 0 && !wr) {
+        errno = ECONNRESET;  /* EOF, not "Success" */
+        return 1;
+      }
+      errno = m < 0 ? (int)-m : EIO;
+      u_flush_notifs(u, 0.5);
+      return 1;
+    }
+    p += m;
+    left -= m;
+    if (t > 0) deadline = now_s() + t;  /* any progress resets the clock */
+  }
+  /* the zero-copy ownership contract: every deferred notification must
+   * land before the caller's buffer is considered released */
+  if (wr && !u->notifs.empty() &&
+      u_flush_notifs(u, t > 0 ? t : 0) != 0) {
+    errno = EIO;
+    return 1;
+  }
+  return 0;
+}
+
+int uring_io_all(int fd, void* buf, int64_t n, bool wr, double t) {
+  Uring* u = uring_acquire();
+  if (!u) return 1;  /* unreachable: callers gate on uring_ready() */
+  return u_io_all(u, fd, static_cast<char*>(buf), n, wr, t);
+}
+
+/* One speculative receive: up to `len` bytes in a single submission
+ * (blocks until at least one byte, exactly like the poll path's header
+ * read).  Returns 0 and the byte count, 1 on error, 2 on deadline. */
+int u_recv_some(Uring* u, int fd, char* p, int64_t len, int64_t* got,
+                double t, bool stage_fixed) {
+  double deadline = 0;
+  if (t > 0) {
+    deadline = now_s() + t;
+    if (g_dl_post_anchor > 0) {
+      double anchored = g_dl_post_anchor + t;
+      if (anchored < deadline) deadline = anchored;
+      g_dl_post_anchor = 0;
+    }
+  }
+  for (;;) {
+    uint8_t op = (stage_fixed && u->registered && u->fixed_ok)
+                     ? IORING_OP_READ_FIXED
+                     : IORING_OP_RECV;
+    bool timed_out = false;
+    int64_t m = u_do(u, op, fd, p, len, 0, deadline, &timed_out);
+    if (timed_out) {
+      g_io_done = 0;
+      g_io_want = len;
+      return 2;
+    }
+    if (m <= 0) {
+      if (m == -EINTR || m == -EAGAIN) continue;
+      if ((m == -EINVAL || m == -EOPNOTSUPP) && op == IORING_OP_READ_FIXED) {
+        u->fixed_ok = false;
+        continue;
+      }
+      if (m == 0) {
+        errno = ECONNRESET;
+        return 1;
+      }
+      errno = (int)-m;
+      return 1;
+    }
+    *got = m;
+    return 0;
+  }
+}
+
+/* Vectored deadline-bounded write: the drain loop's descriptor-burst
+ * twin of io_all_deadline (iovecs are advanced in place on partial
+ * writes; wire bytes are EXACTLY the concatenated frames).  Routes to
+ * one OP_WRITEV submission per attempt under uring, poll+writev pairs
+ * otherwise. */
+void iov_consume(struct iovec** piov, int* pcnt, size_t done) {
+  struct iovec* iov = *piov;
+  int cnt = *pcnt;
+  while (done > 0 && cnt > 0) {
+    if (done >= iov->iov_len) {
+      done -= iov->iov_len;
+      iov++;
+      cnt--;
+    } else {
+      iov->iov_base = static_cast<char*>(iov->iov_base) + done;
+      iov->iov_len -= done;
+      done = 0;
+    }
+  }
+  *piov = iov;
+  *pcnt = cnt;
+}
+
+int writev_all_dl(int fd, struct iovec* iov, int iovcnt, int64_t total) {
+  const double t = transport_timeout_s();
+  int64_t left = total;
+  Uring* u = uring_acquire();
+  if (u) {
+    double deadline = 0;
+    if (t > 0) {
+      deadline = now_s() + t;
+      if (g_dl_post_anchor > 0) {
+        double anchored = g_dl_post_anchor + t;
+        if (anchored < deadline) deadline = anchored;
+        g_dl_post_anchor = 0;
+      }
+    }
+    while (left > 0) {
+      bool timed_out = false;
+      int64_t m = u_do(u, IORING_OP_WRITEV, fd, iov, iovcnt, 0, deadline,
+                       &timed_out);
+      if (timed_out) {
+        g_io_done = total - left;
+        g_io_want = total;
+        return 2;
+      }
+      if (m <= 0) {
+        if (m == -EINTR || m == -EAGAIN) continue;
+        errno = m < 0 ? (int)-m : EIO;
+        return 1;
+      }
+      left -= m;
+      iov_consume(&iov, &iovcnt, (size_t)m);
+      if (t > 0) deadline = now_s() + t;
+    }
+    return 0;
+  }
+  double deadline = t > 0 ? now_s() + t : 0;
+  if (t > 0 && g_dl_post_anchor > 0) {
+    double anchored = g_dl_post_anchor + t;
+    if (anchored < deadline) deadline = anchored;
+    g_dl_post_anchor = 0;
+  }
+  while (left > 0) {
+    if (t > 0) {
+      double remain = deadline - now_s();
+      if (remain <= 0) {
+        g_io_done = total - left;
+        g_io_want = total;
+        return 2;
+      }
+      pollfd pf{fd, POLLOUT, 0};
+      count_sys();
+      int pr = ::poll(&pf, 1, (int)std::min(remain * 1000.0 + 1, 60000.0));
+      if (pr < 0) {
+        if (errno == EINTR) continue;
+        return 1;
+      }
+      if (pr == 0) continue;
+    }
+    count_sys();
+    ssize_t w = ::writev(fd, iov, iovcnt);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        /* no deadline armed means no poll() above paces this loop; on
+         * the uring-made-nonblocking fds an EAGAIN must park like
+         * write_all does, not spin */
+        if (t <= 0 && io_wait_ready(fd, true) < 0 && errno != EINTR)
+          return 1;
+        continue;
+      }
+      return 1;
+    }
+    left -= w;
+    iov_consume(&iov, &iovcnt, (size_t)w);
+    if (t > 0) deadline = now_s() + t;
+  }
+  return 0;
+}
+
 /* ============== job-wide abort propagation (poison frames) ==============
  *
  * When this process aborts (any FAIL surfacing to the Python bridge),
@@ -728,16 +1500,25 @@ void fault_fire(Comm* c, int rank, int point, const char* what) {
  * timeouts to cascade rank by rank. */
 constexpr int32_t kPoisonTag = -7707;
 
-/* Consume a poison frame whose header is already read; always fails. */
-int poison_fail(Comm* c, int source, const MsgHeader& h) {
+/* Consume a poison frame whose header is already read; always fails.
+ * `pre`/`pre_len` hand over payload bytes a speculative uring receive
+ * already pulled off the socket. */
+int poison_fail_pre(Comm* c, int source, const MsgHeader& h,
+                    const char* pre, int64_t pre_len) {
   char text[448] = {0};
   int64_t nb = std::min<int64_t>(h.nbytes, (int64_t)sizeof(text) - 1);
+  int64_t take = std::min(nb, pre_len);
+  if (take > 0) std::memcpy(text, pre, (size_t)take);
   /* best effort: the aborter shuts the socket down right after the
    * frame, so a partial payload ends in EOF, not a hang */
-  if (nb > 0) read_all_dl(c->socks[source], text, nb);
+  if (nb > take) read_all_dl(c->socks[source], text + take, nb - take);
   text[sizeof(text) - 1] = 0;
   FAIL(c, "rank %d aborted the job: %s", source,
        text[0] ? text : "(no detail)");
+}
+
+int poison_fail(Comm* c, int source, const MsgHeader& h) {
+  return poison_fail_pre(c, source, h, nullptr, 0);
 }
 
 void self_deliver(Comm* c, int tag, const void* buf, int64_t nbytes) {
@@ -750,8 +1531,21 @@ int send_msg_tcp(Comm* c, int dest, int tag, const void* buf,
                  int64_t nbytes) {
   fault_fire(c, g_job_rank, FP_SEND, "send");
   MsgHeader h{nbytes, tag, c->comm_id};
-  int rc = write_all_dl(c->socks[dest], &h, sizeof(h));
-  if (!rc) rc = write_all_dl(c->socks[dest], buf, nbytes);
+  int rc;
+  Uring* u;
+  if (nbytes <= kUringSmall && (u = uring_acquire()) != nullptr) {
+    /* one staged frame, one submission: header + payload go out in a
+     * single io_uring_enter from the registered staging pool (the poll
+     * path pays two writes, four syscalls with a deadline armed) */
+    char* st = u->stage.data();
+    std::memcpy(st, &h, sizeof(h));
+    if (nbytes > 0) std::memcpy(st + sizeof(h), buf, (size_t)nbytes);
+    rc = u_io_all(u, c->socks[dest], st, (int64_t)sizeof(h) + nbytes, true,
+                  transport_timeout_s(), /*stage_fixed=*/true);
+  } else {
+    rc = write_all_dl(c->socks[dest], &h, sizeof(h));
+    if (!rc) rc = write_all_dl(c->socks[dest], buf, nbytes);
+  }
   if (rc) FAIL_IO(c, rc, "send to %d", dest);
   return 0;
 }
@@ -910,10 +1704,26 @@ bool header_matches(const Comm* c, const MsgHeader& h, int tag) {
  * sub-message, that payload lands directly in the user buffer (no
  * staging copy) and *consumed is set; every other sub-message stages
  * in c->pending[source] in arrival order. */
-int stage_coalesced(Comm* c, int source, const MsgHeader& outer, int tag,
-                    void* buf, int64_t nbytes, int32_t* out_tag,
-                    int64_t* out_count, bool* consumed) {
+/* `pre`/`pre_len` hand over container bytes a speculative uring receive
+ * already pulled off the socket (consumed before any further socket
+ * reads — arrival order is preserved exactly). */
+int stage_coalesced_pre(Comm* c, int source, const MsgHeader& outer, int tag,
+                        void* buf, int64_t nbytes, int32_t* out_tag,
+                        int64_t* out_count, bool* consumed,
+                        const char* pre, int64_t pre_len) {
   if (consumed) *consumed = false;
+  int64_t pre_off = 0;
+  auto rd = [&](void* dst, int64_t n) -> int {
+    char* d = static_cast<char*>(dst);
+    int64_t take = std::min(n, pre_len - pre_off);
+    if (take > 0) {
+      std::memcpy(d, pre + pre_off, (size_t)take);
+      pre_off += take;
+      d += take;
+      n -= take;
+    }
+    return n > 0 ? read_all_dl(c->socks[source], d, n) : 0;
+  };
   int64_t remaining = outer.nbytes;
   bool first = true;
   while (remaining > 0) {
@@ -921,7 +1731,7 @@ int stage_coalesced(Comm* c, int source, const MsgHeader& outer, int tag,
     if (remaining < (int64_t)sizeof(sh))
       FAIL(c, "corrupt coalesced frame from rank %d (%lld trailing bytes)",
            source, (long long)remaining);
-    int rc = read_all_dl(c->socks[source], &sh, sizeof(sh));
+    int rc = rd(&sh, sizeof(sh));
     if (rc) FAIL_IO(c, rc, "recv coalesced header from %d", source);
     remaining -= sizeof(sh);
     if (sh.comm_id != c->comm_id || sh.nbytes < 0 || sh.nbytes > remaining)
@@ -932,7 +1742,7 @@ int stage_coalesced(Comm* c, int source, const MsgHeader& outer, int tag,
         sh.nbytes <= nbytes) {
       /* pre-posted receive: land the head message straight in the user
        * buffer instead of staging it */
-      rc = read_all_dl(c->socks[source], buf, sh.nbytes);
+      rc = rd(buf, sh.nbytes);
       if (rc) FAIL_IO(c, rc, "recv coalesced payload from %d", source);
       if (out_tag) *out_tag = sh.tag;
       if (out_count) *out_count = sh.nbytes;
@@ -942,7 +1752,7 @@ int stage_coalesced(Comm* c, int source, const MsgHeader& outer, int tag,
       m.hdr = sh;
       m.data.resize((size_t)sh.nbytes);
       if (sh.nbytes > 0) {
-        rc = read_all_dl(c->socks[source], m.data.data(), sh.nbytes);
+        rc = rd(m.data.data(), sh.nbytes);
         if (rc) FAIL_IO(c, rc, "recv coalesced payload from %d", source);
       }
       c->pending[source].push_back(std::move(m));
@@ -950,7 +1760,22 @@ int stage_coalesced(Comm* c, int source, const MsgHeader& outer, int tag,
     remaining -= sh.nbytes;
     first = false;
   }
+  if (pre_off < pre_len)
+    /* the speculative read ran past the whole container — only possible
+     * when the awaited message is shorter than posted, which the strict
+     * caller is about to abort on; fail with its wording here so the
+     * over-read can never silently desynchronize the stream */
+    FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
+         source, (long long)nbytes,
+         (long long)(outer.nbytes - (int64_t)sizeof(MsgHeader)));
   return 0;
+}
+
+int stage_coalesced(Comm* c, int source, const MsgHeader& outer, int tag,
+                    void* buf, int64_t nbytes, int32_t* out_tag,
+                    int64_t* out_count, bool* consumed) {
+  return stage_coalesced_pre(c, source, outer, tag, buf, nbytes, out_tag,
+                             out_count, consumed, nullptr, 0);
 }
 
 /* Consume the head of c->pending[source] into a posted receive, with
@@ -1009,6 +1834,7 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
    * the timeout and busy-spin the level-triggered poll */
   std::vector<int64_t> peeked(ranks.size(), 0);
   for (;;) {
+    count_sys();
     int n = ::poll(fds.data(), fds.size(), t > 0 ? 100 : -1);
     if (n < 0) {
       if (errno == EINTR) continue;
@@ -1029,6 +1855,7 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
         /* POLLIN also fires for EOF; peek the header to tell a real
          * matching frame from a mismatch or a peer that exited */
         MsgHeader h{};
+        count_sys();
         ssize_t p = ::recv(fds[i].fd, &h, sizeof(h),
                            MSG_PEEK | MSG_DONTWAIT);
         if (p == (ssize_t)sizeof(h)) {
@@ -1098,13 +1925,91 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
   }
 }
 
+/* Strict-receive fast path over the uring backend: header AND payload
+ * speculatively pulled in ONE submission into the registered stash
+ * (the sender wrote them contiguously, so they almost always arrive
+ * together) — one syscall where the poll path pays four.  Safe because
+ * the channel is strictly ordered: the frame at the head IS the one
+ * this receive awaits, and every divergence is recoverable —
+ *   - a coalesced container's over-pulled content is handed to the
+ *     splitter as a prefix (arrival order preserved bit-for-bit),
+ *   - a poison frame's text rides the prefix into the abort message,
+ *   - any mismatched header fails exactly like the classic path (the
+ *     job is aborting; stream position no longer matters).
+ * Callers: strict exact-size receives only (recv_msg) — wildcard and
+ * status receives keep the classic two-stage path. */
+int uring_recv_frame(Comm* c, Uring* u, int source, int tag, void* buf,
+                     int64_t nbytes, int64_t* out_count) {
+  const int fd = c->socks[source];
+  const int64_t want = (int64_t)sizeof(MsgHeader) + nbytes;
+  char* st = u->stage.data();
+  int64_t got = 0;
+  {
+    /* first bytes = header arrival = the blocked share (the sender has
+     * not reached the matching send until they appear) */
+    ObsWaitTimer wt;
+    int rc = u_recv_some(u, fd, st, want, &got, transport_timeout_s(),
+                         /*stage_fixed=*/true);
+    if (rc) FAIL_IO(c, rc, "recv header from %d", source);
+    while (got < (int64_t)sizeof(MsgHeader)) {
+      int64_t more = 0;
+      rc = u_recv_some(u, fd, st + got, want - got, &more,
+                       transport_timeout_s(), /*stage_fixed=*/true);
+      if (rc) FAIL_IO(c, rc, "recv header from %d", source);
+      got += more;
+    }
+  }
+  MsgHeader h;
+  std::memcpy(&h, st, sizeof(h));
+  const char* body = st + sizeof(h);
+  const int64_t body_got = got - (int64_t)sizeof(h);
+  if (h.tag == kPoisonTag)
+    return poison_fail_pre(c, source, h, body, body_got);
+  if (h.comm_id != c->comm_id)
+    FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
+         "is comm %d — ops on sibling communicators must run in a "
+         "consistent order on both endpoints", source, h.comm_id,
+         c->comm_id);
+  if (h.tag == kCoalescedTag) {
+    bool consumed = false;
+    if (stage_coalesced_pre(c, source, h, tag, buf, nbytes, nullptr,
+                            out_count, &consumed, body, body_got))
+      return 1;
+    if (consumed) return 0;
+    return consume_pending(c, source, tag, buf, nbytes, nullptr, nullptr,
+                           out_count);
+  }
+  if (h.tag != tag)
+    FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
+         tag, source, h.tag);
+  if (h.nbytes > nbytes)
+    FAIL(c, "message truncated: rank %d sent %lld bytes into a %lld-byte "
+         "buffer", source, (long long)h.nbytes, (long long)nbytes);
+  int64_t take = std::min(body_got, h.nbytes);
+  if (take > 0) std::memcpy(buf, body, (size_t)take);
+  if (h.nbytes > take) {
+    int rc = u_io_all(u, fd, static_cast<char*>(buf) + take,
+                      h.nbytes - take, false, transport_timeout_s());
+    if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
+  } else if (body_got > h.nbytes) {
+    /* over-pulled past a SHORT frame: only reachable when the strict
+     * caller is about to abort on the size check — abort with its
+     * wording here, never leave the stream desynchronized */
+    FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
+         source, (long long)nbytes, (long long)h.nbytes);
+  }
+  if (out_count) *out_count = h.nbytes;
+  return 0;
+}
+
 /* Full-featured receive: ANY_TAG / ANY_SOURCE wildcards and short
  * messages allowed (buffer larger than the payload — MPI receive
  * semantics), with the actual source/tag/byte-count reported for status
  * introspection.  The strict recv_msg below keeps the exact-match
  * contract collectives rely on. */
 int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
-                    int32_t* out_src, int32_t* out_tag, int64_t* out_count) {
+                    int32_t* out_src, int32_t* out_tag, int64_t* out_count,
+                    bool strict_exact = false) {
   fault_fire(c, g_job_rank, FP_RECV, "recv");
   if (source == kAnySource) {
     /* a queued self-message is already complete — it wins immediately,
@@ -1162,6 +2067,14 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
   if (ring_p2p_on(c))
     return shm_recv_status(c, source, tag, buf, nbytes, out_src, out_tag,
                            out_count);
+  Uring* u;
+  if (strict_exact && tag != kAnyTag && nbytes > 0 &&
+      nbytes <= kUringSmall && (u = uring_acquire()) != nullptr)
+    /* strict exact-size receive (recv_msg says so EXPLICITLY — a
+     * status caller passing null src/tag still keeps legal
+     * short-message semantics): one speculative submission pulls the
+     * whole frame (see uring_recv_frame) */
+    return uring_recv_frame(c, u, source, tag, buf, nbytes, out_count);
   if (out_src) *out_src = source;
   MsgHeader h{};
   int rc;
@@ -1204,7 +2117,8 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
 
 int recv_msg(Comm* c, int source, int tag, void* buf, int64_t nbytes) {
   int64_t count = 0;
-  if (recv_msg_status(c, source, tag, buf, nbytes, nullptr, nullptr, &count))
+  if (recv_msg_status(c, source, tag, buf, nbytes, nullptr, nullptr, &count,
+                      /*strict_exact=*/true))
     return 1;
   if (count != nbytes)
     FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
@@ -4172,16 +5086,49 @@ bool coalescible(const EngineOp* o) {
          !ring_p2p_on(o->comm) && o->comm->socks[o->peer] >= 0;
 }
 
+/* One obs event per logical send of a batched drain-loop write (the
+ * whole burst's syscalls are attributed to the FIRST event so per-op
+ * sums stay exact). */
+void engine_obs_burst(EngineOp** ops, int n, int dest, double tw0,
+                      int64_t sys0) {
+  if (!g_obs_on.load(std::memory_order_relaxed)) return;
+  double tw1 = now_s();
+  int64_t ds = g_syscalls.load(std::memory_order_relaxed) - sys0;
+  for (int i = 0; i < n; i++) {
+    TpuObsEvent ev{};
+    ev.op = TPU_OBS_SEND;
+    ev.peer = dest;
+    ev.tag = ops[i]->tag;
+    ev.nbytes = ops[i]->snb;
+    ev.wire_bytes = ops[i]->snb;
+    ev.algo = -1;
+    ev.t_start = ops[i]->t_post;
+    ev.dur_s = tw1 - ops[i]->t_post;
+    ev.queue_s = tw0 - ops[i]->t_post;
+    if (ev.queue_s < 0) ev.queue_s = 0;
+    if (ev.queue_s > ev.dur_s) ev.queue_s = ev.dur_s;
+    ev.syscalls =
+        i == 0 ? (int32_t)std::min<int64_t>(ds, INT32_MAX) : 0;
+    obs_append(ev);
+  }
+}
+
 /* Write a run of adjacent detached sends (same comm, same peer) as ONE
  * kCoalescedTag frame.  Tags and sizes ride as per-message sub-headers;
- * the receive side splits them back apart.  Returns the shared rc. */
+ * the receive side splits them back apart.  Returns the shared rc.
+ * The outer header is assembled INTO the scratch buffer, so the whole
+ * container leaves in one write (one SQE under uring) — byte-identical
+ * wire to the historic header-then-body write pair. */
 int engine_write_coalesced(Engine* e, EngineOp** ops, int n) {
   Comm* c = ops[0]->comm;
   const int dest = ops[0]->peer;
   int64_t total = 0;
   for (int i = 0; i < n; i++) total += (int64_t)sizeof(MsgHeader) + ops[i]->snb;
-  e->scratch.resize((size_t)total);
+  e->scratch.resize((size_t)(total + (int64_t)sizeof(MsgHeader)));
   char* p = e->scratch.data();
+  MsgHeader outer{total, kCoalescedTag, c->comm_id};
+  std::memcpy(p, &outer, sizeof(outer));
+  p += sizeof(outer);
   for (int i = 0; i < n; i++) {
     /* one injector hit per LOGICAL send: MPI4JAX_TPU_FAULT's after=N
      * counts user sends, not wire frames, so a fault lands at the same
@@ -4199,10 +5146,9 @@ int engine_write_coalesced(Engine* e, EngineOp** ops, int n) {
   });
   g_dl_post_anchor = ops[0]->t_post;
   double tw0 = now_s();
-  MsgHeader outer{total, kCoalescedTag, c->comm_id};
-  int fd = c->socks[dest];
-  int io = write_all_dl(fd, &outer, sizeof(outer));
-  if (!io) io = write_all_dl(fd, e->scratch.data(), total);
+  int64_t sys0 = g_syscalls.load(std::memory_order_relaxed);
+  int io = write_all_dl(c->socks[dest], e->scratch.data(),
+                        total + (int64_t)sizeof(MsgHeader));
   g_dl_post_anchor = 0;
   int rc = 0;
   if (io) {
@@ -4221,24 +5167,69 @@ int engine_write_coalesced(Engine* e, EngineOp** ops, int n) {
     set_last_error(c->rank, "coalesced send to %d failed: %s", dest, why);
     rc = 1;
   }
-  if (g_obs_on.load(std::memory_order_relaxed)) {
-    double tw1 = now_s();
-    for (int i = 0; i < n; i++) {
-      TpuObsEvent ev{};
-      ev.op = TPU_OBS_SEND;
-      ev.peer = dest;
-      ev.tag = ops[i]->tag;
-      ev.nbytes = ops[i]->snb;
-      ev.wire_bytes = ops[i]->snb;
-      ev.algo = -1;
-      ev.t_start = ops[i]->t_post;
-      ev.dur_s = tw1 - ops[i]->t_post;
-      ev.queue_s = tw0 - ops[i]->t_post;
-      if (ev.queue_s < 0) ev.queue_s = 0;
-      if (ev.queue_s > ev.dur_s) ev.queue_s = ev.dur_s;
-      obs_append(ev);
-    }
+  engine_obs_burst(ops, n, dest, tw0, sys0);
+  return rc;
+}
+
+/* True for a detached TCP send the drain loop may merge into a
+ * vectored write (no container framing — the wire bytes are EXACTLY
+ * the N individual frames). */
+bool batchable(const EngineOp* o) {
+  return o->kind == TPU_OBS_SEND && o->detached &&
+         o->peer != o->comm->rank && o->peer >= 0 &&
+         o->peer < o->comm->size && !ring_p2p_on(o->comm) &&
+         o->comm->socks[o->peer] >= 0;
+}
+
+/* Write a run of adjacent detached sends that are NOT coalescible
+ * (above the threshold, or coalescing off) as one vectored write: the
+ * historic drain loop issued one header+payload write pair per
+ * descriptor even when several completed descriptors targeted the same
+ * socket back-to-back — batching them into a single writev keeps the
+ * wire bytes bit-identical while the URING=0 escape hatch also sheds
+ * the per-descriptor syscalls. */
+int engine_write_batch(Engine* e, EngineOp** ops, int n) {
+  (void)e;
+  Comm* c = ops[0]->comm;
+  const int dest = ops[0]->peer;
+  std::vector<MsgHeader> hdrs((size_t)n);
+  std::vector<struct iovec> iov((size_t)n * 2);
+  int64_t total = 0;
+  for (int i = 0; i < n; i++) {
+    fault_fire(c, g_job_rank, FP_SEND, "send");
+    hdrs[(size_t)i] = MsgHeader{ops[i]->snb, ops[i]->tag, c->comm_id};
+    iov[(size_t)(2 * i)] = {&hdrs[(size_t)i], sizeof(MsgHeader)};
+    iov[(size_t)(2 * i + 1)] = {const_cast<void*>(ops[i]->sbuf),
+                                (size_t)ops[i]->snb};
+    total += (int64_t)sizeof(MsgHeader) + ops[i]->snb;
   }
+  LogScope log(c->rank, "SendBatch", [&] {
+    return "to " + std::to_string(dest) + " (" + std::to_string(n) +
+           " frames, " + std::to_string(total) + " bytes)";
+  });
+  g_dl_post_anchor = ops[0]->t_post;
+  double tw0 = now_s();
+  int64_t sys0 = g_syscalls.load(std::memory_order_relaxed);
+  int io = writev_all_dl(c->socks[dest], iov.data(), 2 * n, total);
+  g_dl_post_anchor = 0;
+  int rc = 0;
+  if (io) {
+    char why[160];
+    if (io == 2)
+      std::snprintf(why, sizeof(why),
+                    "timed out after %.0f s with %lld/%lld bytes moved "
+                    "(MPI4JAX_TPU_TIMEOUT_S)",
+                    transport_timeout_s(), (long long)g_io_done,
+                    (long long)g_io_want);
+    else
+      std::snprintf(why, sizeof(why), "%s", std::strerror(errno));
+    std::fprintf(stderr,
+                 "tpucomm r%d: batched send to %d (%d frames) failed: %s\n",
+                 c->rank, dest, n, why);
+    set_last_error(c->rank, "batched send to %d failed: %s", dest, why);
+    rc = 1;
+  }
+  engine_obs_burst(ops, n, dest, tw0, sys0);
   return rc;
 }
 
@@ -4256,10 +5247,25 @@ void engine_loop(Comm* root) {
     }
     EngineOp* op = e->slots[t % e->cap];
     int run = 1;
+    bool as_container = false;
     if (coalescible(op)) {
+      /* small adjacent sends merge into ONE container frame (the
+       * historic coalescing wire format, unchanged) */
+      as_container = true;
       while (t + run < h && run < kCoalesceMaxRun) {
         EngineOp* nxt = e->slots[(t + run) % e->cap];
         if (!coalescible(nxt) || nxt->comm != op->comm ||
+            nxt->peer != op->peer)
+          break;
+        run++;
+      }
+      if (run == 1) as_container = false;
+    } else if (batchable(op)) {
+      /* larger detached sends to one socket back-to-back: one vectored
+       * write of the individual frames (bit-identical wire bytes) */
+      while (t + run < h && run < kCoalesceMaxRun) {
+        EngineOp* nxt = e->slots[(t + run) % e->cap];
+        if (!batchable(nxt) || coalescible(nxt) || nxt->comm != op->comm ||
             nxt->peer != op->peer)
           break;
         run++;
@@ -4268,7 +5274,8 @@ void engine_loop(Comm* root) {
     if (run > 1) {
       EngineOp* batch[kCoalesceMaxRun];
       for (int i = 0; i < run; i++) batch[i] = e->slots[(t + i) % e->cap];
-      int rc = engine_write_coalesced(e, batch, run);
+      int rc = as_container ? engine_write_coalesced(e, batch, run)
+                            : engine_write_batch(e, batch, run);
       e->tail.store(t + run, std::memory_order_release);
       e->tseq.fetch_add(1, std::memory_order_release);
       shm_futex_wake_all(&e->tseq);
@@ -4605,8 +5612,13 @@ static int64_t comm_bootstrap(int rank, int size, int base_port,
    * and a blocking socket write of a large payload would otherwise park
    * in the kernel until ALL bytes are queued — unwakeable past any
    * deadline when the peer stops draining.  Without the knob the fds
-   * stay blocking and the historic loops serve untouched. */
-  if (transport_timeout_s() > 0) {
+   * stay blocking and the historic loops serve untouched.  The uring
+   * backend ALSO wants non-blocking fds: a blocking submitted send is
+   * punted to an io-wq kernel worker (a context switch per op, and a
+   * parked worker past any deadline), where a non-blocking one
+   * completes through the ring's internal poll — so an active uring
+   * resolves the same fd mode the deadline does. */
+  if (transport_timeout_s() > 0 || uring_ready()) {
     for (int fd : c->socks)
       if (fd >= 0) {
         int fl = ::fcntl(fd, F_GETFL, 0);
@@ -4929,6 +5941,28 @@ int tpucomm_recv(int64_t h, void* buf, int64_t nbytes, int source, int tag) {
   op.peer2 = source;
   op.tag = tag;
   return engine_submit(c, &op);
+}
+
+const char* tpucomm_uring_status(void) {
+  uring_probe();
+  if (uring_mode() == 0) return "off";
+  if (g_uring_avail == 1) {
+    if (!g_uring_zc) return "on(no-zerocopy)";
+    /* adaptive: the kernel reported it copies zero-copy sends on this
+     * path (loopback) — large sends ride plain submitted sends now */
+    if (g_zc_fallback.load(std::memory_order_relaxed))
+      return "on(zerocopy-fallback)";
+    return "on";
+  }
+  /* g_uring_reason is frozen once the probe resolves; the format
+   * buffer is per-thread so concurrent status calls cannot race */
+  static thread_local char buf[200];
+  std::snprintf(buf, sizeof(buf), "unavailable(%s)", g_uring_reason);
+  return buf;
+}
+
+int64_t tpucomm_syscall_count(void) {
+  return g_syscalls.load(std::memory_order_relaxed);
 }
 
 const char* tpucomm_last_error(void) {

@@ -150,6 +150,20 @@ int tpucomm_topo_info(int64_t h, int32_t* out_island_of,
  * analog of MPI_Error_string); "" if none. */
 const char* tpucomm_last_error(void);
 
+/* Resolved state of the io_uring submission backend (MPI4JAX_TPU_URING;
+ * probes the kernel on first call): "on", "on(no-zerocopy)" (ring up,
+ * kernel predates IORING_OP_SEND_ZC), "off" (knob = 0), or
+ * "unavailable(<reason>)".  This symbol doubles as the layout probe for
+ * the uring generation: a library without it never writes
+ * TpuObsEvent.syscalls and has no uring path at all — the Python side
+ * must treat such a build as uring-unavailable, never misparse it. */
+const char* tpucomm_uring_status(void);
+
+/* Process-total transport syscalls (write/read/writev/poll/
+ * io_uring_enter; futex parks excluded) since load — the benchmarks'
+ * syscalls-per-message denominator reads deltas of this. */
+int64_t tpucomm_syscall_count(void);
+
 /* Job-wide abort propagation: best-effort write one poison control
  * frame (carrying tpucomm_last_error's text) to every peer of every
  * socket-owning communicator and shut the sockets down.  Peers blocked
@@ -314,7 +328,13 @@ struct TpuObsEvent {
   int32_t tier;    /* TpuObsTier: 0 flat/whole-op, 1 intra-island leg,
                     * 2 inter-island leg (hierarchical collectives emit
                     * one extra event per leg carrying the tier) */
-  int32_t _pad;    /* keep the slot 8-byte aligned (72-byte slots) */
+  int32_t syscalls; /* transport syscalls (write/read/writev/poll/
+                    * io_uring_enter — futexes excluded) issued while
+                    * this op executed, so stats/traces attribute the
+                    * submit-batching win.  Occupies the former padding
+                    * slot (layout unchanged, still 72-byte slots);
+                    * probe tpucomm_uring_status to tell a library that
+                    * writes it from one whose slot is always 0. */
 };
 
 /* Arm (enabled=1) or disarm (0) recording.  `capacity` is the ring size
@@ -362,7 +382,28 @@ double tpucomm_obs_clock(void);
  *                                4096; 0 disables coalescing)
  *   MPI4JAX_TPU_QUEUE_DEPTH     submission-queue capacity in ops
  *                                (default 1024; posting parks when
- *                                full) */
+ *                                full)
+ *   MPI4JAX_TPU_URING           io_uring submission backend under the
+ *                                same descriptor queue (auto | 0 | 1,
+ *                                strict parser): batched submits, a
+ *                                registered staging pool, and
+ *                                MSG_ZEROCOPY (IORING_OP_SEND_ZC) for
+ *                                sends past the kernel's buffering
+ *                                ceiling (tcp_wmem[2]+tcp_rmem[2] —
+ *                                below it a plain send completes
+ *                                without the receiver but a ZC buffer
+ *                                release cannot, and the envelope
+ *                                mismatch would deadlock cyclic
+ *                                schedules the poll path accepts).
+ *                                auto (default)
+ *                                probes the kernel; 0 keeps the poll-
+ *                                driven path bit-for-bit (sanitizer
+ *                                builds, old kernels); 1 asks for it
+ *                                loudly (falls back with a warning
+ *                                when the kernel cannot).  Wire bytes,
+ *                                deadlines (measured from post time),
+ *                                poison, and fault injection are
+ *                                identical on both paths. */
 
 /* op kinds reuse the TpuObsOp codes; scalar roles per kind:
  *   SEND       sbuf,snbytes -> peer(dest), tag

@@ -140,18 +140,54 @@ def _build(target):
     assert res.returncode == 0, f"make {target} failed:\n{res.stderr[-2000:]}"
 
 
+def _uring_status_of(so, preload, san_env):
+    """The sanitized build's RESOLVED uring state, probed in a fresh
+    subprocess (the knob resolves once per process)."""
+    code = (
+        "import ctypes\n"
+        "lib = ctypes.CDLL(%r)\n"
+        "lib.tpucomm_uring_status.restype = ctypes.c_char_p\n"
+        "print('status=' + lib.tpucomm_uring_status().decode())\n" % so
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120,
+        env={**os.environ, "LD_PRELOAD": preload, **san_env,
+             "MPI4JAX_TPU_URING": "1"},
+    )
+    for line in res.stdout.splitlines():
+        if line.startswith("status="):
+            return line[len("status="):]
+    return "probe-failed: " + (res.stderr or res.stdout)[-200:]
+
+
+def _uring_env(uring, so, preload, san_env):
+    """Env for a sanitized uring leg — skips VISIBLY (never silently
+    green on the poll path) when the kernel lacks io_uring."""
+    if uring == "1":
+        status = _uring_status_of(so, preload, san_env)
+        if not status.startswith("on"):
+            pytest.skip(f"io_uring leg skipped: sanitized build reports "
+                        f"{status!r} on this kernel (URING=0 leg still "
+                        "covered)")
+    return {"MPI4JAX_TPU_URING": uring}
+
+
+@pytest.mark.parametrize("uring", ["0", "1"])
 @pytest.mark.parametrize("shm", ["on", "off"])
-def test_tsan_loopback_pair(shm):
+def test_tsan_loopback_pair(shm, uring):
     _build("tsan")
     preload = _preload_path("libtsan.so")
     so = os.path.join(SO_DIR, "libtpucomm_tsan.so")
-    extra = {"MPI4JAX_TPU_JOBID": f"tsan{shm}{os.getpid()}"}
+    san = {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"}
+    extra = {"MPI4JAX_TPU_JOBID": f"tsan{shm}{uring}{os.getpid()}",
+             **_uring_env(uring, so, preload, san)}
     if shm == "off":
         extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
     _run_pair(
-        so, preload,
-        {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"},
-        46200 + (os.getpid() + (7 if shm == "on" else 0)) % 900,
+        so, preload, san,
+        46200 + (os.getpid() + (7 if shm == "on" else 0)
+                 + (29 if uring == "1" else 0)) % 900,
         extra,
     )
 
@@ -264,23 +300,26 @@ def _run_group(src, n_ranks, so_path, preload, san_env, port, extra_env):
         assert f"san-rank-ok {rank}" in out, out
 
 
+@pytest.mark.parametrize("uring", ["0", "1"])
 @pytest.mark.parametrize("shm", ["on", "off"])
-def test_tsan_progress_engine_three_ranks(shm):
+def test_tsan_progress_engine_three_ranks(shm, uring):
     _build("tsan")
     preload = _preload_path("libtsan.so")
     so = os.path.join(SO_DIR, "libtpucomm_tsan.so")
+    san = {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"}
     extra = {
-        "MPI4JAX_TPU_JOBID": f"tsaneng{shm}{os.getpid()}",
+        "MPI4JAX_TPU_JOBID": f"tsaneng{shm}{uring}{os.getpid()}",
         "MPI4JAX_TPU_PROGRESS_THREAD": "1",
         "MPI4JAX_TPU_COALESCE_BYTES": "4096",
+        **_uring_env(uring, so, preload, san),
     }
     if shm == "off":
         # TCP path: this is where detached sends coalesce on the wire
         extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
     _run_group(
-        _ENGINE_RANK_SRC, 3, so, preload,
-        {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"},
-        48200 + (os.getpid() + (13 if shm == "on" else 0)) % 900,
+        _ENGINE_RANK_SRC, 3, so, preload, san,
+        48200 + (os.getpid() + (13 if shm == "on" else 0)
+                 + (31 if uring == "1" else 0)) % 900,
         extra,
     )
 
@@ -489,20 +528,23 @@ def test_tsan_shrink_under_load_three_ranks(shm):
     )
 
 
+@pytest.mark.parametrize("uring", ["0", "1"])
 @pytest.mark.parametrize("shm", ["on", "off"])
-def test_asan_loopback_pair(shm):
+def test_asan_loopback_pair(shm, uring):
     _build("asan")
     preload = _preload_path("libasan.so")
     so = os.path.join(SO_DIR, "libtpucomm_asan.so")
-    extra = {"MPI4JAX_TPU_JOBID": f"asan{shm}{os.getpid()}"}
+    san = {
+        "ASAN_OPTIONS": "exitcode=66 detect_leaks=0 halt_on_error=1",
+        "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1",
+    }
+    extra = {"MPI4JAX_TPU_JOBID": f"asan{shm}{uring}{os.getpid()}",
+             **_uring_env(uring, so, preload, san)}
     if shm == "off":
         extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
     _run_pair(
-        so, preload,
-        {
-            "ASAN_OPTIONS": "exitcode=66 detect_leaks=0 halt_on_error=1",
-            "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1",
-        },
-        47200 + (os.getpid() + (7 if shm == "on" else 0)) % 900,
+        so, preload, san,
+        47200 + (os.getpid() + (7 if shm == "on" else 0)
+                 + (37 if uring == "1" else 0)) % 900,
         extra,
     )
