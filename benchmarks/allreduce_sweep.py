@@ -33,6 +33,17 @@ in-jit (the serving-traffic shape the async progress engine targets)
 and at the raw transport — instead of GB/s, which hides small-message
 regressions (the BENCH_r05 72 us figure was invisible in the
 bandwidth curves).
+
+``--knob-grid`` (a DRIVER mode — run it directly, not under the
+launcher) launches one sub-job per hand-set knob combination
+(``MPI4JAX_TPU_COLL_ALGO`` x ``MPI4JAX_TPU_COLL_QUANT`` x, under
+``--fake-hosts``, ``MPI4JAX_TPU_HIER``) and emits every record stamped
+with the combination it ran under (``grid_env`` + the ``knobs`` stamp
+every ``obs.bench_record`` row carries), closing with one
+``knob_grid_best`` summary per size — the best any ONE process-wide
+hand-set combination achieves, which is exactly the baseline a single
+``python -m mpi4jax_tpu.tune --joint`` run has to beat
+(docs/benchmarks.md § Joint tuner, BENCH_joint_tuner.json).
 """
 
 import argparse
@@ -412,6 +423,113 @@ def world_latency_rank(sizes=None):
             print(json.dumps(rec), flush=True)
 
 
+def knob_grid_driver(args):
+    """Launch one sub-job per hand-set knob combination and emit every
+    record stamped with the combination, plus a best-per-size summary.
+
+    The grid is the space an operator can actually SET process-wide:
+    a forced algorithm (or the engine default), the quantization gate,
+    and — on a partitioned shape — the hierarchy gate.  Per-size
+    mix-and-match is exactly what a single hand-set combination cannot
+    do; the joint tuner's cache can, which is the comparison this mode
+    exists to anchor."""
+    import subprocess
+    import tempfile
+
+    np_ = args.np or 4
+    sizes = args.sizes or "4194304,16777216"
+    here = os.path.abspath(__file__)
+    grid_tmp = tempfile.mkdtemp(prefix="m4j_knob_grid_")
+    combos = []
+    for algo in (None, "ring", "rd", "tree"):
+        for quant in (None, "force"):
+            base = {}
+            if algo:
+                base["MPI4JAX_TPU_COLL_ALGO"] = algo
+            if quant:
+                base["MPI4JAX_TPU_COLL_QUANT"] = quant
+            combos.append(base)
+    if args.fake_hosts:
+        # the hierarchy axis only exists on a multi-island shape (on a
+        # flat comm HIER=force is a no-op and would double the grid
+        # for identical measurements)
+        combos += [dict(c, MPI4JAX_TPU_HIER="force") for c in combos]
+
+    rows = []
+    for i, combo in enumerate(combos):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        if args.fake_hosts:
+            env["MPI4JAX_TPU_FAKE_HOSTS"] = args.fake_hosts
+        else:
+            # an inherited partition would give the sub-jobs a
+            # multi-island topology while the grid skips the HIER axis
+            # and the summary claims a flat shape — the grid's shape is
+            # --fake-hosts or nothing
+            env.pop("MPI4JAX_TPU_FAKE_HOSTS", None)
+            env["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+        env.pop("MPI4JAX_TPU_COLL_ALGO", None)
+        env.pop("MPI4JAX_TPU_COLL_QUANT", None)
+        env.pop("MPI4JAX_TPU_HIER", None)
+        # the grid is the HAND-SET baseline: a persistent tune cache
+        # (possibly written by the joint tuner itself) auto-loading
+        # into the no-ALGO combos would make the comparison circular —
+        # point the cache knob at a guaranteed-missing file
+        env["MPI4JAX_TPU_TUNE_CACHE"] = os.path.join(
+            grid_tmp, "no_tune_cache.json")
+        env.update(combo)
+        cmd = [sys.executable, "-m", "mpi4jax_tpu.runtime.launch",
+               "-n", str(np_)]
+        if args.port:
+            # a fresh port block per sub-job: the previous job's
+            # sockets may still sit in TIME_WAIT on the shared block
+            cmd += ["--port", str(args.port + i * (np_ + 2))]
+        cmd += [here, "--world", "--sizes", sizes]
+        res = subprocess.run(cmd, env=env, capture_output=True, text=True)
+        label = ",".join(f"{k.rsplit('_', 1)[-1]}={v}"
+                         for k, v in sorted(combo.items())) or "defaults"
+        if res.returncode != 0:
+            print(json.dumps({"mode": "knob-grid", "grid_env": combo,
+                              "error": f"exit {res.returncode}",
+                              "stderr_tail": res.stderr[-500:]}),
+                  flush=True)
+            continue
+        for line in res.stdout.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("op") != "allreduce":
+                continue
+            rec["mode"] = "knob-grid"
+            rec["grid_env"] = combo
+            rec["grid_label"] = label
+            rows.append(rec)
+            print(json.dumps(rec), flush=True)
+
+    best = {}
+    for rec in rows:
+        key = int(rec["bytes"])
+        raw = float(rec.get("raw_seconds") or rec["seconds"])
+        if key not in best or raw < best[key]["raw_seconds"]:
+            best[key] = {"raw_seconds": raw,
+                         "grid_label": rec["grid_label"],
+                         "grid_env": rec["grid_env"],
+                         "resolved_algo": rec.get("resolved_algo"),
+                         "raw_eff_GBps_per_chip":
+                             rec.get("raw_eff_GBps_per_chip")}
+    print(json.dumps({"mode": "knob-grid-best", "ranks": np_,
+                      "fake_hosts": args.fake_hosts or None,
+                      "combos_swept": len(combos),
+                      "best": {str(k): v
+                               for k, v in sorted(best.items())}}),
+          flush=True)
+    return 0 if rows else 1
+
+
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--max-mb", type=float, default=64)
@@ -428,7 +546,25 @@ if __name__ == "__main__":
                     help="small-message mode (world tier): 1 B - 64 KiB "
                          "sweep emitting p50/p95/p99 us per op instead of "
                          "GB/s")
+    ap.add_argument("--knob-grid", action="store_true",
+                    help="driver mode: sweep the hand-set knob "
+                         "combination grid (one launcher sub-job per "
+                         "COLL_ALGO x COLL_QUANT [x HIER] point) and "
+                         "emit per-combo records + a best-per-size "
+                         "summary — the baseline tune --joint must beat")
+    ap.add_argument("--np", type=int, default=None,
+                    help="--knob-grid: ranks per sub-job (default 4)")
+    ap.add_argument("--port", type=int, default=None,
+                    help="--knob-grid: launcher base port")
+    ap.add_argument("--fake-hosts", default=None,
+                    help="--knob-grid: virtual host partition for the "
+                         "sub-jobs (adds the MPI4JAX_TPU_HIER axis)")
     args = ap.parse_args()
+    if args.knob_grid:
+        if os.environ.get("MPI4JAX_TPU_RANK"):
+            ap.error("--knob-grid is a driver mode; run it directly, "
+                     "not under the launcher")
+        sys.exit(knob_grid_driver(args))
     if args.world and args.pallas:
         ap.error("--pallas applies to the mesh tier; drop --world")
     if args.algos and not args.world:

@@ -87,6 +87,85 @@ def default_bucket_bytes() -> int:
     return DEFAULT_BUCKET_BYTES
 
 
+def env_cost_model():
+    """The cost model named by ``MPI4JAX_TPU_TUNE_MODEL`` (written by
+    ``python -m mpi4jax_tpu.tune --joint``), or None.  The compiler
+    only probes the disk when the knob is set explicitly, so plans —
+    and the golden-plan corpus — compiled without it are byte-stable
+    regardless of what a previous tuner run left in ``~/.cache``."""
+    path = os.environ.get("MPI4JAX_TPU_TUNE_MODEL", "").strip()
+    if not path:
+        return None
+    try:
+        try:
+            from ..tune import _model
+        except ImportError:  # standalone analysis load (no package)
+            import importlib.util
+
+            spec = importlib.util.spec_from_file_location(
+                "m4j_plan_cost_model",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             os.pardir, "tune", "_model.py"))
+            _model = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(_model)
+        return _model.load_model(path)
+    except Exception as e:
+        # warn-and-continue is the contract: an unusable model file —
+        # unreadable, wrong version, OR structurally corrupt (a shape
+        # from_json never anticipated) — must never take down plan
+        # compilation; the static defaults serve
+        import warnings
+
+        warnings.warn(f"ignoring unusable cost model "
+                      f"MPI4JAX_TPU_TUNE_MODEL={path}: {e!r}")
+        return None
+
+
+def _model_bucket_bytes(events_by_rank, model) -> Optional[int]:
+    """The cost model's gradient-bucket ceiling for THIS schedule: the
+    ceiling minimizing the predicted cost of syncing the schedule's
+    bucketable allreduce bytes (the dominant rank's total).  None when
+    the schedule has nothing to bucket or the model no allreduce data —
+    the static default then stands."""
+    ladder_max = max(_TUNE_BUCKET_LADDER)
+    total = 0
+    for events in events_by_rank.values():
+        rank_total = sum(
+            ev.nbytes or 0 for ev in events
+            if ev.kind == "allreduce" and ev.nbytes
+            and ev.nbytes <= ladder_max)
+        total = max(total, rank_total)
+    if total <= 0:
+        return None
+    return model.best_bucket_bytes(total, ladder=_TUNE_BUCKET_LADDER)
+
+
+def _model_group_cap(events_by_rank, model) -> Optional[int]:
+    """The cost model's concurrency-group cap: keyed on the schedule's
+    median deferrable-send payload, using the measured allreduce curve
+    as the transport proxy (sends are not swept per-algorithm — the
+    collective alpha/beta is the same wire).  None without send events
+    or model data."""
+    sends = sorted(ev.nbytes for events in events_by_rank.values()
+                   for ev in events
+                   if ev.kind == "send" and ev.nbytes)
+    if not sends:
+        return None
+    median = sends[len(sends) // 2]
+    combos = model.combos("allreduce")
+    if not combos:
+        return None
+    combo = "ring" if "ring" in combos else combos[0]
+    cap = model.suggested_group_cap(median, op="allreduce", combo=combo,
+                                    default=_deps.MAX_GROUP)
+    return cap if cap != _deps.MAX_GROUP else None
+
+
+#: bucket-size candidates the model evaluates (mirrors
+#: tune._model.BUCKET_LADDER without importing it on the hot path)
+_TUNE_BUCKET_LADDER = tuple(1 << p for p in range(16, 23))
+
+
 @dataclass
 class PlanOp:
     """One scheduled op in one rank's execution plan.
@@ -233,6 +312,10 @@ class ExecutionPlan:
     detach_threshold: int = 0
     coalesce_bytes: int = 0
     bucket_bytes: int = 0
+    #: provenance of model-informed choices ("" = static defaults; the
+    #: golden corpus compiles without a model, so the field stays absent
+    #: there)
+    model: str = ""
     ranks: Dict[int, RankPlan] = field(default_factory=dict)
     proved: bool = False
     proof: dict = field(default_factory=dict)
@@ -277,7 +360,7 @@ class ExecutionPlan:
         return "\n".join(lines)
 
     def to_json(self) -> dict:
-        return {
+        out = {
             "format": PLAN_FORMAT,
             "analyzer_version": self.analyzer_version,
             "cache_key": self.cache_key,
@@ -292,6 +375,9 @@ class ExecutionPlan:
             "ranks": {str(r): rp.to_json()
                       for r, rp in sorted(self.ranks.items())},
         }
+        if self.model:
+            out["model"] = self.model
+        return out
 
     @classmethod
     def from_json(cls, data: dict) -> "ExecutionPlan":
@@ -306,6 +392,7 @@ class ExecutionPlan:
             detach_threshold=int(data.get("detach_threshold", 0)),
             coalesce_bytes=int(data.get("coalesce_bytes", 0)),
             bucket_bytes=int(data.get("bucket_bytes", 0)),
+            model=str(data.get("model", "")),
             proved=bool(data.get("proved", False)),
             proof=dict(data.get("proof", {})),
             reasons=list(data.get("reasons", [])),
@@ -405,6 +492,7 @@ def build_plan(
     max_group: int = _deps.MAX_GROUP,
     aggressive: bool = True,
     force_trivial: bool = False,
+    cost_model=None,
 ) -> ExecutionPlan:
     """Compile per-rank schedules into an (unproven) execution plan.
 
@@ -416,6 +504,15 @@ def build_plan(
 
     ``aggressive=False`` builds the fallback plan: groups and marks but
     no recv hoisting (used when the prover rejects the hoisted plan).
+
+    ``cost_model`` (a ``tune._model.CostModel``; default: the file
+    ``MPI4JAX_TPU_TUNE_MODEL`` names, if any) informs the two sizing
+    choices the compiler otherwise makes statically: the
+    gradient-bucket ceiling (the predicted-cheapest point of the bucket
+    ladder for this schedule's bucketable bytes — an EXPLICIT
+    ``MPI4JAX_TPU_PLAN_BUCKET_KB`` still wins) and the concurrency-
+    group cap (deeper groups where the measured curve says dispatch
+    dominates).  The plan records the provenance (``model`` field).
     """
     if world_size is None:
         world_size = len(events_by_rank)
@@ -423,8 +520,26 @@ def build_plan(
         detach_threshold = _match.default_detach_threshold()
     if coalesce_bytes is None:
         coalesce_bytes = default_coalesce_bytes()
+    if cost_model is None:
+        cost_model = env_cost_model()
+    model_notes = []
     if bucket_bytes is None:
         bucket_bytes = default_bucket_bytes()
+        if (cost_model is not None
+                and not os.environ.get("MPI4JAX_TPU_PLAN_BUCKET_KB",
+                                       "").strip()):
+            picked = _model_bucket_bytes(events_by_rank, cost_model)
+            if picked is not None and picked != bucket_bytes:
+                model_notes.append(
+                    f"bucket_bytes {picked} (model; static default "
+                    f"{bucket_bytes})")
+                bucket_bytes = picked
+    if cost_model is not None and max_group == _deps.MAX_GROUP:
+        cap = _model_group_cap(events_by_rank, cost_model)
+        if cap is not None:
+            model_notes.append(
+                f"group cap {cap} (model; static default {max_group})")
+            max_group = cap
     plan = ExecutionPlan(
         world_size=world_size,
         cache_key=schedule_cache_key(events_by_rank, world_size),
@@ -432,6 +547,9 @@ def build_plan(
         coalesce_bytes=coalesce_bytes,
         bucket_bytes=bucket_bytes,
     )
+    if model_notes:
+        plan.model = "; ".join(model_notes)
+        plan.reasons.append("cost model consulted: " + plan.model)
 
     blockers = sorted(
         {f.kind for f in findings
@@ -686,16 +804,20 @@ def compile_schedules(
     coalesce_bytes: Optional[int] = None,
     bucket_bytes: Optional[int] = None,
     max_interleavings: int = MAX_INTERLEAVINGS,
+    cost_model=None,
 ) -> ExecutionPlan:
     """Build the most aggressive provable plan: try hoisting + grouping,
     fall back to no-hoist, then to the trivial (unrewritten) plan.  The
     returned plan always carries ``proved`` and the downgrade reasons —
     an unsafe rewrite is *demonstrably* rejected, never silently run."""
+    if cost_model is None:
+        # resolve the env-named model once for all three attempts
+        cost_model = env_cost_model()
     kw = dict(
         world_size=world_size, findings=findings,
         value_deps_by_rank=value_deps_by_rank,
         detach_threshold=detach_threshold, coalesce_bytes=coalesce_bytes,
-        bucket_bytes=bucket_bytes,
+        bucket_bytes=bucket_bytes, cost_model=cost_model,
     )
     plan = build_plan(events_by_rank, comms, aggressive=True, **kw)
     if prove_plan(events_by_rank, comms, plan, max_interleavings):
@@ -746,9 +868,7 @@ def save_plan(plan: ExecutionPlan, path: Optional[str] = None) -> str:
     return path
 
 
-def load_plan(path: str) -> ExecutionPlan:
-    with open(path) as f:
-        data = json.load(f)
+def _plan_from_data(data: dict, path: str) -> ExecutionPlan:
     plan = ExecutionPlan.from_json(data)
     if plan.analyzer_version != ANALYZER_VERSION:
         raise ValueError(
@@ -758,6 +878,12 @@ def load_plan(path: str) -> ExecutionPlan:
             "stale plans invalidate instead of misexecuting)"
         )
     return plan
+
+
+def load_plan(path: str) -> ExecutionPlan:
+    with open(path) as f:
+        data = json.load(f)
+    return _plan_from_data(data, path)
 
 
 def cached_plan(cache_key: str) -> Optional[ExecutionPlan]:
@@ -771,3 +897,133 @@ def cached_plan(cache_key: str) -> Optional[ExecutionPlan]:
     if plan.cache_key != cache_key or not plan.proved:
         return None
     return plan
+
+
+# ---------------------------------------------------------------------------
+# elastic-safe plans: bundles (one plan per survivable world size) and
+# in-recovery re-derivation
+
+
+def events_from_plan(plan: ExecutionPlan):
+    """Reconstruct the per-rank schedules a plan was compiled from:
+    ``(events_by_rank, comms)`` ready for :func:`compile_schedules`.
+
+    A :class:`PlanOp` carries every field of the event's *semantic
+    identity* (``_events.canonical_event`` — exactly what the schedule
+    cache key hashes), so the reconstruction round-trips the cache key
+    bit-for-bit; only presentation (source-site strings) is lost.  This
+    is what lets elastic recovery re-derive and re-PROVE a stored plan
+    from the plan file alone, with no program re-trace."""
+    events_by_rank: Dict[int, List[CommEvent]] = {}
+    for rank, rp in sorted(plan.ranks.items()):
+        events_by_rank[rank] = [
+            CommEvent(
+                rank=rank, idx=i, kind=op.kind, comm=tuple(op.comm),
+                dest=op.dest, source=op.source, lo=op.lo, hi=op.hi,
+                root=op.root, tag=op.tag, sendtag=op.sendtag,
+                recvtag=op.recvtag, reduce_op=op.reduce_op,
+                dtype=op.dtype,
+                shape=None if op.shape is None else tuple(op.shape),
+                status=bool(op.status),
+            )
+            for i, op in enumerate(rp.ops)
+        ]
+    # compilable plans serve the world communicator only (build_plan
+    # leaves sub-comm schedules unrewritten; planrt.install refuses
+    # them), so the comm map is exactly the world membership
+    comms = {(0,): tuple(sorted(plan.ranks))}
+    return events_by_rank, comms
+
+
+def recompile_plan(stored: ExecutionPlan, *,
+                   max_interleavings: int = MAX_INTERLEAVINGS,
+                   cost_model=None) -> ExecutionPlan:
+    """Re-derive and re-prove a stored plan from its own schedule: the
+    full compile pipeline (dependence DAG, hoist points, equivalence
+    prover) runs fresh on the reconstructed events.  The result's
+    ``cache_key`` must equal the stored one — the signature check the
+    elastic reinstall path enforces (a mismatch means the file does not
+    contain the schedule it claims to)."""
+    events_by_rank, comms = events_from_plan(stored)
+    return compile_schedules(
+        events_by_rank, comms, world_size=stored.world_size,
+        detach_threshold=stored.detach_threshold,
+        coalesce_bytes=stored.coalesce_bytes,
+        bucket_bytes=stored.bucket_bytes,
+        max_interleavings=max_interleavings, cost_model=cost_model)
+
+
+#: bundle wire format: ``{"format": "plan-bundle", "version": 1,
+#: "plans": {"<np>": <plan json>}}`` — one verified plan per world size
+#: a shrinking elastic job may pass through.  ``launch --plan
+#: --elastic`` emits these (one analyzer run per size), and
+#: ``planrt``/``bridge.rebuild`` pick the surviving size's plan at
+#: recovery.
+BUNDLE_FORMAT = "plan-bundle"
+BUNDLE_VERSION = 1
+
+
+def save_bundle(plans, path: str) -> str:
+    """Atomically write a plan bundle from ``{world_size: plan}`` (or
+    an iterable of plans)."""
+    if not isinstance(plans, dict):
+        plans = {p.world_size: p for p in plans}
+    payload = {
+        "format": BUNDLE_FORMAT,
+        "version": BUNDLE_VERSION,
+        "analyzer_version": ANALYZER_VERSION,
+        "plans": {str(int(n)): p.to_json()
+                  for n, p in sorted(plans.items())},
+    }
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    return path
+
+
+def is_bundle(data) -> bool:
+    return isinstance(data, dict) and data.get("format") == BUNDLE_FORMAT
+
+
+def _bundle_from_data(data: dict, path: str) -> Dict[int, ExecutionPlan]:
+    if int(data.get("version", -1)) != BUNDLE_VERSION:
+        raise ValueError(
+            f"plan bundle {path} has version {data.get('version')!r}, "
+            f"expected {BUNDLE_VERSION}")
+    out: Dict[int, ExecutionPlan] = {}
+    for n, pdata in data.get("plans", {}).items():
+        plan = ExecutionPlan.from_json(pdata)
+        if plan.analyzer_version != ANALYZER_VERSION:
+            raise ValueError(
+                f"plan bundle {path} was compiled by analyzer "
+                f"{plan.analyzer_version!r}, this is "
+                f"{ANALYZER_VERSION!r} — recompile")
+        out[int(n)] = plan
+    return out
+
+
+def load_bundle(path: str) -> Dict[int, ExecutionPlan]:
+    """``{world_size: plan}`` from a bundle file; raises ``ValueError``
+    on anything else (including version/analyzer drift — stale bundles
+    must invalidate, not misexecute)."""
+    with open(path) as f:
+        data = json.load(f)
+    if not is_bundle(data):
+        raise ValueError(f"{path} is not a plan bundle")
+    return _bundle_from_data(data, path)
+
+
+def load_plan_for_size(path: str, world_size: int) -> Optional[ExecutionPlan]:
+    """The plan serving ``world_size`` from ``path`` — a single-plan
+    file (must match the size exactly) or a bundle (picks the size's
+    entry).  None when the file holds no plan for that size; raises on
+    unreadable/stale files.  One read + one parse — this sits on the
+    elastic-recovery reinstall path."""
+    with open(path) as f:
+        data = json.load(f)
+    if is_bundle(data):
+        return _bundle_from_data(data, path).get(int(world_size))
+    plan = _plan_from_data(data, path)
+    return plan if plan.world_size == int(world_size) else None

@@ -20,9 +20,13 @@ Surviving ranks catch :class:`RankFailure`, call :func:`recover`, and:
 
 Renumbering is dense (0..new_size-1), so every rank/size invariant the
 static verifier proved about a program's schedule shape holds on the
-shrunk world too — a schedule valid for *any* np stays valid; only
-np-specific *plans* are dropped (bridge.rebuild does not reinstall
-them).
+shrunk world too — a schedule valid for *any* np stays valid.
+np-specific *plans* are elastic-safe: ``bridge.rebuild`` re-derives and
+re-PROVES the plan for the new world size inside the recovery (from
+the ``MPI4JAX_TPU_PLAN`` bundle or a ``planrt.set_plan_source``
+callback) and installs it only when the fresh proof passes — a
+recovered job keeps its overlap (docs/elasticity.md § Plans survive
+recovery).
 
 Under the ``respawn`` policy the announcement keeps the original size
 and an identity map; the launcher restarts the dead slot's program in a

@@ -29,6 +29,7 @@ the launcher's merge step and the profile CLI rely on that.
 
 from ._dump import (  # noqa: F401
     load_events,
+    load_events_meta,
     load_part,
     part_path,
     part_paths,

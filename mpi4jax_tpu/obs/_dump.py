@@ -76,6 +76,18 @@ def load_events(path: str):
     trace — the tuner's ``--from-trace`` accepts both.  Chrome spans are
     mapped back to canonical events (metadata and phase slices are
     skipped)."""
+    events, size, _gens = load_events_meta(path)
+    return events, size
+
+
+def load_events_meta(path: str):
+    """(events, world_size, generations) — like :func:`load_events`
+    plus the set of elastic world generations the file's recording
+    belongs to: a part file carries exactly one; a merged Chrome trace
+    reports every per-rank generation it merged (``otherData.
+    generations``).  Pre-elastic files report ``{0}``.  The tuner's
+    ``--from-trace`` uses this to keep pre- and post-shrink timings
+    from pooling into one median."""
     with open(path) as f:
         data = json.load(f)
     if isinstance(data, dict) and "events" in data and "version" in data:
@@ -86,7 +98,8 @@ def load_events(path: str):
             raise ValueError(
                 f"{path} has recording version {data.get('version')!r}, "
                 f"expected {PART_VERSION}")
-        return list(data["events"]), int(data.get("size", 1))
+        return (list(data["events"]), int(data.get("size", 1)),
+                {int(data.get("generation", 0))})
     if isinstance(data, dict) and "traceEvents" in data:
         events = []
         for ev in data["traceEvents"]:
@@ -110,6 +123,7 @@ def load_events(path: str):
                 evd["tier"] = args["tier"]  # hierarchical leg label
             events.append(evd)
         other = data.get("otherData") or {}
-        return events, int(other.get("world_size", 1))
+        gens = {int(g) for g in (other.get("generations") or {}).values()}
+        return events, int(other.get("world_size", 1)), (gens or {0})
     raise ValueError(
         f"{path} is neither an obs recording part nor a Chrome trace")

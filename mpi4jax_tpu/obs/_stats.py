@@ -31,6 +31,20 @@ from __future__ import annotations
 
 import math
 
+try:
+    from ..utils import config as _config
+except ImportError:  # pragma: no cover - standalone tooling load
+    import importlib.util as _ilu
+    import os as _os
+
+    _spec = _ilu.spec_from_file_location(
+        "m4j_stats_config",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      _os.pardir, "utils", "config.py"),
+    )
+    _config = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_config)
+
 STATS_SCHEMA = "mpi4jax_tpu.obs.stats/1"
 
 
@@ -191,13 +205,29 @@ def bench_record(*, op, nbytes, seconds, ranks=None, tier=None, algo=None,
     ``eff_GBps_per_chip`` uses the ring-effective convention the BENCH
     artifacts established (``2*(n-1)/n * bytes / seconds`` per rank)
     when ``ranks`` is given, falling back to plain payload-over-time.
+
+    Every row is stamped with the active knob environment
+    (``config.knob_env()``: the resolved COLL_ALGO/COLL_QUANT/HIER/
+    URING/PLAN gates) so a committed BENCH artifact is reproducible
+    without reading the shell history; pass ``knobs=...`` in ``extra``
+    to override (the ``--knob-grid`` sweep stamps the combination it
+    forced on the sub-job).
     """
     seconds = float(seconds)
+    try:
+        knobs = _config.knob_env()
+    except ValueError as e:
+        # a malformed gate aborts loudly wherever it MATTERS (the
+        # native parser exits on it); a mesh-tier benchmark that never
+        # touches those gates must not crash on the stamp — record the
+        # problem instead of fabricating a resolution
+        knobs = {"unparseable": str(e)}
     rec = {
         "op": str(op),
         "bytes": int(nbytes),
         "seconds": round(seconds, 9),
         "us": round(seconds * 1e6, 3),
+        "knobs": knobs,
     }
     if ranks is not None:
         n = max(int(ranks), 1)

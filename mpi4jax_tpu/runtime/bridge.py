@@ -651,10 +651,14 @@ def rebuild(old_handle, new_rank: int, new_size: int, base_port: int,
     was ever created) and bootstrap a fresh one over the survivors at
     the re-derived ``base_port``, then rerun the per-world setup
     (decision table for the new size, obs re-arm with a new clock
-    handshake).  Schedule plans are NOT reinstalled: a plan is proved
-    for one (program, np) shape and a shrunk world invalidates it —
-    the historic token-order path serves post-recovery (docs/
-    elasticity.md)."""
+    handshake).  Schedule plans are ELASTIC-SAFE: a plan is proved for
+    one (program, np) shape, so the dead world's runner is dropped and
+    the plan is re-derived AND re-proved for the new size inside the
+    recovery (``planrt.reinstall_after_rebuild`` — from the
+    ``MPI4JAX_TPU_PLAN`` bundle or a registered plan source), and only
+    a freshly-proved, signature-checked plan executes on the recovered
+    world; anything less degrades loudly to the always-correct
+    token-order path (docs/elasticity.md)."""
     lib = get_lib()
     if not hasattr(lib, "tpucomm_shrink"):
         raise RuntimeError(
@@ -673,6 +677,20 @@ def rebuild(old_handle, new_rank: int, new_size: int, base_port: int,
     if handle == 0:
         _abort("shrink", 1)
     _post_init_setup(lib, handle, new_rank, new_size, install_plan=False)
+    # the plan layer last: the rebuilt transport/selection/obs stack is
+    # live, so the re-proof can install onto a working world.  Soft
+    # like the comm_init install — a plan problem must never take a
+    # recovered job down.
+    try:
+        from . import planrt
+
+        planrt.reinstall_after_rebuild(old_handle, handle, new_rank,
+                                       new_size)
+    except Exception as e:  # pragma: no cover - defensive
+        import warnings
+
+        warnings.warn(f"schedule-plan reinstall failed after recovery: "
+                      f"{e}")
     return handle
 
 

@@ -162,8 +162,90 @@ def _preflight_verify(prog: str, np_: int, prog_args=()) -> int:
     return res.returncode or 2
 
 
+def _emit_plan_at(prog: str, np_: int, prog_args, plan_path: str):
+    """One analyzer --emit-plan run at a specific world size; returns
+    the CompletedProcess (the caller interprets exit codes)."""
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env.setdefault("PYTHONPATH", repo)
+    return subprocess.run(
+        [sys.executable, "-m", "mpi4jax_tpu.analyze", prog,
+         "--np", str(np_), "--errors-only", "--emit-plan", plan_path,
+         "--", *prog_args],
+        capture_output=True, text=True, env=env,
+    )
+
+
+def _bundle_shrink_ladder(prog: str, np_: int, prog_args,
+                          plan_path: str, primary: dict) -> None:
+    """Elastic-safe plans: extend the verified primary plan into a
+    *bundle* carrying one verified plan per world size a shrinking job
+    may pass through (np-1 .. 2).  ``bridge.rebuild`` then re-derives
+    and re-proves the surviving size's plan inside recovery instead of
+    dropping the overlap.  Sizes whose plan cannot be compiled/proved
+    are skipped with a notice — recovery at those sizes runs the
+    historic path.  The bundle overwrites ``plan_path`` in place (the
+    MPI4JAX_TPU_PLAN export is unchanged); the wire format is
+    ``analysis/_plan.py``'s plan-bundle/1."""
+    import json as _json
+    import tempfile
+
+    plans = {str(np_): primary}
+    skipped = []
+    for n2 in range(np_ - 1, 1, -1):
+        fd, sub_path = tempfile.mkstemp(prefix="m4j_plan_",
+                                        suffix=".json")
+        os.close(fd)
+        try:
+            res = _emit_plan_at(prog, n2, prog_args, sub_path)
+            if res.returncode not in (0, 3):
+                skipped.append((n2, f"analyzer exit {res.returncode}"))
+                continue
+            with open(sub_path) as f:
+                sub = _json.load(f)
+            if not (sub.get("proved") and sub.get("rewritten")):
+                why = ("not proved" if not sub.get("proved")
+                       else "unrewritten")
+                skipped.append((n2, why))
+                continue
+            plans[str(n2)] = sub
+        except Exception as e:
+            skipped.append((n2, str(e)))
+        finally:
+            try:
+                os.unlink(sub_path)
+            except OSError:
+                pass
+    try:
+        # one source of truth for the wire format; the literals below
+        # only serve the run-as-a-plain-file mode (no package context)
+        from ..analysis._plan import BUNDLE_FORMAT, BUNDLE_VERSION
+    except ImportError:
+        BUNDLE_FORMAT, BUNDLE_VERSION = "plan-bundle", 1
+    bundle = {
+        "format": BUNDLE_FORMAT,
+        "version": BUNDLE_VERSION,
+        "analyzer_version": primary.get("analyzer_version", ""),
+        "plans": plans,
+    }
+    tmp = f"{plan_path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        _json.dump(bundle, f, indent=1, sort_keys=True)
+    os.replace(tmp, plan_path)
+    covered = sorted(int(n) for n in plans)
+    print(f"[launch] --plan --elastic: plan bundle covers "
+          f"np={covered} — a shrink inside this range re-proves and "
+          "keeps its plan", file=sys.stderr, flush=True)
+    for n2, why in skipped:
+        print(f"[launch] --plan --elastic: no plan for np={n2} ({why}); "
+              "a shrink to that size runs the historic path",
+              file=sys.stderr, flush=True)
+
+
 def _preflight_plan(prog: str, np_: int, prog_args=(),
-                    enforce_verify: bool = False):
+                    enforce_verify: bool = False, elastic: bool = False):
     """Compile + verify ``prog``'s execution plan before spawning any
     rank (the schedule compiler, docs/analysis.md § "From verifier to
     compiler").  Returns ``(rc, plan_path)``: nonzero ``rc`` aborts the
@@ -173,22 +255,16 @@ def _preflight_plan(prog: str, np_: int, prog_args=(),
     installed — compile failure, an unproved plan, or an unrewritten
     one (exporting a trivial plan would cost the FFI fast path and
     per-op bookkeeping for zero overlap benefit) — and the job runs the
-    historic token-order path, which is always correct."""
+    historic token-order path, which is always correct.
+
+    ``elastic`` additionally compiles the shrink ladder into a plan
+    BUNDLE (see :func:`_bundle_shrink_ladder`) so recovery keeps the
+    overlap."""
     import tempfile
 
     fd, plan_path = tempfile.mkstemp(prefix="m4j_plan_", suffix=".json")
     os.close(fd)
-    env = dict(os.environ)
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    repo = os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
-    env.setdefault("PYTHONPATH", repo)
-    res = subprocess.run(
-        [sys.executable, "-m", "mpi4jax_tpu.analyze", prog,
-         "--np", str(np_), "--errors-only", "--emit-plan", plan_path,
-         "--", *prog_args],
-        capture_output=True, text=True, env=env,
-    )
+    res = _emit_plan_at(prog, np_, prog_args, plan_path)
     if res.returncode == 3 and enforce_verify:
         print(f"[launch] --verify FAILED for {prog} at np={np_} — "
               "no rank was spawned:", file=sys.stderr)
@@ -244,6 +320,8 @@ def _preflight_plan(prog: str, np_: int, prog_args=(),
           f"{plan.get('cache_key', '?')} for {prog} at np={np_}"
           + "".join(f"\n    note: {r}" for r in reasons),
           file=sys.stderr, flush=True)
+    if elastic:
+        _bundle_shrink_ladder(prog, np_, prog_args, plan_path, plan)
     return 0, plan_path
 
 
@@ -383,7 +461,8 @@ def main(argv=None):
         # the findings verdict too (tracing a large program twice would
         # double the pre-launch cost for nothing)
         rc, plan_path = _preflight_plan(args.prog, args.np, args.args,
-                                        enforce_verify=args.verify)
+                                        enforce_verify=args.verify,
+                                        elastic=args.elastic)
         if rc != 0:
             return rc
     elif args.verify:

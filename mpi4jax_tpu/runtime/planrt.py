@@ -133,23 +133,128 @@ def install(handle: int, plan, rank: int) -> bool:
 
 def maybe_install_from_env(handle: int, rank: int, size: int) -> None:
     """``bridge.comm_init`` hook: when MPI4JAX_TPU_PLAN names a plan
-    file (the ``launch --plan`` wiring), load it and attach this rank's
-    schedule to the world communicator.  Never fatal — a bad plan file
-    degrades to the historic path with a warning, it must not take a
-    healthy job down."""
+    file (the ``launch --plan`` wiring) — or a plan *bundle* (one
+    verified plan per survivable world size, what ``launch --plan
+    --elastic`` emits) — load the entry serving this world size and
+    attach this rank's schedule to the world communicator.  Never
+    fatal — a bad plan file degrades to the historic path with a
+    warning, it must not take a healthy job down."""
     spec = plan_spec()
     if spec is None or spec.lower() in ("1", "true", "on", "yes", "auto"):
         return  # bare enable: plans attach via the API / plan cache
     try:
-        plan = _plan_mod().load_plan(spec)
+        plan = _plan_mod().load_plan_for_size(spec, size)
     except Exception as err:
         _warn(f"cannot load MPI4JAX_TPU_PLAN={spec}: {err}")
         return
-    if plan.world_size != size:
-        _warn(f"plan {plan.cache_key} is for np={plan.world_size}, "
-              f"this job is np={size}; ignoring it")
+    if plan is None:
+        _warn(f"MPI4JAX_TPU_PLAN={spec} holds no plan for np={size}; "
+              "ignoring it")
         return
     install(handle, plan, rank)
+
+
+#: elastic-safe plan source: ``world_size -> ExecutionPlan | (events_by_
+#: rank, comms) | None``.  Registered by programs that install plans via
+#: the API (:func:`set_plan_source`); the env-spec (file/bundle) path
+#: needs no registration — :func:`reinstall_after_rebuild` reads
+#: MPI4JAX_TPU_PLAN itself.
+_plan_source = None
+
+
+def set_plan_source(fn) -> None:
+    """Register how to re-derive this job's plan for a NEW world size
+    (elastic recovery).  ``fn(world_size)`` returns an
+    :class:`ExecutionPlan` compiled for that size (it will be
+    re-proved before installation), a ``(events_by_rank, comms)`` pair
+    to compile fresh, or None (no plan for that size).  Pass ``None``
+    to unregister."""
+    global _plan_source
+    _plan_source = fn
+
+
+def drop(handle: int) -> None:
+    """Forget a communicator's runner WITHOUT flushing its tickets —
+    the rebuild path, where the old world's sockets are already dead
+    and a ticket wait would hang on them."""
+    global _active
+    _runners.pop(int(handle), None)
+    if not _runners:
+        _active = False
+
+
+def reinstall_after_rebuild(old_handle, handle: int, rank: int,
+                            size: int) -> bool:
+    """Elastic recovery's plan step (called from ``bridge.rebuild``):
+    drop the dead world's runner, re-derive the plan for the NEW world
+    size, re-PROVE it through the equivalence prover, and install it —
+    so a recovered job keeps its overlap instead of silently losing it
+    (docs/elasticity.md § Plans survive recovery).
+
+    The plan for the new size comes from the registered
+    :func:`set_plan_source` callback, or from the MPI4JAX_TPU_PLAN
+    file/bundle.  Whatever the source, nothing executes without a
+    fresh proof: a stored plan is recompiled from its own schedule
+    (``_plan.recompile_plan``) and its cache key must survive the
+    round trip (the signature check).  Every outcome is loud.  Returns
+    True when a re-proved plan is active on the new world."""
+    if old_handle:
+        drop(old_handle)
+    spec = plan_spec()
+    source = _plan_source
+    if source is None and (
+            spec is None
+            or spec.lower() in ("1", "true", "on", "yes", "auto")):
+        return False  # no plan was driving this job
+    plan_mod = _plan_mod()
+    stored = None
+    try:
+        if source is not None:
+            stored = source(size)
+        else:
+            stored = plan_mod.load_plan_for_size(spec, size)
+    except Exception as err:
+        _warn(f"cannot re-derive a plan for the recovered np={size} "
+              f"world: {err}; continuing on the historic path")
+        return False
+    if stored is None:
+        _warn(f"no plan available for the recovered np={size} world "
+              "(the bundle/source does not cover this size); "
+              "continuing on the historic path")
+        return False
+    try:
+        if isinstance(stored, tuple):
+            events_by_rank, comms = stored
+            fresh = plan_mod.compile_schedules(events_by_rank, comms,
+                                               world_size=size)
+        else:
+            fresh = plan_mod.recompile_plan(stored)
+            if fresh.cache_key != stored.cache_key:
+                _warn(f"re-derived plan signature {fresh.cache_key} does "
+                      f"not match the stored plan {stored.cache_key} for "
+                      f"np={size}; refusing it — the file does not "
+                      "contain the schedule it claims to")
+                return False
+    except Exception as err:
+        _warn(f"plan re-derivation failed for np={size}: {err}; "
+              "continuing on the historic path")
+        return False
+    if fresh.world_size != size:
+        _warn(f"re-derived plan is for np={fresh.world_size}, the "
+              f"recovered world is np={size}; refusing it")
+        return False
+    if not fresh.proved:
+        _warn(f"re-derived plan for np={size} failed its re-proof:"
+              + "".join(f"\n    {r}" for r in fresh.reasons)
+              + "\n  continuing on the historic path")
+        return False
+    if not install(handle, fresh, rank):
+        return False
+    _warn(f"re-proved plan {fresh.cache_key} for the recovered "
+          f"np={size} world ({fresh.proof.get('interleavings', 0)} "
+          "interleavings re-verified); overlap preserved across "
+          "recovery")
+    return True
 
 
 def detach(handle: int) -> None:

@@ -37,7 +37,49 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import Dict, List, Optional, Sequence, Tuple
+
+
+def _submodule(name: str):
+    """Load a sibling submodule, surviving the standalone (file-loaded)
+    import mode the tier-1 suite and the CLI fallback use."""
+    if __package__:
+        try:
+            from importlib import import_module
+
+            return import_module(f".{name}", __package__)
+        except ImportError:
+            pass  # standalone file load: fall through to the file path
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"m4j_tune_{name}_standalone",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _config_mod():
+    """utils.config, loaded standalone when the package gate blocks the
+    normal import (the knob mirrors are stdlib-only)."""
+    try:
+        from ..utils import config
+
+        return config
+    except ImportError:  # pragma: no cover - standalone tooling load
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "m4j_tune_config_standalone",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.pardir, "utils", "config.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
 
 # keep in sync with native/tpucomm.h (TpuCollAlgo / TpuCollOpKind)
 ALGO_CODES = {"auto": 0, "ring": 1, "rd": 2, "tree": 3, "shm": 4,
@@ -111,7 +153,16 @@ def _usable_trace_event(ev):
         return None
     return op, nbytes, dur_s
 
-CACHE_VERSION = 1
+#: persistent-cache wire format: v2 adds the JOINT layer — per-size-band
+#: algorithm *combinations* (``combos``: algo x quant x topology, see
+#: ``_joint.py``), the knob-environment stamp (``knobs``), and an
+#: optional cost-model pointer (``model``).  v1 files (algo-only) still
+#: load here; the ``table`` key keeps its v1 meaning (the per-call-
+#: forcible algorithm per band), but a pre-v2 RELEASE's loader rejects
+#: a v2 file by its version gate — its install() then warns "ignoring
+#: unusable tune cache" and runs on defaults, never on a misread table.
+CACHE_VERSION = 2
+_READABLE_CACHE_VERSIONS = (1, 2)
 
 # bucket table entries: (min_bytes ascending, algo name).  The defaults
 # mirror the pre-engine built-in heuristics in native/tpucomm.cc.
@@ -137,8 +188,10 @@ _HIER_DEFAULT_TABLE: Table = {
 _overrides: Dict[str, Dict[int, str]] = {op: {} for op in OPS}
 _cache_table: Optional[Table] = None
 _cache_origin: Optional[str] = None  # path the cache table came from
+_cache_combos: Optional[Table] = None  # v2 joint combos (label entries)
 _topo_multi: bool = False            # install() saw a multi-island topology
 _cache_loaded_for = None             # (world_size, topo_fp) of _cache_table
+_noticed: set = set()                # shadow notices already printed
 
 
 def _check_op(op: str) -> str:
@@ -220,16 +273,16 @@ def load_cache(world_size: int, path: Optional[str] = None,
     topology-stamped file: a cache measured on one topology shape must
     not govern another.  Legacy files without a topology stamp load for
     any shape (the documented fallback)."""
-    global _cache_table, _cache_origin
+    global _cache_table, _cache_origin, _cache_combos
     p = path or cache_path(world_size, topo_fingerprint)
     with open(p) as f:
         data = json.load(f)
     if not isinstance(data, dict) or "table" not in data:
         raise ValueError(f"tune cache {p} has no 'table' key")
-    if int(data.get("version", -1)) != CACHE_VERSION:
+    if int(data.get("version", -1)) not in _READABLE_CACHE_VERSIONS:
         raise ValueError(
             f"tune cache {p} has version {data.get('version')!r}, "
-            f"expected {CACHE_VERSION}"
+            f"expected one of {_READABLE_CACHE_VERSIONS}"
         )
     if int(data.get("world_size", -1)) != int(world_size):
         # a table measured at one world size must not govern another
@@ -246,17 +299,57 @@ def load_cache(world_size: int, path: Optional[str] = None,
             f"this job discovered {topo_fingerprint!r}"
         )
     table = _validate_table(data["table"])
+    combos = None
+    if data.get("combos"):
+        combos = _validate_combos(data["combos"])
     _cache_table = table
+    _cache_combos = combos
     _cache_origin = p
     return table
 
 
+def _validate_combos(raw) -> Table:
+    """Validate a v2 cache's joint-combination entries: same bucket
+    shape as the algorithm table, but the labels are the joint space's
+    combos (``hring+q`` legal, validated by ``_joint.check_combo``)."""
+    joint = _submodule("_joint")
+    if not isinstance(raw, dict):
+        raise ValueError("tune cache combos must be a dict of op -> entries")
+    combos: Table = {}
+    for op, entries in raw.items():
+        _check_op(op)
+        out: List[Entry] = []
+        for e in entries:
+            if not isinstance(e, (list, tuple)) or len(e) != 2:
+                raise ValueError(f"malformed combo entry for {op}: {e!r}")
+            min_bytes = int(e[0])
+            if min_bytes < 0:
+                raise ValueError(f"negative min_bytes in combo entry: {e!r}")
+            out.append((min_bytes, joint.check_combo(e[1], op)))
+        combos[op] = sorted(out)
+    return combos
+
+
 def save_cache(world_size: int, table: Table, measurements=(),
                path: Optional[str] = None, transport: str = "tcp",
-               topo_fingerprint: Optional[str] = None) -> str:
-    """Atomically write the cache file; returns its path."""
+               topo_fingerprint: Optional[str] = None,
+               combos: Optional[Table] = None,
+               model_path: Optional[str] = None) -> str:
+    """Atomically write the cache file; returns its path.
+
+    Every payload is stamped with the active knob environment
+    (``knobs``) so the winners are reproducible without reading the
+    shell history; measurement rows of gate-dependent combinations
+    (``hring+q``/...) additionally carry their own ``gates`` — they
+    were measured in a sub-job whose gates differ from the driver's
+    stamp.  ``combos`` (the joint tuner's per-band algorithm
+    *combinations*) and ``model_path`` (the cost-model file the search
+    was seeded by) make the payload a v2 joint cache; without them the
+    file is still written as v2 but carries only the v1 semantics."""
     p = path or cache_path(world_size, topo_fingerprint)
     table = _validate_table(table)
+    if combos is not None:
+        combos = _validate_combos(combos)
     os.makedirs(os.path.dirname(p) or ".", exist_ok=True)
     payload = {
         "version": CACHE_VERSION,
@@ -265,7 +358,13 @@ def save_cache(world_size: int, table: Table, measurements=(),
         "table": {op: [list(e) for e in entries]
                   for op, entries in table.items()},
         "measurements": list(measurements),
+        "knobs": _config_mod().knob_env(),
     }
+    if combos is not None:
+        payload["combos"] = {op: [list(e) for e in entries]
+                             for op, entries in combos.items()}
+    if model_path:
+        payload["model"] = str(model_path)
     if topo_fingerprint:
         payload["topology"] = str(topo_fingerprint)
     tmp = f"{p}.tmp.{os.getpid()}"
@@ -398,7 +497,7 @@ def sources() -> List[str]:
 def describe() -> dict:
     """Diag-friendly summary: table, sources, representative picks."""
     table = decision_table()
-    return {
+    out = {
         "sources": sources(),
         "table": {op: [list(e) for e in entries]
                   for op, entries in table.items()},
@@ -408,6 +507,79 @@ def describe() -> dict:
             for op in OPS
         },
     }
+    if _cache_combos:
+        out["combos"] = {op: [list(e) for e in entries]
+                         for op, entries in _cache_combos.items()}
+    return out
+
+
+def cache_combos() -> Optional[Table]:
+    """The loaded joint cache's per-band algorithm combinations, or
+    None (no cache, or a v1 algo-only cache)."""
+    return _cache_combos
+
+
+def _notice_shadowed() -> None:
+    """Satellite of the joint tuner: when a process-wide env knob
+    overrides (or degrades) an installed cache pick, say so LOUDLY once
+    per distinct conflict instead of letting the precedence chain
+    shadow the cache silently — naming both picks, so the operator
+    knows which measurement they are discarding.
+
+    Covered shadows: ``MPI4JAX_TPU_COLL_ALGO`` replacing a cached
+    algorithm outright; ``MPI4JAX_TPU_COLL_QUANT=deny`` degrading a
+    cached quantized pick to its exact twin; a joint-cache ``+q``
+    combo whose quantized leader leg needs ``COLL_QUANT=force``; and
+    ``MPI4JAX_TPU_HIER=deny`` flattening a cached hierarchical pick.
+    """
+    if _cache_table is None:
+        return
+    msgs: List[str] = []
+    env_raw = os.environ.get("MPI4JAX_TPU_COLL_ALGO", "").strip()
+    if env_raw:
+        env_t = _env_table()
+        for op, entries in sorted(_cache_table.items()):
+            if op not in env_t:
+                continue
+            forced = env_t[op][-1][1]
+            shadowed = sorted({a for _, a in entries if a != forced})
+            if shadowed:
+                msgs.append(
+                    f"MPI4JAX_TPU_COLL_ALGO={env_raw} overrides the "
+                    f"installed tune-cache pick(s) {', '.join(shadowed)} "
+                    f"for {op} with '{forced}' (cache: {_cache_origin})")
+    cfg = _config_mod()
+    try:
+        qm, hm = cfg.quant_mode(), cfg.hier_mode()
+    except ValueError:
+        # a malformed gate is about to abort the job loudly anyway
+        qm = hm = "allow"
+    joint = _submodule("_joint")
+    picks = _cache_combos or _cache_table
+    for op, entries in sorted(picks.items()):
+        for mb, combo in entries:
+            algo = joint.combo_algo(combo)
+            where = f"{op} >= {mb} B (cache: {_cache_origin})"
+            if algo in QUANT_ALGOS and qm == "deny":
+                msgs.append(
+                    f"MPI4JAX_TPU_COLL_QUANT=deny degrades the installed "
+                    f"cache pick '{combo}' to its exact twin "
+                    f"'{EXACT_TWIN[algo]}' for {where}")
+            elif combo.endswith(joint.QUANT_LEG_SUFFIX) and qm != "force":
+                msgs.append(
+                    f"the installed joint-cache pick '{combo}' needs "
+                    f"MPI4JAX_TPU_COLL_QUANT=force for its quantized "
+                    f"leader leg; the active gate '{qm}' leaves that leg "
+                    f"exact ('{algo}' runs) for {where}")
+            if algo in HIER_ALGOS and hm == "deny":
+                flat = "ring" if algo == "hring" else "tree"
+                msgs.append(
+                    f"MPI4JAX_TPU_HIER=deny degrades the installed cache "
+                    f"pick '{combo}' to its flat twin '{flat}' for {where}")
+    for msg in msgs:
+        if msg not in _noticed:
+            _noticed.add(msg)
+            print(f"[tune] NOTICE: {msg}", file=sys.stderr, flush=True)
 
 
 def entries_from_measurements(best: Dict[int, str]) -> List[Entry]:
@@ -482,6 +654,111 @@ def wire_fractions_from_events(events) -> Dict[str, Dict[int, Dict[str, float]]]
     }
 
 
+def dispatch_fractions_from_events(events) -> Dict[str, Dict[int, Dict[str, float]]]:
+    """Mean recorded dispatch share — ``dispatch / dur`` — per (op,
+    payload bytes, algorithm), same event filter as
+    :func:`measurements_from_events`.  A high dispatch fraction means
+    the op spends its time queued behind the engine, which is what the
+    cost model's concurrency-group-cap suggestion keys on."""
+    fracs: Dict[str, Dict[int, Dict[str, List[float]]]] = {}
+    for ev in events:
+        usable = _usable_trace_event(ev)
+        if usable is None:
+            continue
+        op, nbytes, dur_s = usable
+        disp_s = float(ev.get("dispatch_us", 0.0)) / 1e6
+        fracs.setdefault(op, {}).setdefault(nbytes, {}) \
+            .setdefault(ev["algo"], []).append(min(disp_s / dur_s, 1.0))
+    return {
+        op: {nbytes: {algo: sum(fr) / len(fr)
+                      for algo, fr in by_algo.items()}
+             for nbytes, by_algo in by_size.items()}
+        for op, by_size in fracs.items()
+    }
+
+
+def fit_model_from_events(events, *, world_size: int = 0,
+                          topo_fingerprint: Optional[str] = None,
+                          source: str = "trace"):
+    """Fit a :class:`tune._model.CostModel` from a recorded run's
+    canonical events: the per-(op, size, algorithm) medians become the
+    model's samples, with the recorded wire and dispatch fractions
+    riding along (the same event filter as ``--from-trace``).  The
+    model is stamped with the active knob environment — a recording is
+    only comparable to runs under the same gates."""
+    _model = _submodule("_model")
+    samples = measurements_from_events(events)
+    wire = wire_fractions_from_events(events)
+    disp = dispatch_fractions_from_events(events)
+    model = _model.CostModel(
+        world_size=world_size, topology=topo_fingerprint,
+        knobs=_config_mod().knob_env(), source=source)
+    for op, by_size in samples.items():
+        for nbytes, by_algo in by_size.items():
+            for algo, med in by_algo.items():
+                model.add_sample(
+                    op, algo, nbytes, med,
+                    wire_frac=wire.get(op, {}).get(nbytes, {}).get(algo),
+                    dispatch_frac=disp.get(op, {}).get(nbytes, {})
+                    .get(algo))
+    return model
+
+
+def collect_trace_events(paths: Sequence[str], obs_dump=None):
+    """Load recorded events from part files / merged traces with the
+    elastic world-generation gate applied: a file spanning generations
+    is refused outright, files from superseded generations are skipped
+    with a loud notice (pre- and post-shrink timings must never pool
+    into one median).  Returns ``(events, seen_world_size)`` — the one
+    loader BOTH ``--from-trace`` consumers (cache derivation and the
+    ``--joint`` model seed) go through."""
+    if obs_dump is None:
+        try:
+            from ..obs import _dump as obs_dump
+        except ImportError:  # pragma: no cover - standalone tooling load
+            import importlib.util
+
+            _spec = importlib.util.spec_from_file_location(
+                "m4j_obs_dump_standalone",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             os.pardir, "obs", "_dump.py"))
+            obs_dump = importlib.util.module_from_spec(_spec)
+            _spec.loader.exec_module(obs_dump)
+    per_file = []
+    for path in paths:
+        evs, size, gens = obs_dump.load_events_meta(path)
+        if len(gens) > 1:
+            # a merged trace spanning an elastic recovery: its spans
+            # cannot be attributed to one world membership, so pre- and
+            # post-shrink timings would pool into one median
+            raise ValueError(
+                f"{path} merges recordings from world generations "
+                f"{sorted(gens)} (an elastic recovery happened "
+                "mid-job); pass the per-rank part files instead — "
+                "only the latest generation's timings are usable")
+        per_file.append((path, evs, size, max(gens)))
+    latest_gen = max((g for _, _, _, g in per_file), default=0)
+    stale = [(path, g) for path, _, _, g in per_file if g != latest_gen]
+    if stale:
+        # an elastic shrink mid-recording: pre-shrink worlds have a
+        # different membership (and size), so their timings must not
+        # pool with the survivors' — reject them loudly, keep the rest
+        names = ", ".join(f"{os.path.basename(p)} (generation {g})"
+                          for p, g in stale)
+        print(f"tune: --from-trace: ignoring {len(stale)} recording(s) "
+              f"from superseded world generation(s): {names} — only "
+              f"generation {latest_gen}, the latest, carries timings "
+              "for the surviving world", file=sys.stderr, flush=True)
+        per_file = [(p, e, s, g) for p, e, s, g in per_file
+                    if g == latest_gen]
+    events: List[dict] = []
+    seen_size = 0
+    for _, evs, size, _ in per_file:
+        events.extend(evs)
+        seen_size = max(seen_size, size)
+    return events, seen_size
+
+
 def cache_from_trace(paths: Sequence[str], world_size: Optional[int] = None,
                      cache_path_override: Optional[str] = None,
                      quantize: bool = True) -> str:
@@ -506,25 +783,7 @@ def cache_from_trace(paths: Sequence[str], world_size: Optional[int] = None,
     always safe.  Pass ``quantize=False`` (CLI: ``--no-quantize``) for
     an exact-only table.
     """
-    try:
-        from ..obs import _dump as obs_dump
-    except ImportError:  # pragma: no cover - standalone tooling load
-        import importlib.util
-
-        _spec = importlib.util.spec_from_file_location(
-            "m4j_obs_dump_standalone",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         os.pardir, "obs", "_dump.py"),
-        )
-        obs_dump = importlib.util.module_from_spec(_spec)
-        _spec.loader.exec_module(obs_dump)
-
-    events: List[dict] = []
-    seen_size = 0
-    for path in paths:
-        evs, size = obs_dump.load_events(path)
-        events.extend(evs)
-        seen_size = max(seen_size, size)
+    events, seen_size = collect_trace_events(paths)
     n = int(world_size or seen_size)
     if n < 2:
         raise ValueError(
@@ -577,6 +836,34 @@ def cache_from_trace(paths: Sequence[str], world_size: Optional[int] = None,
                       transport="tcp:from-trace")
 
 
+def cache_from_joint(world_size: int, best: Dict[str, Dict[int, str]],
+                     measurements=(), *, path: Optional[str] = None,
+                     topo_fingerprint: Optional[str] = None,
+                     model_file: Optional[str] = None) -> str:
+    """Write the v2 joint cache from per-(op, size) winning combos (the
+    ``--joint`` search's output): the ``combos`` layer records the full
+    winning combination per size band, and the derived ``table`` keeps
+    the v1 meaning — the per-call-forcible algorithm under each combo —
+    so the native install path and v1 readers are untouched."""
+    joint = _submodule("_joint")
+    combos = {op: entries_from_measurements(b) for op, b in best.items()}
+
+    def _algo_entries(op, entries):
+        out: List[Entry] = []
+        for mb, combo in entries:
+            algo = _check_algo(joint.combo_algo(combo), op)
+            if not out or out[-1][1] != algo:
+                out.append((mb, algo))
+        return out
+
+    table = {op: _algo_entries(op, entries)
+             for op, entries in combos.items()}
+    return save_cache(world_size, table, measurements, path=path,
+                      transport="tcp:joint",
+                      topo_fingerprint=topo_fingerprint, combos=combos,
+                      model_path=model_file)
+
+
 def install(world_size: Optional[int] = None, topology=None) -> bool:
     """Load the persistent cache (if present) and push the merged
     decision table into the native layer.  Called by
@@ -589,7 +876,8 @@ def install(world_size: Optional[int] = None, topology=None) -> bool:
     hierarchical table, and its fingerprint keys the cache lookup —
     ``tune_<size>_<topohash>.json`` first, the legacy un-keyed
     ``tune_<size>.json`` as a fallback."""
-    global _topo_multi, _cache_table, _cache_origin, _cache_loaded_for
+    global _topo_multi, _cache_table, _cache_origin, _cache_combos, \
+        _cache_loaded_for
     topo_fp = None
     if topology is not None:
         _topo_multi = bool(getattr(topology, "multi", False))
@@ -602,6 +890,7 @@ def install(world_size: Optional[int] = None, topology=None) -> bool:
             # cache belongs to the old one — drop it and reload below
             _cache_table = None
             _cache_origin = None
+            _cache_combos = None
             _cache_loaded_for = None
         if _cache_table is None:
             candidates = []
@@ -625,6 +914,9 @@ def install(world_size: Optional[int] = None, topology=None) -> bool:
                     break
             else:
                 _cache_loaded_for = want  # nothing on disk for this shape
+    # a conflicting env knob silently shadowing a measured cache pick is
+    # the one precedence interaction operators cannot see — say so once
+    _notice_shadowed()
     return _push_native()
 
 

@@ -2,7 +2,7 @@
 
     python -m mpi4jax_tpu.tune [--np 4] [--sizes 1024,...,16777216]
                                [--repeats N] [--ops allreduce,allgather]
-                               [--cache PATH] [--port P]
+                               [--cache PATH] [--port P] [--joint]
 
 Sweeps every selectable algorithm (ring / recursive doubling / tree,
 plus the quantized qring/qrd allreduce twins) for each (op, payload
@@ -10,11 +10,28 @@ size) on a live job and writes the winners to the
 persistent cache (``tune.cache_path(world_size)``), which is loaded at
 communicator creation on every subsequent run — see ``tune.install``.
 
+``--joint`` replaces the one-axis sweep with the JOINT search
+(``tune/_joint.py``, docs/usage.md § Joint tuning): algorithm x
+quantization x topology combinations compete in one space, seeded by a
+cost model (``tune/_model.py``) fit from anchor measurements (and, with
+``--from-trace``, from real-run recordings) and refined by live
+measurement of the model's top-k per size.  Combinations whose gates
+are per-process (``hring+q``/``htree+q`` — the hierarchical schedules
+with a quantized leader leg, which exist only under
+``MPI4JAX_TPU_COLL_QUANT=force``) are measured in a dedicated sub-job.
+The result is ONE v2 cache recording the winning *combination* per
+size band, plus the fitted cost-model file
+(``tune._model.model_path``) the schedule compiler can consult.
+
 ``--from-trace out.json.rank0.json`` (or a glob / the merged trace)
 skips the synthetic sweep entirely and derives the cache from a REAL
 run's recorded per-op timings (``mpi4jax_tpu.launch --trace`` +
 ``mpi4jax_tpu/obs`` — docs/observability.md): the winner per (op,
 payload size) is the algorithm with the best median observed time.
+Recordings from superseded elastic world generations are rejected (an
+elastic shrink mid-recording must not pool pre- and post-shrink
+timings into one median).  With ``--joint``, recordings SEED the model
+instead of replacing the sweep.
 
 Three modes:
 
@@ -105,24 +122,35 @@ def _parse_args(argv=None):
                          "recording part files (out.json.rank*.json) "
                          "and/or merged traces written by `launch --trace` "
                          "(globs allowed); winners are the best median "
-                         "observed per (op, payload size)")
+                         "observed per (op, payload size).  With --joint "
+                         "the recordings SEED the cost model instead")
+    ap.add_argument("--joint", action="store_true",
+                    help="search the joint algorithm x quantization x "
+                         "topology space (model-seeded, measurement-"
+                         "refined) and write a v2 cache recording the "
+                         "winning combination per size band, plus the "
+                         "fitted cost-model file")
+    ap.add_argument("--topk", type=int, default=3,
+                    help="--joint: combos measured live per non-anchor "
+                         "size (the model's best k predictions; unknown "
+                         "combos are always measured)")
+    ap.add_argument("--model-out", default=None,
+                    help="--joint: cost-model output path (default: "
+                         "tune._model.model_path(np), or "
+                         "MPI4JAX_TPU_TUNE_MODEL)")
+    # internal plumbing between the --joint driver and its sub-jobs
+    ap.add_argument("--joint-out", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--joint-combos", default=None, help=argparse.SUPPRESS)
+    ap.add_argument("--joint-model", default=None, help=argparse.SUPPRESS)
     return ap.parse_args(argv)
 
 
 def _from_trace(args) -> int:
-    import glob as _glob
-
-    paths = []
-    for piece in args.from_trace.split(","):
-        piece = piece.strip()
-        if not piece:
-            continue
-        hits = sorted(_glob.glob(piece))
-        if not hits:
-            print(f"tune: --from-trace: no file matches {piece!r}",
-                  file=sys.stderr, flush=True)
-            return 2
-        paths.extend(hits)
+    try:
+        paths = _trace_paths(args.from_trace)
+    except FileNotFoundError as e:
+        print(f"tune: {e}", file=sys.stderr, flush=True)
+        return 2
     try:
         cache = tune.cache_from_trace(
             paths, world_size=args.np_, cache_path_override=args.cache,
@@ -174,8 +202,16 @@ def _driver(args) -> int:
 
 
 def _time_point(comm, bridge, np, op, nbytes, algo, repeats):
-    """Median wall time of `repeats` forced-algorithm collectives,
-    maxed across ranks (a collective is as slow as its slowest rank)."""
+    """Median per-call wall time of `repeats` forced-algorithm
+    collectives, maxed across ranks (a collective is as slow as its
+    slowest rank).
+
+    Each sample starts from a barrier — the same methodology as
+    ``allreduce_sweep``'s raw loop, for the same reason: back-to-back
+    free-running calls accumulate rank drift whose stalls land on
+    whichever schedule runs later, an artifact of the loop rather than
+    of the algorithm — and near-twin candidates (hring+q vs htree+q)
+    differ by less than that drift."""
     code = tune.ALGO_CODES[algo]
     h = comm.handle
     if op == "allreduce":
@@ -193,12 +229,14 @@ def _time_point(comm, bridge, np, op, nbytes, algo, repeats):
 
     run()  # warmup + cross-rank alignment on the same op count
     times = []
-    for _ in range(3):
+    for _ in range(max(repeats, 3)):
+        bridge.barrier(h)  # outside the timed window, same for every algo
         t0 = time.perf_counter()
-        for _ in range(repeats):
-            run()
-        times.append((time.perf_counter() - t0) / repeats)
-    dt = sorted(times)[1]
+        run()
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    n = len(times)
+    dt = (times[(n - 1) // 2] + times[n // 2]) / 2.0
     agreed = np.empty(1, np.float64)
     bridge.allreduce_raw(h, np.array([dt], np.float64), agreed, _F64, _MAX)
     return float(agreed[0])
@@ -246,7 +284,7 @@ def _rank(args) -> int:
     best = {op: {} for op in ops}
     for op in ops:
         for nbytes in sizes:
-            repeats = args.repeats or max(3, min(30, int(3e6 / max(nbytes, 1))))
+            repeats = args.repeats or max(7, min(30, int(3e6 / max(nbytes, 1))))
             per_algo = {}
             cands = CANDIDATES[op]
             if hier_ok:
@@ -284,18 +322,269 @@ def _rank(args) -> int:
     return 0
 
 
+def _trace_paths(spec: str):
+    import glob as _glob
+
+    paths = []
+    for piece in spec.split(","):
+        piece = piece.strip()
+        if not piece:
+            continue
+        hits = sorted(_glob.glob(piece))
+        if not hits:
+            raise FileNotFoundError(
+                f"--from-trace: no file matches {piece!r}")
+        paths.extend(hits)
+    return paths
+
+
+def _joint_rank(args) -> int:
+    """One rank of the joint search: every rank runs the identical
+    model-seeded search (the per-point timings are MAX-agreed across
+    ranks, so the search trajectory — and the winners — agree), and
+    rank 0 hands the measurement rows back to the driver."""
+    import numpy as np
+
+    from mpi4jax_tpu import topo as _topo
+    from mpi4jax_tpu.runtime import bridge, transport
+    from mpi4jax_tpu.utils.config import hier_mode, quant_mode
+
+    joint = tune._submodule("_joint")
+    _model = tune._submodule("_model")
+
+    comm = transport.get_world_comm()
+    n = comm.size()
+    if not hasattr(bridge.get_lib(), "tpucomm_allreduce_algo"):
+        print("tune: ERROR — the loaded native library predates the "
+              "algorithm engine; rebuild native/ before tuning",
+              file=sys.stderr, flush=True)
+        return 1
+    topology = _topo.get_topology(comm.handle)
+    multi = (topology is not None and topology.multi
+             and hasattr(bridge.get_lib(), "tpucomm_set_topology"))
+    sizes = ([int(s) for s in args.sizes.split(",")] if args.sizes
+             else DEFAULT_SIZES)
+    ops = [tune._check_op(o.strip()) for o in args.ops.split(",")
+           if o.strip()]
+    only = None
+    if args.joint_combos:
+        only = {c.strip() for c in args.joint_combos.split(",")
+                if c.strip()}
+    qm, hm = quant_mode(), hier_mode()
+
+    def _runs_as_labeled(combo):
+        """Whether a per-call force of this combo's algorithm would
+        actually RUN the labeled schedule under the process gates —
+        the native resolver upgrades exact picks under a force gate,
+        and a row timing the upgrade under an exact label is noise
+        dressed up as a measurement."""
+        algo = joint.combo_algo(combo)
+        if combo.endswith(joint.QUANT_LEG_SUFFIX):
+            # +q only exists under the force gate (the driver measures
+            # these in their own sub-job)
+            return qm == "force"
+        if qm == "force":
+            if algo in ("ring", "rd", "tree"):
+                return False  # upgraded to the quantized twin
+            if algo in tune.HIER_ALGOS:
+                return False  # leader leg quantized: that IS +q
+        if hm == "force" and multi and algo in ("ring", "rd", "tree"):
+            return False  # upgraded to the hierarchical twin
+        return True
+
+    candidates = {}
+    for op in ops:
+        cands = joint.eligible_combos(op, multi_island=multi,
+                                      quant_mode=qm, hier_mode=hm)
+        cands = [c for c in cands if _runs_as_labeled(c)]
+        if only is not None:
+            cands = [c for c in cands if c in only]
+        if cands:
+            candidates[op] = cands
+
+    seed = None
+    if args.joint_model:
+        seed = _model.load_model(args.joint_model)
+
+    def measure(op, nbytes, combo):
+        algo = joint.combo_algo(combo)
+        repeats = args.repeats or max(7, min(30, int(3e6 / max(nbytes, 1))))
+        return _time_point(comm, bridge, np, op, nbytes, algo, repeats)
+
+    def log(row):
+        if comm.rank() == 0:
+            print(json.dumps(row), flush=True)
+
+    best, measurements, model = joint.joint_search(
+        measure, candidates, sizes, model=seed, topk=max(args.topk, 1),
+        ranks=n, log=log)
+    if comm.rank() == 0 and args.joint_out:
+        payload = {
+            "world_size": n,
+            "multi": bool(multi),
+            "topology": (topology.fingerprint()
+                         if topology is not None and topology.multi
+                         else None),
+            "measurements": measurements,
+        }
+        tmp = f"{args.joint_out}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, args.joint_out)
+    bridge.barrier(comm.handle)  # results are on disk before exit
+    return 0
+
+
+def _joint_driver(args) -> int:
+    """Orchestrate the joint search: the base sub-job covers every
+    per-call-forcible combination; the gated quantized-leader-leg
+    variants (per-process COLL_QUANT=force) get their own sub-job on a
+    multi-island shape; the merged winners become ONE v2 cache plus the
+    fitted cost-model file."""
+    import tempfile
+
+    from mpi4jax_tpu.utils.config import quant_mode
+
+    joint = tune._submodule("_joint")
+    _model = tune._submodule("_model")
+
+    np_ = args.np_ or 4
+    workdir = tempfile.mkdtemp(prefix="m4j_joint_")
+
+    def _sub_job(out_path, extra_env, extra_args, job_index=0):
+        cmd = [sys.executable, "-m", "mpi4jax_tpu.runtime.launch",
+               "-n", str(np_)]
+        if args.port:
+            # a fresh port block per sub-job: the base job's sockets
+            # may still sit in TIME_WAIT when the forced_q job binds
+            # (the same offset the --knob-grid driver applies)
+            cmd += ["--port", str(args.port + job_index * (np_ + 2))]
+        cmd += [os.path.abspath(__file__), "--joint",
+                "--joint-out", out_path, "--topk", str(args.topk)]
+        for flag, val in (("--sizes", args.sizes),
+                          ("--repeats", args.repeats or None),
+                          ("--ops", args.ops)):
+            if val:
+                cmd += [flag, str(val)]
+        cmd += extra_args
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        if not os.environ.get("MPI4JAX_TPU_FAKE_HOSTS", "").strip():
+            env["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+        env.pop("MPI4JAX_TPU_COLL_ALGO", None)
+        # an inherited FORCE gate would make the native resolver
+        # silently upgrade per-call-forced exact algorithms (ring ->
+        # qring/hring, ...) — every plain-labeled row would measure the
+        # upgraded schedule and poison the cache/model.  The driver
+        # owns the gates: base job runs under allow, the forced_q job
+        # sets its own; an operator's deny stays (it restricts the
+        # candidate set instead).
+        for gate in ("MPI4JAX_TPU_COLL_QUANT", "MPI4JAX_TPU_HIER"):
+            if env.get(gate, "").strip() == "force" \
+                    and gate not in extra_env:
+                print(f"tune: --joint: ignoring inherited {gate}=force "
+                      "for the sweep sub-job (forced upgrades would "
+                      "mislabel the exact-algorithm rows); gated "
+                      "combinations are measured in their own sub-job",
+                      file=sys.stderr, flush=True)
+                env.pop(gate)
+        env.update(extra_env)
+        rc = subprocess.run(cmd, env=env).returncode
+        if rc != 0:
+            return rc, None
+        try:
+            with open(out_path) as f:
+                return 0, json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"tune: --joint: sub-job wrote no results: {e}",
+                  file=sys.stderr, flush=True)
+            return 2, None
+
+    seed_args = []
+    if args.from_trace:
+        # recordings seed the model: the ranks start from the real
+        # run's medians instead of measuring every anchor blind.  The
+        # same world-generation gate as plain --from-trace applies — a
+        # seed pooling pre- and post-shrink timings would steer the
+        # top-k refinement from wrong-world medians.
+        try:
+            paths = _trace_paths(args.from_trace)
+            events, _size = tune.collect_trace_events(paths)
+            seed = tune.fit_model_from_events(events, world_size=np_,
+                                              source="trace-seed")
+            seed_path = os.path.join(workdir, "seed_model.json")
+            _model.save_model(seed, path=seed_path)
+            seed_args = ["--joint-model", seed_path]
+        except (OSError, ValueError) as e:
+            print(f"tune: --joint: cannot seed from recordings ({e}); "
+                  "searching unseeded", file=sys.stderr, flush=True)
+
+    rc, base = _sub_job(os.path.join(workdir, "base.json"), {}, seed_args)
+    if rc != 0 or base is None:
+        return rc or 2
+    n = int(base["world_size"])
+    topo_fp = base.get("topology")
+    sets = [base["measurements"]]
+
+    if base.get("multi") and quant_mode() != "deny":
+        # the hierarchical schedules with a QUANTIZED leader leg exist
+        # only under the per-process force gate: measure them in their
+        # own sub-job, labeled as the +q combos they are
+        qcombos = ",".join(
+            c for c in joint.JOINT_CANDIDATES["allreduce"]
+            if c.endswith(joint.QUANT_LEG_SUFFIX))
+        rc, gated = _sub_job(
+            os.path.join(workdir, "forced_q.json"),
+            {"MPI4JAX_TPU_COLL_QUANT": "force"},
+            ["--joint-combos", qcombos], job_index=1)
+        if rc == 0 and gated is not None:
+            sets.append(gated["measurements"])
+        else:
+            print("tune: --joint: the quantized-leader-leg sub-job "
+                  "failed; the cache is written without the +q rows",
+                  file=sys.stderr, flush=True)
+
+    best, rows = joint.merge_winners(sets)
+    if not best:
+        print("tune: --joint: no measurements survived; nothing to "
+              "write", file=sys.stderr, flush=True)
+        return 2
+    model = _model.CostModel.from_measurements(
+        rows, world_size=n, topology=topo_fp, source="joint",
+        knobs=tune._config_mod().knob_env())
+    model_file = _model.save_model(model, path=args.model_out)
+    cache = tune.cache_from_joint(n, best, rows, path=args.cache,
+                                  topo_fingerprint=topo_fp,
+                                  model_file=model_file)
+    for op in sorted(best):
+        for nbytes in sorted(best[op]):
+            print(json.dumps({"op": op, "bytes": nbytes,
+                              "winner": best[op][nbytes]}), flush=True)
+    print(f"tune: joint cache written to {cache}")
+    print(f"tune: cost model written to {model_file}")
+    return 0
+
+
 def main(argv=None) -> int:
     args = _parse_args(argv)
-    if args.from_trace:
+    if args.from_trace and not args.joint:
         return _from_trace(args)
     try:
         from mpi4jax_tpu.runtime import transport
     except ImportError as e:
+        if args.joint:
+            print(f"tune: --joint needs the full package "
+                  f"(jax >= 0.6): {e}", file=sys.stderr, flush=True)
+            return 2
         print(f"tune: the sweep modes need the full package "
               f"(jax >= 0.6): {e}\n"
               "tune: --from-trace works standalone on recordings",
               file=sys.stderr, flush=True)
         return 2
+    if args.joint:
+        if transport.in_world():
+            return _joint_rank(args)
+        return _joint_driver(args)
     if transport.in_world():
         return _rank(args)
     return _driver(args)

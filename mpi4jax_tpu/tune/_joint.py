@@ -1,0 +1,232 @@
+"""Joint tuning: one search over algorithm x quantization x topology.
+
+A 16 MiB allreduce's fate used to be decided by four independent
+layers — the decision table, ``MPI4JAX_TPU_COLL_QUANT``,
+``MPI4JAX_TPU_HIER``, and whether a schedule plan is installed — and
+the interactions are real: the hierarchical ring's leader leg is
+quant-eligible, and plan bucketing changes the payload sizes that pick
+the best algorithm.  Following GC3's one-compiler-over-the-whole-space
+argument (arXiv:2201.11840) and EQuARX's put-quantization-inside-the-
+selection-loop argument (arXiv:2506.17615), this module owns the ONE
+search space:
+
+A **combo** is a string naming one point of the joint space:
+
+- a plain algorithm name (``ring``/``rd``/``tree``) — exact wire,
+  whatever gates;
+- a quantized wire format (``qring``/``qrd``) — the quantization
+  decision IS the algorithm choice (per-call forcible, no env needed);
+- a hierarchical schedule (``hring``/``htree``) — the topology
+  decision, per-call forcible on a multi-island comm;
+- a gated variant (``hring+q``/``htree+q``) — the hierarchical
+  schedule with its leader leg quantized, which only exists under
+  ``MPI4JAX_TPU_COLL_QUANT=force`` (the native gate is cached
+  per-process, so the driver measures these in a dedicated sub-job).
+
+:func:`joint_search` runs the model-seeded search: measure every
+eligible combo at a few anchor sizes, fit the cost model, then at every
+other size measure only the model's top-k predictions (plus anything
+the model has never seen) and crown the best *measured* combo — seeded
+by prediction, decided by measurement.  The winners collapse into the
+version-2 cache's per-size-band combination entries.
+
+Stdlib-only and side-effect free: the CLI (``__main__.py``) supplies
+the live ``measure`` callable; unit tests supply synthetic ones.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+try:
+    from ._model import CostModel
+except ImportError:  # pragma: no cover - standalone tooling load
+    import importlib.util as _ilu
+    import os as _os
+
+    _spec = _ilu.spec_from_file_location(
+        "m4j_tune_model_standalone",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "_model.py"))
+    _model_mod = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_model_mod)
+    CostModel = _model_mod.CostModel
+
+#: gated-variant suffix: the combo's leader leg rides the quantized
+#: wire under MPI4JAX_TPU_COLL_QUANT=force
+QUANT_LEG_SUFFIX = "+q"
+
+#: every point of the joint space per op (allgather has no quantized
+#: schedule — it is pure data movement and the wire format is lossy)
+JOINT_CANDIDATES: Dict[str, Tuple[str, ...]] = {
+    "allreduce": ("ring", "rd", "tree", "qring", "qrd",
+                  "hring", "htree", "hring+q", "htree+q"),
+    "allgather": ("ring", "rd", "tree", "hring", "htree"),
+}
+
+
+def combo_algo(combo: str) -> str:
+    """The per-call-forcible algorithm under a combo label."""
+    return combo[:-len(QUANT_LEG_SUFFIX)] \
+        if combo.endswith(QUANT_LEG_SUFFIX) else combo
+
+
+def combo_gates(combo: str) -> Dict[str, str]:
+    """Env gates (beyond the allow defaults) a combo needs to run as
+    measured.  Empty for every per-call-forcible combo."""
+    if combo.endswith(QUANT_LEG_SUFFIX):
+        return {"MPI4JAX_TPU_COLL_QUANT": "force"}
+    return {}
+
+
+def check_combo(combo: str, op: str) -> str:
+    combo = str(combo).strip()
+    if combo not in JOINT_CANDIDATES.get(op, ()):
+        raise ValueError(
+            f"unknown joint combination {combo!r} for {op} "
+            f"(expected one of {JOINT_CANDIDATES.get(op)})")
+    return combo
+
+
+def eligible_combos(op: str, *, multi_island: bool, quant_mode: str,
+                    hier_mode: str) -> List[str]:
+    """The combos worth measuring on THIS deployment shape: quantized
+    wire formats drop under quant deny (the engine would degrade the
+    rows right back), hierarchical schedules need a discovered
+    multi-island topology (anywhere else they degrade to their flat
+    twins and the sweep would time ring/tree twice under wrong
+    labels), and the quantized-leader-leg variants need both."""
+    try:
+        from . import HIER_ALGOS, QUANT_ALGOS  # shared vocabulary
+    except ImportError:  # standalone load: the engine's stable names
+        HIER_ALGOS = frozenset(("hring", "htree"))
+        QUANT_ALGOS = frozenset(("qring", "qrd"))
+
+    out = []
+    for combo in JOINT_CANDIDATES[op]:
+        algo = combo_algo(combo)
+        quantized = algo in QUANT_ALGOS or combo.endswith(QUANT_LEG_SUFFIX)
+        if quantized and quant_mode == "deny":
+            continue
+        if algo in HIER_ALGOS and (not multi_island
+                                   or hier_mode == "deny"):
+            continue
+        out.append(combo)
+    return out
+
+
+def _anchor_sizes(sizes: Sequence[int], n_anchors: int = 3) -> List[int]:
+    """The sizes every combo is measured at to seed the model: the
+    extremes plus the middle of the ladder (log-wise) — enough to pin
+    each combo's alpha and beta, cheap enough to afford for every
+    candidate."""
+    ordered = sorted(set(int(s) for s in sizes))
+    if len(ordered) <= n_anchors:
+        return ordered
+    picks = {ordered[0], ordered[-1], ordered[len(ordered) // 2]}
+    return sorted(picks)
+
+
+def joint_search(
+    measure: Callable[[str, int, str], Optional[float]],
+    candidates_by_op: Dict[str, Sequence[str]],
+    sizes: Sequence[int],
+    *,
+    model: Optional[CostModel] = None,
+    topk: int = 3,
+    ranks: int = 0,
+    log: Optional[Callable[[dict], None]] = None,
+) -> Tuple[Dict[str, Dict[int, str]], List[dict], CostModel]:
+    """Model-seeded joint search.
+
+    ``measure(op, nbytes, combo)`` returns the agreed cross-rank median
+    seconds of one live measurement, or None when the combo cannot be
+    measured in this process (its gates are not active — the driver
+    runs those in a sub-job).  ``model`` may arrive pre-seeded from
+    ``--from-trace`` recordings; everything measured here is added to
+    it, so the returned model reflects the live run.
+
+    Returns ``(best, measurements, model)``: the best *measured* combo
+    per (op, size), the measurement rows (cache-payload shaped, each
+    stamped with its search phase), and the updated model.
+    """
+    model = model if model is not None else CostModel(world_size=ranks)
+    best: Dict[str, Dict[int, str]] = {}
+    measurements: List[dict] = []
+
+    def _measure(op, nbytes, combo, phase):
+        dt = measure(op, nbytes, combo)
+        if dt is None:
+            return None
+        model.add_sample(op, combo, nbytes, dt)
+        row = {"op": op, "bytes": int(nbytes), "combo": combo,
+               "algo": combo_algo(combo), "seconds": round(float(dt), 9),
+               "ranks": int(ranks), "phase": phase}
+        gates = combo_gates(combo)
+        if gates:
+            # the cache payload's top-level knobs stamp records the
+            # DRIVER's env; a gated combo's rows were measured under
+            # their own sub-job gates — say so per row, or the stamp
+            # would misstate exactly the measurements it exists for
+            row["gates"] = gates
+        measurements.append(row)
+        if log is not None:
+            log(row)
+        return dt
+
+    for op, cands in candidates_by_op.items():
+        cands = [check_combo(c, op) for c in cands]
+        if not cands:
+            continue
+        anchors = _anchor_sizes(sizes)
+        measured: Dict[int, Dict[str, float]] = {}
+        for nbytes in anchors:
+            for combo in cands:
+                dt = _measure(op, nbytes, combo, "anchor")
+                if dt is not None:
+                    measured.setdefault(nbytes, {})[combo] = dt
+        for nbytes in sorted(set(int(s) for s in sizes)):
+            here = measured.setdefault(nbytes, {})
+            if nbytes not in anchors:
+                ranked = model.rank_combos(op, nbytes, cands)
+                # measure the model's top-k predictions plus every
+                # combo it has no opinion on — prediction seeds, live
+                # measurement decides
+                chosen = [c for c, _ in ranked[:topk]]
+                chosen += [c for c, p in ranked[topk:] if p is None]
+                for combo in chosen:
+                    dt = _measure(op, nbytes, combo, "refine")
+                    if dt is not None:
+                        here[combo] = dt
+            if here:
+                best.setdefault(op, {})[nbytes] = min(here, key=here.get)
+    return best, measurements, model
+
+
+def merge_winners(
+    measurement_sets: Sequence[Sequence[dict]],
+) -> Tuple[Dict[str, Dict[int, str]], List[dict]]:
+    """Fold measurement rows from several sub-jobs (the base sweep and
+    the gated ``+q`` sweep) into one winner table: the best measured
+    combo per (op, size) across every set, plus the concatenated rows.
+    Re-measurements of one (op, size, combo) keep their best (the
+    quietest observation of the same schedule)."""
+    pooled: Dict[Tuple[str, int, str], float] = {}
+    rows: List[dict] = []
+    for mset in measurement_sets:
+        for row in mset:
+            combo = row.get("combo") or row.get("algo")
+            if not combo or float(row.get("seconds", 0)) <= 0:
+                continue
+            key = (str(row["op"]), int(row["bytes"]), str(combo))
+            dt = float(row["seconds"])
+            if key not in pooled or dt < pooled[key]:
+                pooled[key] = dt
+            rows.append(row)
+    best: Dict[str, Dict[int, str]] = {}
+    per: Dict[Tuple[str, int], Dict[str, float]] = {}
+    for (op, nbytes, combo), dt in pooled.items():
+        per.setdefault((op, nbytes), {})[combo] = dt
+    for (op, nbytes), by_combo in per.items():
+        best.setdefault(op, {})[nbytes] = min(by_combo, key=by_combo.get)
+    return best, rows

@@ -122,6 +122,17 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 tune_<world_size>.json``), written by
                                 ``python -m mpi4jax_tpu.tune`` and loaded
                                 at communicator creation.
+- ``MPI4JAX_TPU_TUNE_MODEL``  — full path of the persistent cost-model
+                                file (default ``~/.cache/mpi4jax_tpu/
+                                model_<world_size>[_<topohash>].json``),
+                                written by ``python -m mpi4jax_tpu.tune
+                                --joint`` and consulted by the schedule
+                                compiler when choosing gradient-bucket
+                                sizes and concurrency-group caps
+                                (docs/usage.md § Joint tuning).  The
+                                compiler only probes the disk when this
+                                knob is set — golden plans compiled
+                                without it stay byte-stable.
 - ``MPI4JAX_TPU_ANALYZE_TIMEOUT_S`` — wall-clock deadline (seconds,
                                 default 120; 0 = no deadline) for one
                                 virtual-world run of the static
@@ -379,6 +390,7 @@ KNOBS = {
     "MPI4JAX_TPU_COLL_ALGO": "force world-tier collective algorithms",
     "MPI4JAX_TPU_COLL_QUANT": "quantized wire formats: allow/deny/force",
     "MPI4JAX_TPU_TUNE_CACHE": "persistent autotune cache path",
+    "MPI4JAX_TPU_TUNE_MODEL": "persistent collective cost-model path",
     "MPI4JAX_TPU_TRACE": "record per-op events; dump/merge trace here",
     "MPI4JAX_TPU_TRACE_BUF_KB": "observability event-ring size (KB)",
     "MPI4JAX_TPU_PROGRESS_THREAD": "async progress engine on/off",
@@ -477,6 +489,35 @@ def hier_mode() -> str:
     raise ValueError(
         f"cannot parse MPI4JAX_TPU_HIER={raw!r} "
         "(expected allow, deny, or force)")
+
+
+def knob_env() -> dict:
+    """The RESOLVED tuning-relevant knob environment, for stamping into
+    benchmark records and tuner-cache payloads: every committed BENCH
+    artifact / derived cache names the gates it was measured under, so
+    it is reproducible without reading the shell history.
+
+    Values are the resolved modes (the same resolution the native layer
+    applies), not the raw strings — ``{"MPI4JAX_TPU_COLL_QUANT":
+    "allow", ...}``.  ``MPI4JAX_TPU_PLAN`` reports ``"0"`` when plan
+    execution is off and the spec (a path or ``"1"``) otherwise;
+    ``MPI4JAX_TPU_COLL_ALGO`` reports the raw force string or ``""``.
+    """
+    return {
+        "MPI4JAX_TPU_COLL_ALGO":
+            os.environ.get("MPI4JAX_TPU_COLL_ALGO", "").strip(),
+        "MPI4JAX_TPU_COLL_QUANT": quant_mode(),
+        "MPI4JAX_TPU_HIER": hier_mode(),
+        "MPI4JAX_TPU_URING": uring_mode(),
+        "MPI4JAX_TPU_PLAN": plan_spec() or "0",
+    }
+
+
+def tune_model_path():
+    """MPI4JAX_TPU_TUNE_MODEL: an explicit cost-model file path, or
+    None (the schedule compiler then never probes the disk for one)."""
+    raw = os.environ.get("MPI4JAX_TPU_TUNE_MODEL")
+    return raw if raw and raw.strip() else None
 
 
 def fake_hosts_spec():

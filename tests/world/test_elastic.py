@@ -161,6 +161,47 @@ def test_rank_failure_surfaces_as_exception(tmp_path):
     assert "UNREACHABLE" not in res.stdout
 
 
+# ---- bridge level: plans survive recovery (elastic-safe plans) -----
+
+
+def test_shrink_reproves_and_keeps_the_plan(tmp_path):
+    """The elastic-safe-plans acceptance scenario: a PLANNED job (every
+    step's gradient allreduces run through an installed proved plan,
+    signature-checked per op) loses rank 1 mid-job, shrinks 3 -> 2, and
+    ``bridge.rebuild`` re-derives + re-PROVES the plan for the new
+    world inside recovery — the job finishes with the plan still
+    active, zero signature mismatches, and the EXACT digest of an
+    uninterrupted planned run (instead of silently dropping to the
+    unplanned path, the pre-PR-12 behavior)."""
+    clean = _run("elastic_plan.py", 3, _port(10),
+                 {"MPI4JAX_TPU_CKPT_DIR": str(tmp_path / "clean")},
+                 prog_args=(10,))
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    assert clean.stdout.count("elastic_plan OK") == 3
+    assert "plan_active=1" in clean.stdout
+    d_clean = _digests(clean.stdout, "elastic_plan digest")
+    assert len(d_clean) == 1, clean.stdout
+
+    fault = _run("elastic_plan.py", 3, _port(11),
+                 {"MPI4JAX_TPU_FAULT":
+                      "rank=1,point=send,after=30,action=exit",
+                  "MPI4JAX_TPU_TIMEOUT_S": "8",
+                  "MPI4JAX_TPU_CKPT_DIR": str(tmp_path / "fault")},
+                 prog_args=(10,))
+    assert fault.returncode == 0, fault.stderr[-2000:]
+    # both survivors finish WITH the plan active and clean signatures
+    assert fault.stdout.count("elastic_plan OK") == 2
+    assert fault.stdout.count("np=2 plan_active=1 mismatches=0") == 2, \
+        fault.stdout
+    # recovery really did re-derive + re-prove (not reuse the np=3 plan)
+    assert "re-proved plan" in fault.stderr, fault.stderr[-2000:]
+    assert "np=2" in fault.stderr
+    assert "overlap preserved across recovery" in fault.stderr
+    assert "completed after recovery" in fault.stderr
+    # bit-identical trajectory: the MAX sync is world-size invariant
+    assert _digests(fault.stdout, "elastic_plan digest") == d_clean
+
+
 # ---- bridge level: serving recovery --------------------------------
 
 

@@ -1,0 +1,106 @@
+"""Bridge-level ELASTIC + PLANNED training rank program (no jax import,
+so it runs in ANY container via the parent-package shim).
+
+The elastic-safe-plans acceptance scenario: every step routes K small
+MAX allreduces through an installed, proved execution plan (bucket
+marks make it a rewritten plan; the runner signature-checks every op).
+A registered ``planrt.set_plan_source`` tells recovery how to re-derive
+the schedule for ANY world size, so when a rank dies mid-job
+``bridge.rebuild`` re-compiles and re-PROVES the plan for the shrunk
+world inside the recovery — the job keeps its plan instead of silently
+losing it.  The MAX gradient sync is world-size invariant, so the final
+state digest must be BIT-IDENTICAL to an uninterrupted planned run.
+
+Usage (under the launcher): elastic_plan.py [steps]
+Checkpoint directory: MPI4JAX_TPU_CKPT_DIR (set by the test).
+"""
+
+import hashlib
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+
+import numpy as np  # noqa: E402
+
+from mpi4jax_tpu.analysis import _events, _plan  # noqa: E402
+from mpi4jax_tpu.elastic import training  # noqa: E402
+from mpi4jax_tpu.runtime import bridge, planrt, transport  # noqa: E402
+
+STEPS = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+K = 4                 # planned allreduces per step (one plan cycle)
+SHAPE = (256,)        # f64: 2 KB — bucketable, so the plan is rewritten
+_MAX = 2              # native reduce-op code (tpucomm.h)
+
+
+def make_schedule(n):
+    """The per-step schedule for ANY world size: K adjacent small MAX
+    allreduces per rank — the shape the compiler marks as a gradient
+    bucket (=> a rewritten plan worth keeping across recovery)."""
+    events = {
+        r: [_events.CommEvent(r, i, "allreduce", reduce_op="MAX",
+                              dtype="float64", shape=SHAPE)
+            for i in range(K)]
+        for r in range(n)
+    }
+    return events, {(0,): tuple(range(n))}
+
+
+# HOW recovery re-derives the plan for a shrunk world: rebuild calls
+# this with the new size, compiles the schedule fresh, and re-proves it
+# before anything may execute — the elastic-safe-plans contract.
+planrt.set_plan_source(make_schedule)
+
+
+def grad(step, j):
+    # identical on every rank; MAX-synced, so the result is
+    # bit-identical for ANY world size and the trajectory survives a
+    # shrink bit-for-bit
+    return np.cos(np.arange(SHAPE[0]) * (step + 1) * 0.01 * (j + 1))
+
+
+def step_fn(state, step, comm):
+    rt = planrt.get(comm)
+    assert rt is not None and rt.enabled, \
+        f"step {step}: no active plan runner on this world"
+    g = np.zeros(8)
+    for j in range(K):
+        payload = grad(step, j)
+        out = rt.run_sync(
+            "allreduce",
+            lambda p=payload: bridge.allreduce(comm.handle, p, _MAX),
+            reduce_op="MAX", nbytes=payload.nbytes)
+        g = g + out[:8]
+    assert rt.stats["mismatches"] == 0, rt.stats
+    return state - 0.05 * g
+
+
+def main():
+    comm = transport.get_world_comm()
+    n, r = comm.size(), comm.rank()
+    events, comms = make_schedule(n)
+    plan = _plan.compile_schedules(events, comms)
+    assert plan.proved, plan.reasons
+    assert plan.rewritten, plan.format()  # bucket marks
+    assert planrt.install(comm.handle, plan, r), "planrt.install refused"
+
+    state = training.run(step_fn, np.zeros(8), steps=STEPS, save_every=2)
+
+    rt = planrt.get(comm)
+    assert rt is not None and rt.enabled, "plan lost by the end of the job"
+    assert rt.stats["mismatches"] == 0, rt.stats
+    rt.flush()
+    digest = hashlib.sha256(np.asarray(state).tobytes()).hexdigest()
+    print(f"elastic_plan digest r{comm.rank()} {digest}", flush=True)
+    print(f"elastic_plan OK np={comm.size()} plan_active=1 "
+          f"mismatches=0", flush=True)
+
+
+if __name__ == "__main__":
+    main()
