@@ -170,13 +170,17 @@ def reset() -> None:
 
 
 def record_span(name: str, t_unix: float, dur_s: float, *, peer=-1,
-                nbytes=0, tag=0, algo=None) -> None:
+                nbytes=0, tag=0, algo=None, tier=None) -> None:
     """Ops-layer span hook (called by ``tracing.CallTrace`` only when
     :func:`enabled` — callers guard, so the disabled path never reaches
-    here)."""
+    here).  ``tier`` marks a per-leg event (e.g. the Pallas ICI intra
+    leg's ``tier="ici"``) nested inside a whole-op record: stats then
+    attributes the leg's bytes in ``tier_bytes`` while the tuner keeps
+    ignoring tier-carrying events (``_usable_trace_event``), exactly as
+    it does for the native hierarchical leg events."""
     if _state.spans is None:
         return
-    _state.spans.append({
+    ev = {
         "name": name,
         "src": "ops",
         "ts_us": t_unix * 1e6 + _state.clock_offset_us,
@@ -187,7 +191,10 @@ def record_span(name: str, t_unix: float, dur_s: float, *, peer=-1,
         "peer": int(peer),
         "tag": int(tag),
         "algo": algo,
-    })
+    }
+    if tier:
+        ev["tier"] = str(tier)
+    _state.spans.append(ev)
 
 
 def _pull_native() -> None:

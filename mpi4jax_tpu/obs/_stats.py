@@ -13,10 +13,11 @@ Canonical event shape (every producer — the native ring, the ops-layer
 
 plus an optional ``wire_bytes`` carried ONLY when it differs from
 ``bytes`` (quantized collectives: the packed int8+scales payload), and
-an optional ``tier`` (``"intra"`` / ``"inter"``) carried ONLY on a
-hierarchical collective's per-leg events — the whole-op record stays
-tier-less, so per-leg rows never double-count against it and
-pre-topology recordings stay schema-compatible.
+an optional ``tier`` (``"intra"`` / ``"inter"`` on the native
+hierarchical legs; ``"ici"`` on the Pallas ICI intra leg's ops-src
+span) carried ONLY on a hierarchical collective's per-leg events — the
+whole-op record stays tier-less, so per-leg rows never double-count
+against it and pre-topology recordings stay schema-compatible.
 
 ``dispatch_us`` is the submission-queue delay of an engine-queued op
 (post -> native execution start; 0 for inline execution) — the host

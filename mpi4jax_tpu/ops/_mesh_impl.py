@@ -114,6 +114,10 @@ def allreduce(x, op: ReduceOp, axis):
         if _pallas_ring(axis):
             from . import pallas_collectives as _pc
 
+            # bandwidth-bound payloads dispatch to the fused
+            # double-buffered ring kernel inside (one launch for all
+            # hops; the same data plane the hierarchical schedules'
+            # ICI intra leg rides — topo/_ici_leg.py)
             return _pc.allreduce_sum(x, axis)
         return lax.psum(x, axis)
     if op.lax_kind == "max":

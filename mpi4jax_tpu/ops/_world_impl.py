@@ -571,6 +571,26 @@ def _emit_ffi_call(ctx, target, args, attrs, alias_in_out=False):
     return results
 
 
+def _ici_leg_blocks_ffi() -> bool:
+    """True when the ICI data-plane leg (topo/_ici_leg.py) could claim
+    allreduce calls at runtime: those must keep the host-callback route
+    — the leg hooks ``bridge.allreduce_raw``, which the native FFI
+    custom call bypasses.  Conservative by design (``force``, or
+    ``auto`` with TPU chips present): the per-call dtype/op/topology
+    gates live in the bridge hook, and a callback-routed allreduce the
+    leg then declines still runs the identical native schedule."""
+    from ..utils import config
+
+    mode = config.ici_leg_mode()
+    if mode == "off":
+        return False
+    if mode == "force":
+        return True
+    from .. import topo
+
+    return topo._tpu_chip_count() > 0
+
+
 def _register_ffi_lowering(p, target, identity_param=None,
                            alias_in_out=False):
     """cpu lowering: native FFI custom call, falling back to the host
@@ -592,11 +612,15 @@ def _register_ffi_lowering(p, target, identity_param=None,
         from ..runtime import bridge
 
         if (params.get("algo") or not params.get("ordered", True)
-                or not bridge.ffi_available()):
+                or not bridge.ffi_available()
+                or (target == "tpucomm_allreduce"
+                    and _ici_leg_blocks_ffi())):
             # unordered (explicit-token) mode keeps the callback route
             # (the FFI call's wire format carries the compiler token),
             # and so does a forced per-call algorithm (the quantized
-            # allreduce path) — the FFI attribute schema has no algo slot
+            # allreduce path) and an allreduce the ICI data-plane leg
+            # could claim (the leg hooks the bridge funnel the FFI
+            # call would bypass)
             return p._callback_lowering(ctx, *args, **params)
         params.pop("ordered", None)
         params.pop("algo", None)
@@ -636,6 +660,8 @@ def _token_ffi_attrs(name, params):
         return None
     if params.pop("algo", None) is not None:
         return None  # forced (quantized) algorithm: callback route only
+    if name == "allreduce" and _ici_leg_blocks_ffi():
+        return None  # the ICI leg hooks the bridge funnel, not the wire
     op = params.get("op")
     if op is not None and op.name not in _OP_CODE:
         return None  # custom ReduceOp: the fold runs in Python

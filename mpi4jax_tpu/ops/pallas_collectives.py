@@ -31,6 +31,19 @@ mode so the CPU test mesh exercises the identical code path.
 Opt-in routing: set ``MPI4JAX_TPU_PALLAS_COLLECTIVES=1`` and the mesh tier
 routes allreduce(SUM)/allgather/ring-sendrecv through this module (see
 ``_mesh_impl``); or call these functions directly.
+
+Beyond the hop-composed collectives, this module carries the **fused
+ring allreduce** (:func:`fused_ring_allreduce_sum`) — ONE kernel doing
+the whole double-buffered reduce-scatter + allgather, the next remote
+DMA in flight while the current chunk folds — and the **in-kernel int8
+wire codec** (:func:`quant_pack_pallas`), bit-compatible with the
+native ``tpucomm_quant_pack`` frame (``quant_pack_ref`` is the
+contract).  Both are the data plane of the hierarchical schedules'
+ICI intra-island leg (``topo/_ici_leg.py``, ``MPI4JAX_TPU_ICI_LEG``):
+the fused kernel realizes EXACTLY the ``topo.simulate_ring_sum``
+association (native chunk boundaries, local + incoming fold order), so
+the leg is bit-comparable against the numpy simulators, and the mesh
+tier's large-payload allreduce dispatch rides the same kernel.
 """
 
 from __future__ import annotations
@@ -610,14 +623,13 @@ def _allreduce_sum(x, axis, *, interpret=None):
     if flat.shape[0] <= BUTTERFLY_MAX_ELEMS and (n & (n - 1)) == 0:
         return _allreduce_butterfly(flat, axis, interpret).reshape(x.shape)
     if flat.shape[0] >= BIDIR_MIN_ELEMS and n > 2:
-        pad = (-flat.shape[0]) % (2 * n)
-        if pad:
-            flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
-        half = flat.shape[0] // 2
-        a, b = flat[:half], flat[half:]
-        ma, mb = _reduce_scatter_bidir(a, b, axis, interpret)
-        fa, fb = _all_gather_bidir(ma, mb, axis, interpret)
-        full = jnp.concatenate([fa.reshape(-1), fb.reshape(-1)])
+        # bandwidth-bound: the fused double-buffered ring — one kernel
+        # launch for all 2(n-1) hops, the next chunk's remote DMA in
+        # flight while the current one folds (the hop-composed bidir
+        # pair this replaced paid a kernel launch per hop; the split
+        # halves survive in _reduce_scatter_bidir/_all_gather_bidir
+        # for direct use)
+        return _fused_ring_allreduce_impl(x, axis, interpret)
     else:
         pad = (-flat.shape[0]) % n
         if pad:
@@ -638,6 +650,264 @@ def _allreduce_bwd(axis, _, g):
 
 
 allreduce_sum.defvjp(_allreduce_fwd, _allreduce_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused double-buffered ring allreduce — ONE kernel, DMA/compute overlap
+# ---------------------------------------------------------------------------
+
+
+def _make_fused_ring_kernel(n: int, cr: int):
+    """The whole ring allreduce in one kernel: a double-buffered
+    reduce-scatter (the next hop's remote DMA in flight while the
+    current chunk folds) followed by the allgather, ``n - 1`` hops each.
+
+    Buffers are ``(n * cr, 128)`` with chunk ``i`` at rows
+    ``[i*cr, (i+1)*cr)`` — the caller lays the native ``_chunk_lo``
+    chunks out zero-padded so the fold association is EXACTLY
+    ``topo.simulate_ring_sum``'s (local + incoming, ring arrival
+    order).
+
+    Reduce-scatter flow control: arrivals land in a 2-slot ``landing``
+    scratch; a slot is reused at step ``s + 2``, so after folding slot
+    ``s % 2`` the receiver returns a credit DMA to its LEFT neighbor,
+    and a sender past step 1 waits for that credit before starting —
+    the classical 2-deep producer/consumer handshake (sends at steps
+    ``0..n-4`` are pre-credited by the double buffer itself).  The
+    allgather needs none of this: step ``t`` forwards the chunk that
+    fully landed at step ``t - 1`` into its OWN rows on the receiver,
+    so regions never alias and per-step semaphores give exact
+    accounting."""
+
+    def kernel(meta_ref, x_ref, o_ref, landing, credit,
+               rs_send, rs_recv, cr_send, cr_recv, ag_send, ag_recv):
+        me = meta_ref[0]
+        right = meta_ref[1]
+        left = meta_ref[2]
+        o_ref[...] = x_ref[...]
+        pending = [None, None]
+        pending_cr = [None, None]
+        for s in range(n - 1):
+            slot = s % 2
+            sc = jnp.mod(me - s, n)
+            rc = jnp.mod(me - 1 - s, n)
+            if s >= 2:
+                # the credit our left-hand receiver sent after folding
+                # arrival s-2 frees its landing slot AND our send sem
+                pltpu.make_async_copy(
+                    credit.at[slot * 8:slot * 8 + 8, :],
+                    credit.at[slot * 8:slot * 8 + 8, :],
+                    cr_recv.at[slot],
+                ).wait()
+                pending[slot].wait_send()
+            c = pltpu.make_async_remote_copy(
+                src_ref=o_ref.at[pl.ds(sc * cr, cr), :],
+                dst_ref=landing.at[slot * cr:(slot + 1) * cr, :],
+                send_sem=rs_send.at[slot],
+                recv_sem=rs_recv.at[slot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            c.start()
+            pending[slot] = c
+            # wait for OUR arrival of this step, then fold it
+            pltpu.make_async_copy(
+                landing.at[slot * cr:(slot + 1) * cr, :],
+                landing.at[slot * cr:(slot + 1) * cr, :],
+                rs_recv.at[slot],
+            ).wait()
+            o_ref[pl.ds(rc * cr, cr), :] = (
+                o_ref[pl.ds(rc * cr, cr), :]
+                + landing[slot * cr:(slot + 1) * cr, :]
+            )
+            if s <= n - 4:
+                # landing slot drained: credit our left neighbor's
+                # step-(s+2) send (content is a doorbell, not data)
+                if s >= 2:
+                    pending_cr[slot].wait_send()
+                cc = pltpu.make_async_remote_copy(
+                    src_ref=credit.at[slot * 8:slot * 8 + 8, :],
+                    dst_ref=credit.at[slot * 8:slot * 8 + 8, :],
+                    send_sem=cr_send.at[slot],
+                    recv_sem=cr_recv.at[slot],
+                    device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+                cc.start()
+                pending_cr[slot] = cc
+        for c in pending:
+            if c is not None:
+                c.wait_send()
+        for cc in pending_cr:
+            if cc is not None:
+                cc.wait_send()
+        # allgather: after the reduce-scatter rank me owns chunk
+        # (me+1)%n; step t forwards chunk (me+1-t)%n (own, then the one
+        # that landed at step t-1) and waits for (me-t)%n from the left
+        ag_copies = []
+        for t in range(n - 1):
+            k = jnp.mod(me + 1 - t, n)
+            c = pltpu.make_async_remote_copy(
+                src_ref=o_ref.at[pl.ds(k * cr, cr), :],
+                dst_ref=o_ref.at[pl.ds(k * cr, cr), :],
+                send_sem=ag_send.at[t],
+                recv_sem=ag_recv.at[t],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            c.start()
+            ag_copies.append(c)
+            rk = jnp.mod(me - t, n)
+            pltpu.make_async_copy(
+                o_ref.at[pl.ds(rk * cr, cr), :],
+                o_ref.at[pl.ds(rk * cr, cr), :],
+                ag_recv.at[t],
+            ).wait()
+        for c in ag_copies:
+            c.wait_send()
+
+    return kernel
+
+
+def _fused_ring_layout(count: int, n: int):
+    """Native chunk geometry: ``per``-element ``_chunk_lo`` chunks, each
+    zero-padded to ``cpad`` (a lane multiple) so chunk boundaries land
+    on row boundaries of the ``(n*cr, 128)`` kernel buffer."""
+    per = -(-count // n)
+    cpad = max(-(-per // 128) * 128, 128)
+    return per, cpad, cpad // 128
+
+
+def _fused_ring_allreduce_impl(x, axis, interpret):
+    n = lax.axis_size(axis)
+    if n == 1:
+        return x
+    v, cdtype = _as_dma_dtype(x)
+    flat = v.reshape(-1)
+    count = flat.shape[0]
+    if count == 0:
+        return x
+    per, cpad, cr = _fused_ring_layout(count, n)
+    pieces = []
+    for i in range(n):
+        lo, hi = min(per * i, count), min(per * (i + 1), count)
+        seg = flat[lo:hi]
+        if hi - lo < cpad:
+            seg = jnp.concatenate(
+                [seg, jnp.zeros((cpad - (hi - lo),), flat.dtype)])
+        pieces.append(seg)
+    buf = jnp.concatenate(pieces).reshape(n * cr, 128)
+    me = lax.axis_index(axis).astype(jnp.int32)
+    meta = jnp.stack([me, _dst_logical(axis, 1), _dst_logical(axis, -1)])
+    out = pl.pallas_call(
+        _make_fused_ring_kernel(n, cr),
+        out_shape=_out_struct(buf, axis),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.SMEM),
+            pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pltpu.MemorySpace.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2 * cr, 128), buf.dtype),
+            pltpu.VMEM((16, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+        ],
+        interpret=_interpret(interpret),
+    )(meta, buf)
+    rows = out.reshape(n, cpad)
+    segs = [rows[i, :min(per * (i + 1), count) - min(per * i, count)]
+            for i in range(n)]
+    res = jnp.concatenate(segs).reshape(v.shape)
+    return res.view(cdtype).reshape(x.shape) if cdtype is not None else res
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _fused_ring_d(x, axis, interpret):
+    return _fused_ring_allreduce_impl(x, axis, interpret)
+
+
+def _fused_ring_fwd(x, axis, interpret):
+    return _fused_ring_allreduce_impl(x, axis, interpret), None
+
+
+def _fused_ring_bwd(axis, interpret, _, g):
+    # the cotangent of an allreduce-SUM is an allreduce-SUM
+    return (_fused_ring_allreduce_impl(g, axis, interpret),)
+
+
+_fused_ring_d.defvjp(_fused_ring_fwd, _fused_ring_bwd)
+
+
+def fused_ring_allreduce_sum(x, axis, *, interpret=None):
+    """Ring allreduce (SUM) in ONE fused kernel: double-buffered
+    reduce-scatter (next hop's remote DMA overlaps the current fold)
+    + allgather, with the native ``_chunk_lo`` chunk layout so the f32
+    result is bit-identical to ``topo.simulate_ring_sum`` over the
+    ring's per-rank inputs — the bit-parity contract the ICI
+    intra-island leg (``topo/_ici_leg.py``) is verified against.
+    Reverse-mode differentiable; fwd-mode raises."""
+    return _fused_ring_d(x, axis, interpret)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel int8 wire codec — bit-compatible with tpucomm_quant_pack
+# ---------------------------------------------------------------------------
+
+
+def _quant_pack_kernel(x_ref, scale_ref, codes_ref):
+    """One shot of the native wire codec's quantize step, every
+    intermediate forced to f32 exactly as ``quant_pack_ref`` (the
+    numpy contract of ``tpucomm_quant_pack``) computes it: per-256
+    absmax -> scale (amax/127, 1.0 for all-zero blocks) -> clip to
+    [-127, 127] -> round-half-even to int8.  IEEE f32 arithmetic is
+    deterministic, so the codes and scales are bit-identical to the
+    reference on every backend (interpret mode included)."""
+    x = x_ref[...]
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / jnp.float32(127.0),
+                      jnp.float32(1.0)).astype(jnp.float32)
+    inv = (jnp.float32(1.0) / scale).astype(jnp.float32)
+    v = (x * inv).astype(jnp.float32)
+    v = jnp.clip(v, jnp.float32(-127.0), jnp.float32(127.0))
+    codes_ref[...] = jnp.round(v).astype(jnp.int8)
+    scale_ref[...] = scale
+
+
+def quant_pack_pallas(x, *, interpret=None):
+    """The native int8 wire frame of a 1-D f32 array, quantized
+    IN-KERNEL: ``ceil(n/256)`` f32 block scales (bitcast to their
+    little-endian int8 bytes) followed by ``n`` int8 codes — the exact
+    ``tpucomm_quant_pack`` layout (``bridge.quant_packed_bytes``
+    bytes).  Bit-compatibility with ``quant_pack_ref`` is
+    test-enforced (the cross-ISA bit-identity suite); the quantized
+    ICI leg ships these bytes to the leader leg with no host-side
+    pack."""
+    from .quantized import QUANT_BLOCK
+
+    flat = jnp.asarray(x, jnp.float32).reshape(-1)
+    count = flat.shape[0]
+    if count == 0:
+        return jnp.zeros((0,), jnp.int8)
+    nb = -(-count // QUANT_BLOCK)
+    pad = nb * QUANT_BLOCK - count
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    scales, codes = pl.pallas_call(
+        _quant_pack_kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((nb, 1), jnp.float32),
+            jax.ShapeDtypeStruct((nb, QUANT_BLOCK), jnp.int8),
+        ),
+        interpret=_interpret(interpret),
+    )(flat.reshape(nb, QUANT_BLOCK))
+    sbytes = lax.bitcast_convert_type(
+        scales.reshape(nb), jnp.int8).reshape(-1)
+    return jnp.concatenate([sbytes, codes.reshape(-1)[:count]])
 
 
 # ---------------------------------------------------------------------------

@@ -31,8 +31,11 @@ Two execution paths:
   world tier they ride the native transport.
 
 This module also hosts the **numpy reference** of the native wire codec
-(`quant_pack_ref` / `quant_unpack_ref`, bit-identical to
-``tpucomm_quant_pack``/``unpack`` — test-enforced) and per-rank
+(`quant_pack_ref` / `quant_unpack_ref` / `quant_pack_wire_ref`,
+bit-identical to ``tpucomm_quant_pack``/``unpack`` — test-enforced; the
+in-kernel Pallas codec ``pallas_collectives.quant_pack_pallas`` and the
+quantized ICI leg (``topo/_ici_leg.py``) are held to the same contract)
+and per-rank
 **schedule simulators** (:func:`simulate_qring_sum`,
 :func:`simulate_qrd_sum`) that reproduce the native algorithms' exact
 f32 arithmetic without any transport — the accuracy harness
@@ -248,6 +251,18 @@ def quant_pack_ref(x):
     v = np.clip(v, np.float32(-127.0), np.float32(127.0))
     codes = np.rint(v).astype(np.int8).reshape(-1)[:n]
     return scale, codes
+
+
+def quant_pack_wire_ref(x):
+    """The full native wire frame of a 1-D f32 array — ``ceil(n/256)``
+    f32 scales viewed as their little-endian int8 bytes, then ``n``
+    int8 codes (``bridge.quant_packed_bytes(n)`` bytes total): the
+    layout ``tpucomm_quant_pack`` emits and the in-kernel Pallas codec
+    (``pallas_collectives.quant_pack_pallas``) must match bit-for-bit
+    (test-enforced).  The quantized ICI leg's numpy backend ships
+    exactly these bytes to the leader leg."""
+    scales, codes = quant_pack_ref(x)
+    return np.concatenate([scales.view(np.int8), codes])
 
 
 def quant_unpack_ref(scales, codes):

@@ -540,6 +540,35 @@ def _post_init_setup(lib, handle, rank: int, size: int, *,
 #: finalized BEFORE the world (comm_finalize / rebuild do)
 _topo_handles: dict = {}
 
+#: per-rank ROLES of those sub-comms, keyed by world handle: the ICI
+#: data-plane leg (topo/_ici_leg.py) needs to know which handle is the
+#: intra comm vs the leaders comm (plus this rank / island), which the
+#: positional _topo_handles list cannot encode (non-leaders have no
+#: leader handle, singleton islands no intra handle)
+_topo_subcomms: dict = {}
+
+_ici_leg_mod = None
+
+
+def _ici_leg_hook(handle, buf, out, dtype_code, op_code, algo) -> bool:
+    """Pre-dispatch probe for the ICI data-plane leg: resolves to False
+    in a couple of dict lookups on ineligible calls (flat comms and
+    sub-comms never have a _topo_subcomms entry; the leg is f32 SUM
+    only — wire codes from native/tpucomm.h) so the native fast paths
+    keep their cost profile.  The full gate chain lives in
+    ``topo._ici_leg.maybe_allreduce``."""
+    global _ici_leg_mod
+    if int(handle) not in _topo_subcomms:
+        return False
+    if dtype_code != 11 or op_code != 0:
+        return False
+    if _ici_leg_mod is None:
+        from ..topo import _ici_leg
+
+        _ici_leg_mod = _ici_leg
+    return _ici_leg_mod.maybe_allreduce(
+        handle, buf, out, dtype_code, op_code, algo)
+
 
 def _install_topology(lib, handle, rank: int, size: int):
     """Run the discovery handshake, derive the sub-communicators on a
@@ -600,6 +629,13 @@ def _install_topology(lib, handle, rank: int, size: int):
                 "schedules stay degraded to their flat twins")
     if subs:
         _topo_handles[int(handle)] = subs
+        _topo_subcomms[int(handle)] = {
+            "topology": t,
+            "rank": rank,
+            "island": t.island_of[rank],
+            "intra": intra_h,
+            "leader": leader_h,
+        }
     topo._register(handle, t)
     return t
 
@@ -608,6 +644,7 @@ def _teardown_topology(handle) -> None:
     """Finalize the cached topology sub-comms of a world handle (they
     borrow its sockets — native finalize order requires children
     first) and forget its registry entries."""
+    _topo_subcomms.pop(int(handle), None)
     for sub in _topo_handles.pop(int(handle), []):
         try:
             get_lib().tpucomm_finalize(_i64(sub))
@@ -1154,7 +1191,15 @@ def allreduce_raw(handle, buf: np.ndarray, out: np.ndarray, dtype_code: int,
     the tuner/benchmark inner loop.  ``algo`` is a TpuCollAlgo code
     forced for this call (None/0 = engine selection); forcing against a
     pre-engine .so raises — silently running the default schedule under
-    a forced label would poison equivalence tests and tuning data."""
+    a forced label would poison equivalence tests and tuning data.
+
+    The ICI data-plane leg (``topo/_ici_leg.py``) intercepts BEFORE
+    both native paths: an eligible hierarchical f32 SUM on a topology
+    comm runs its intra-island phase over the Pallas ring instead of
+    the native shm/TCP legs (quiet fallthrough otherwise — the knob
+    parser is the loud guard)."""
+    if _ici_leg_hook(handle, buf, out, dtype_code, op_code, algo):
+        return
     if _exec_fn is not None:
         hc, d, ref = _exec_desc(handle, _K_ALLREDUCE, ("dtype", dtype_code),
                                 ("rop", op_code), ("algo", int(algo or 0)))

@@ -408,7 +408,11 @@ def check_topology(port):
     while each island's intra sub-comm gets one, the native layer
     reports the installed map, the decision table defaults the 16 MB
     allreduce to the hierarchical ring, and a forced hring matches the
-    flat result bit-for-bit on integer-valued floats."""
+    flat result bit-for-bit on integer-valued floats.  The report line
+    names the intra-island data plane: ``intra=ici(<backend>)`` when
+    the ICI leg is active on this comm (``MPI4JAX_TPU_ICI_LEG``),
+    ``intra=native`` otherwise — integer payloads keep the bit-parity
+    assertions valid either way (every association sums them exactly)."""
     import tempfile
 
     from ..utils import config
@@ -447,9 +451,12 @@ def check_topology(port):
         "    [np.arange(70000, dtype=np.float32) + r for r in range(4)],\n"
         "    t.islands)\n"
         "assert np.array_equal(out, sim), 'hring diverged from simulator'\n"
+        "st = topo.ici_leg_status(c.handle)\n"
+        "intra = ('ici(' + st['backend'] + ')') if st['active'] \\\n"
+        "    else 'native'\n"
         "if c.rank() == 0:\n"
         "    print('topology-ok', t.render(), 'fp=' + t.fingerprint(),\n"
-        "          'algo16mb=' + pick, flush=True)\n"
+        "          'algo16mb=' + pick, 'intra=' + intra, flush=True)\n"
         % (REPO, REPO)
     )
     with tempfile.NamedTemporaryFile(

@@ -309,8 +309,13 @@ def discover(handle, rank: int, size: int) -> Topology:
 def __getattr__(name):
     # lazy numpy-needing re-exports, keeping the package stdlib-importable
     if name in ("simulate_hring_sum", "simulate_htree_sum",
-                "simulate_ring_sum", "simulate_rd_sum"):
+                "simulate_ring_sum", "simulate_rd_sum",
+                "simulate_ici_q_sum"):
         from . import _simulate
 
         return getattr(_simulate, name)
+    if name in ("ici_leg_active", "ici_leg_backend", "ici_leg_status"):
+        from . import _ici_leg
+
+        return getattr(_ici_leg, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -9,7 +9,10 @@ quantized schedules):
 - intra-island reduce: sequential member-order folding (both native
   intra paths — the shm arena's ``vertical_reduce`` and the serial TCP
   reduce — combine in member order, so ONE simulator covers shm on and
-  off);
+  off); under the ICI data-plane leg (``MPI4JAX_TPU_ICI_LEG``, see
+  ``topo/_ici_leg.py``) the intra phase is instead a chunked ring
+  reduce-scatter/allgather per island — ``intra="ring"`` replays that
+  association with the same ``simulate_ring_sum`` fold;
 - ``hring`` leader leg: the chunked ring reduce-scatter/allgather
   (every chunk accumulates contributions in ring arrival order);
 - ``htree`` leader leg: recursive doubling with the standard
@@ -117,20 +120,92 @@ def _island_sums(inputs: Sequence[np.ndarray],
     return sums
 
 
+def _intra_sums(inputs: Sequence[np.ndarray],
+                islands: Sequence[Sequence[int]],
+                intra: str) -> List[np.ndarray]:
+    if intra == "member":
+        return _island_sums(inputs, islands)
+    if intra == "ring":
+        # the ICI leg's intra phase: a chunked ring reduce-scatter +
+        # allgather inside each island (the Pallas kernel and its numpy
+        # twin both realize exactly this fold; every member finishes
+        # with identical bits, so one array per island suffices)
+        return [simulate_ring_sum([inputs[m] for m in members])
+                for members in islands]
+    raise ValueError(f"unknown intra association {intra!r} "
+                     "(expected 'member' or 'ring')")
+
+
 def simulate_hring_sum(inputs: Sequence[np.ndarray],
-                       islands: Sequence[Sequence[int]]) -> np.ndarray:
+                       islands: Sequence[Sequence[int]],
+                       intra: str = "member") -> np.ndarray:
     """Bit-exact model of the native ``hring`` f32 SUM allreduce:
     ``inputs`` is one array per world rank, ``islands`` the member-rank
     lists in island order (``Topology.islands``).  Returns the result
     every rank holds (phase 3 broadcasts the leader's bytes verbatim,
-    so all ranks are identical)."""
-    sums = _island_sums(inputs, islands)
+    so all ranks are identical).
+
+    ``intra`` selects the phase-1 association: ``"member"`` (native
+    shm/TCP sequential fold, the default) or ``"ring"`` (the ICI
+    data-plane leg's per-island ring reduce-scatter/allgather)."""
+    sums = _intra_sums(inputs, islands, intra)
     return simulate_ring_sum(sums)
 
 
 def simulate_htree_sum(inputs: Sequence[np.ndarray],
-                       islands: Sequence[Sequence[int]]) -> np.ndarray:
+                       islands: Sequence[Sequence[int]],
+                       intra: str = "member") -> np.ndarray:
     """Bit-exact model of the native ``htree`` f32 SUM allreduce
-    (recursive-doubling leader leg)."""
-    sums = _island_sums(inputs, islands)
+    (recursive-doubling leader leg).  ``intra`` as in
+    :func:`simulate_hring_sum`."""
+    sums = _intra_sums(inputs, islands, intra)
     return simulate_rd_sum(sums)
+
+
+def _quant_refs():
+    """The numpy wire-codec references from ``ops/quantized.py``.
+
+    Package import first; standalone file load as the fallback so the
+    bridge-level world programs (parent-package shim, no jax) can
+    simulate the quantized ICI leg in any container."""
+    global _QUANT_REFS
+    if _QUANT_REFS is None:
+        try:
+            from ..ops import quantized as q
+        except Exception:
+            import importlib.util
+            import os
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, "ops", "quantized.py")
+            spec = importlib.util.spec_from_file_location(
+                "_m4j_quantized_for_simulate", path)
+            q = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(q)
+        _QUANT_REFS = q
+    return _QUANT_REFS
+
+
+_QUANT_REFS = None
+
+
+def simulate_ici_q_sum(inputs: Sequence[np.ndarray],
+                       islands: Sequence[Sequence[int]]) -> np.ndarray:
+    """Bit-exact model of the quantized ICI-leg f32 SUM allreduce
+    (``hring+q``/``htree+q`` with ``MPI4JAX_TPU_ICI_LEG`` active).
+
+    Phase 1 is the per-island ring fold; each island's sum is then
+    packed once with the int8 wire codec (``quant_pack_ref`` — the
+    in-kernel Pallas codec is bit-compatible by contract), the leaders
+    exchange the packed frames losslessly, and EVERY leader dequantizes
+    and folds them in island order in f32.  One qdq per contribution —
+    the leader exchange itself adds no further quantization error —
+    and the fold order is island order on every rank, so the result is
+    rank-consistent by construction."""
+    q = _quant_refs()
+    sums = _intra_sums(inputs, islands, "ring")
+    acc = None
+    for s in sums:
+        scales, codes = q.quant_pack_ref(s)
+        d = q.quant_unpack_ref(scales, codes)
+        acc = d if acc is None else (acc + d).astype(np.float32)
+    return acc

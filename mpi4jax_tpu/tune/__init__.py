@@ -529,8 +529,10 @@ def _notice_shadowed() -> None:
     Covered shadows: ``MPI4JAX_TPU_COLL_ALGO`` replacing a cached
     algorithm outright; ``MPI4JAX_TPU_COLL_QUANT=deny`` degrading a
     cached quantized pick to its exact twin; a joint-cache ``+q``
-    combo whose quantized leader leg needs ``COLL_QUANT=force``; and
-    ``MPI4JAX_TPU_HIER=deny`` flattening a cached hierarchical pick.
+    combo whose quantized leader leg needs ``COLL_QUANT=force``; an
+    ``+ici`` combo whose intra leg is switched off by
+    ``MPI4JAX_TPU_ICI_LEG=off``; and ``MPI4JAX_TPU_HIER=deny``
+    flattening a cached hierarchical pick.
     """
     if _cache_table is None:
         return
@@ -551,26 +553,34 @@ def _notice_shadowed() -> None:
     cfg = _config_mod()
     try:
         qm, hm = cfg.quant_mode(), cfg.hier_mode()
+        im = cfg.ici_leg_mode()
     except ValueError:
         # a malformed gate is about to abort the job loudly anyway
-        qm = hm = "allow"
+        qm = hm = im = "allow"
     joint = _submodule("_joint")
     picks = _cache_combos or _cache_table
     for op, entries in sorted(picks.items()):
         for mb, combo in entries:
             algo = joint.combo_algo(combo)
+            gates = joint.combo_gates(combo)
             where = f"{op} >= {mb} B (cache: {_cache_origin})"
             if algo in QUANT_ALGOS and qm == "deny":
                 msgs.append(
                     f"MPI4JAX_TPU_COLL_QUANT=deny degrades the installed "
                     f"cache pick '{combo}' to its exact twin "
                     f"'{EXACT_TWIN[algo]}' for {where}")
-            elif combo.endswith(joint.QUANT_LEG_SUFFIX) and qm != "force":
+            elif "MPI4JAX_TPU_COLL_QUANT" in gates and qm != "force":
                 msgs.append(
                     f"the installed joint-cache pick '{combo}' needs "
                     f"MPI4JAX_TPU_COLL_QUANT=force for its quantized "
                     f"leader leg; the active gate '{qm}' leaves that leg "
                     f"exact ('{algo}' runs) for {where}")
+            if "MPI4JAX_TPU_ICI_LEG" in gates and im == "off":
+                msgs.append(
+                    f"the installed joint-cache pick '{combo}' rides the "
+                    f"Pallas ICI intra-island leg; MPI4JAX_TPU_ICI_LEG=off "
+                    f"keeps the native intra paths ('{algo}' runs) for "
+                    f"{where}")
             if algo in HIER_ALGOS and hm == "deny":
                 flat = "ring" if algo == "hring" else "tree"
                 msgs.append(

@@ -16,10 +16,12 @@ quantization x topology combinations compete in one space, seeded by a
 cost model (``tune/_model.py``) fit from anchor measurements (and, with
 ``--from-trace``, from real-run recordings) and refined by live
 measurement of the model's top-k per size.  Combinations whose gates
-are per-process (``hring+q``/``htree+q`` — the hierarchical schedules
-with a quantized leader leg, which exist only under
-``MPI4JAX_TPU_COLL_QUANT=force``) are measured in a dedicated sub-job.
-The result is ONE v2 cache recording the winning *combination* per
+are per-process — ``+q`` (quantized leader leg, needs
+``MPI4JAX_TPU_COLL_QUANT=force``), ``+ici`` (intra-island legs on the
+Pallas ICI data plane, needs ``MPI4JAX_TPU_ICI_LEG=force``), and their
+composition — are grouped by gate set and measured in one dedicated
+sub-job per set, skipping sets whose gate cannot engage (quant deny /
+ici off).  The result is ONE v2 cache recording the winning *combination* per
 size band, plus the fitted cost-model file
 (``tune._model.model_path``) the schedule compiler can consult.
 
@@ -371,15 +373,30 @@ def _joint_rank(args) -> int:
         only = {c.strip() for c in args.joint_combos.split(",")
                 if c.strip()}
     qm, hm = quant_mode(), hier_mode()
+    # whether the ICI intra-island leg activates for f32 SUM allreduce
+    # in THIS process (topology eligibility x MPI4JAX_TPU_ICI_LEG)
+    leg_on = bool(multi and _topo.ici_leg_active(comm.handle))
 
-    def _runs_as_labeled(combo):
+    def _runs_as_labeled(combo, op):
         """Whether a per-call force of this combo's algorithm would
         actually RUN the labeled schedule under the process gates —
         the native resolver upgrades exact picks under a force gate,
         and a row timing the upgrade under an exact label is noise
         dressed up as a measurement."""
         algo = joint.combo_algo(combo)
-        if combo.endswith(joint.QUANT_LEG_SUFFIX):
+        gates = joint.combo_gates(combo)
+        wants_ici = "MPI4JAX_TPU_ICI_LEG" in gates
+        if wants_ici and not leg_on:
+            # +ici only exists where the leg activates (the driver
+            # measures these in their own gated sub-jobs)
+            return False
+        if op == "allreduce" and algo in tune.HIER_ALGOS \
+                and leg_on and not wants_ici:
+            # the leg hijacks every f32 SUM hring/htree dispatch:
+            # a plain (or +q) hierarchical row measured here would
+            # time the ICI leg under the wrong label
+            return False
+        if "MPI4JAX_TPU_COLL_QUANT" in gates:
             # +q only exists under the force gate (the driver measures
             # these in their own sub-job)
             return qm == "force"
@@ -395,8 +412,9 @@ def _joint_rank(args) -> int:
     candidates = {}
     for op in ops:
         cands = joint.eligible_combos(op, multi_island=multi,
-                                      quant_mode=qm, hier_mode=hm)
-        cands = [c for c in cands if _runs_as_labeled(c)]
+                                      quant_mode=qm, hier_mode=hm,
+                                      ici_leg=leg_on)
+        cands = [c for c in cands if _runs_as_labeled(c, op)]
         if only is not None:
             cands = [c for c in cands if c in only]
         if cands:
@@ -437,13 +455,14 @@ def _joint_rank(args) -> int:
 
 def _joint_driver(args) -> int:
     """Orchestrate the joint search: the base sub-job covers every
-    per-call-forcible combination; the gated quantized-leader-leg
-    variants (per-process COLL_QUANT=force) get their own sub-job on a
-    multi-island shape; the merged winners become ONE v2 cache plus the
-    fitted cost-model file."""
+    per-call-forcible combination; the gated variants (quantized
+    leader leg under per-process COLL_QUANT=force, ICI intra leg under
+    ICI_LEG=force, and their composition) each get their own sub-job
+    on a multi-island shape; the merged winners become ONE v2 cache
+    plus the fitted cost-model file."""
     import tempfile
 
-    from mpi4jax_tpu.utils.config import quant_mode
+    from mpi4jax_tpu.utils.config import ici_leg_mode, quant_mode
 
     joint = tune._submodule("_joint")
     _model = tune._submodule("_model")
@@ -479,7 +498,8 @@ def _joint_driver(args) -> int:
         # owns the gates: base job runs under allow, the forced_q job
         # sets its own; an operator's deny stays (it restricts the
         # candidate set instead).
-        for gate in ("MPI4JAX_TPU_COLL_QUANT", "MPI4JAX_TPU_HIER"):
+        for gate in ("MPI4JAX_TPU_COLL_QUANT", "MPI4JAX_TPU_HIER",
+                     "MPI4JAX_TPU_ICI_LEG"):
             if env.get(gate, "").strip() == "force" \
                     and gate not in extra_env:
                 print(f"tune: --joint: ignoring inherited {gate}=force "
@@ -526,23 +546,37 @@ def _joint_driver(args) -> int:
     topo_fp = base.get("topology")
     sets = [base["measurements"]]
 
-    if base.get("multi") and quant_mode() != "deny":
-        # the hierarchical schedules with a QUANTIZED leader leg exist
-        # only under the per-process force gate: measure them in their
-        # own sub-job, labeled as the +q combos they are
-        qcombos = ",".join(
-            c for c in joint.JOINT_CANDIDATES["allreduce"]
-            if c.endswith(joint.QUANT_LEG_SUFFIX))
-        rc, gated = _sub_job(
-            os.path.join(workdir, "forced_q.json"),
-            {"MPI4JAX_TPU_COLL_QUANT": "force"},
-            ["--joint-combos", qcombos], job_index=1)
-        if rc == 0 and gated is not None:
-            sets.append(gated["measurements"])
-        else:
-            print("tune: --joint: the quantized-leader-leg sub-job "
-                  "failed; the cache is written without the +q rows",
-                  file=sys.stderr, flush=True)
+    if base.get("multi"):
+        # the gated variants exist only under their per-process force
+        # gates: one sub-job per distinct gate set (quantized leader
+        # leg, ICI intra leg, and their composition), each measuring
+        # only the combos it gates — labeled as what actually ran.
+        # An operator's deny/off excludes the matching gate sets
+        # instead of mislabeling them.
+        by_gates = {}
+        for c in joint.JOINT_CANDIDATES["allreduce"]:
+            gates = joint.combo_gates(c)
+            if not gates:
+                continue
+            if "MPI4JAX_TPU_COLL_QUANT" in gates \
+                    and quant_mode() == "deny":
+                continue
+            if "MPI4JAX_TPU_ICI_LEG" in gates \
+                    and ici_leg_mode() == "off":
+                continue
+            by_gates.setdefault(tuple(sorted(gates.items())), []).append(c)
+        for j, gk in enumerate(sorted(by_gates), start=1):
+            combos = by_gates[gk]
+            rc, gated = _sub_job(
+                os.path.join(workdir, f"gated_{j}.json"), dict(gk),
+                ["--joint-combos", ",".join(combos)], job_index=j)
+            if rc == 0 and gated is not None:
+                sets.append(gated["measurements"])
+            else:
+                print(f"tune: --joint: the gated sub-job for "
+                      f"{', '.join(combos)} failed; the cache is "
+                      "written without those rows",
+                      file=sys.stderr, flush=True)
 
     best, rows = joint.merge_winners(sets)
     if not best:

@@ -21,7 +21,15 @@ A **combo** is a string naming one point of the joint space:
 - a gated variant (``hring+q``/``htree+q``) — the hierarchical
   schedule with its leader leg quantized, which only exists under
   ``MPI4JAX_TPU_COLL_QUANT=force`` (the native gate is cached
-  per-process, so the driver measures these in a dedicated sub-job).
+  per-process, so the driver measures these in a dedicated sub-job);
+- an ICI-data-plane variant (``hring+ici``/... and the doubly gated
+  ``hring+q+ici``/...) — the hierarchical schedule with its
+  intra-island leg on the Pallas fused ring (``topo/_ici_leg.py``),
+  which needs ``MPI4JAX_TPU_ICI_LEG`` active (``force`` in the
+  driver's sub-jobs; ``auto`` only activates on an all-ici-tier
+  island).  A shape where the leg cannot run (no TPU island, or
+  ``ICI_LEG=off``) EXCLUDES these combos from the candidate set —
+  they would silently measure the plain schedule under a wrong label.
 
 :func:`joint_search` runs the model-seeded search: measure every
 eligible combo at a few anchor sizes, fit the cost model, then at every
@@ -56,27 +64,43 @@ except ImportError:  # pragma: no cover - standalone tooling load
 #: wire under MPI4JAX_TPU_COLL_QUANT=force
 QUANT_LEG_SUFFIX = "+q"
 
+#: gated-variant suffix: the combo's intra-island leg rides the Pallas
+#: ICI data plane (topo/_ici_leg.py) under MPI4JAX_TPU_ICI_LEG=force
+ICI_LEG_SUFFIX = "+ici"
+
 #: every point of the joint space per op (allgather has no quantized
-#: schedule — it is pure data movement and the wire format is lossy)
+#: schedule — it is pure data movement and the wire format is lossy —
+#: and no ICI-leg variant — the leg is an f32 SUM allreduce schedule)
 JOINT_CANDIDATES: Dict[str, Tuple[str, ...]] = {
     "allreduce": ("ring", "rd", "tree", "qring", "qrd",
-                  "hring", "htree", "hring+q", "htree+q"),
+                  "hring", "htree", "hring+q", "htree+q",
+                  "hring+ici", "htree+ici", "hring+q+ici", "htree+q+ici"),
     "allgather": ("ring", "rd", "tree", "hring", "htree"),
 }
 
 
+def _combo_parts(combo: str) -> Tuple[str, frozenset]:
+    """``"hring+q+ici"`` -> ``("hring", {"q", "ici"})``."""
+    parts = str(combo).split("+")
+    return parts[0], frozenset(parts[1:])
+
+
 def combo_algo(combo: str) -> str:
     """The per-call-forcible algorithm under a combo label."""
-    return combo[:-len(QUANT_LEG_SUFFIX)] \
-        if combo.endswith(QUANT_LEG_SUFFIX) else combo
+    return _combo_parts(combo)[0]
 
 
 def combo_gates(combo: str) -> Dict[str, str]:
     """Env gates (beyond the allow defaults) a combo needs to run as
-    measured.  Empty for every per-call-forcible combo."""
-    if combo.endswith(QUANT_LEG_SUFFIX):
-        return {"MPI4JAX_TPU_COLL_QUANT": "force"}
-    return {}
+    measured.  Empty for every per-call-forcible combo; the suffixes
+    compose (``hring+q+ici`` needs both force gates)."""
+    _, legs = _combo_parts(combo)
+    gates: Dict[str, str] = {}
+    if "q" in legs:
+        gates["MPI4JAX_TPU_COLL_QUANT"] = "force"
+    if "ici" in legs:
+        gates["MPI4JAX_TPU_ICI_LEG"] = "force"
+    return gates
 
 
 def check_combo(combo: str, op: str) -> str:
@@ -89,13 +113,17 @@ def check_combo(combo: str, op: str) -> str:
 
 
 def eligible_combos(op: str, *, multi_island: bool, quant_mode: str,
-                    hier_mode: str) -> List[str]:
+                    hier_mode: str, ici_leg: bool = False) -> List[str]:
     """The combos worth measuring on THIS deployment shape: quantized
     wire formats drop under quant deny (the engine would degrade the
     rows right back), hierarchical schedules need a discovered
     multi-island topology (anywhere else they degrade to their flat
     twins and the sweep would time ring/tree twice under wrong
-    labels), and the quantized-leader-leg variants need both."""
+    labels), the quantized-leader-leg variants need both, and the
+    ``+ici`` variants need the ICI intra-island leg to actually
+    activate here (``ici_leg`` — no TPU island under ``auto``, or
+    ``MPI4JAX_TPU_ICI_LEG=off``, excludes them: a row timing the
+    native intra path under an ``+ici`` label would be a lie)."""
     try:
         from . import HIER_ALGOS, QUANT_ALGOS  # shared vocabulary
     except ImportError:  # standalone load: the engine's stable names
@@ -104,12 +132,14 @@ def eligible_combos(op: str, *, multi_island: bool, quant_mode: str,
 
     out = []
     for combo in JOINT_CANDIDATES[op]:
-        algo = combo_algo(combo)
-        quantized = algo in QUANT_ALGOS or combo.endswith(QUANT_LEG_SUFFIX)
+        algo, legs = _combo_parts(combo)
+        quantized = algo in QUANT_ALGOS or "q" in legs
         if quantized and quant_mode == "deny":
             continue
         if algo in HIER_ALGOS and (not multi_island
                                    or hier_mode == "deny"):
+            continue
+        if "ici" in legs and not ici_leg:
             continue
         out.append(combo)
     return out
@@ -207,7 +237,7 @@ def merge_winners(
     measurement_sets: Sequence[Sequence[dict]],
 ) -> Tuple[Dict[str, Dict[int, str]], List[dict]]:
     """Fold measurement rows from several sub-jobs (the base sweep and
-    the gated ``+q`` sweep) into one winner table: the best measured
+    the gated ``+q``/``+ici`` sweeps) into one winner table: the best measured
     combo per (op, size) across every set, plus the concatenated rows.
     Re-measurements of one (op, size, combo) keep their best (the
     quietest observation of the same schedule)."""

@@ -340,6 +340,21 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 bcast/reduce hierarchically at any
                                 size.  Must agree across ranks (the
                                 schedules exchange different frames).
+- ``MPI4JAX_TPU_ICI_LEG``     — gate over the ICI data-plane leg of the
+                                hierarchical schedules (``hring``/
+                                ``htree``): ``auto`` (default) runs the
+                                intra-island phase of an f32 SUM
+                                allreduce as a Pallas remote-DMA ring
+                                (in-kernel int8 codec under ``+q``)
+                                when EVERY multi-member island is an
+                                ici-tier TPU slice; ``off`` keeps the
+                                native shm/TCP intra paths; ``force``
+                                activates the leg regardless of tier
+                                (off-TPU it runs the leg's numpy twin /
+                                Pallas interpret mode — the dryrun and
+                                tier-1 axis).  Must agree across ranks
+                                (the leg exchanges different frames
+                                than the native intra paths).
 - ``MPI4JAX_TPU_PALLAS_COLLECTIVES`` — route eligible mesh-tier collectives
                                 (allreduce-SUM, allgather, ring sendrecv)
                                 through the Pallas RDMA ring kernels
@@ -403,6 +418,7 @@ KNOBS = {
     "MPI4JAX_TPU_TOPO": "topology discovery at comm creation: auto/off",
     "MPI4JAX_TPU_FAKE_HOSTS": "virtual host partition for topology tests",
     "MPI4JAX_TPU_HIER": "hierarchical schedules: allow/deny/force",
+    "MPI4JAX_TPU_ICI_LEG": "Pallas ICI intra-island leg: auto/off/force",
     "MPI4JAX_TPU_ELASTIC": "elastic worlds: RankFailure + recovery",
     "MPI4JAX_TPU_ELASTIC_DIR": "launcher<->rank generation announcements",
     "MPI4JAX_TPU_ELASTIC_POLICY": "dead-rank policy: shrink / respawn",
@@ -491,6 +507,24 @@ def hier_mode() -> str:
         "(expected allow, deny, or force)")
 
 
+def ici_leg_mode() -> str:
+    """``MPI4JAX_TPU_ICI_LEG`` as "auto" | "off" | "force" — gate over
+    the Pallas ICI data-plane leg of the hierarchical schedules (see
+    ``topo/_ici_leg.py``).  Strict: a typo aborts loudly rather than
+    silently riding the native shm/TCP intra paths."""
+    raw = os.environ.get("MPI4JAX_TPU_ICI_LEG")
+    if raw is None:
+        return "auto"
+    v = raw.strip()
+    if not v:
+        return "auto"
+    if v in ("auto", "off", "force"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_ICI_LEG={raw!r} "
+        "(expected auto, off, or force)")
+
+
 def knob_env() -> dict:
     """The RESOLVED tuning-relevant knob environment, for stamping into
     benchmark records and tuner-cache payloads: every committed BENCH
@@ -508,6 +542,7 @@ def knob_env() -> dict:
             os.environ.get("MPI4JAX_TPU_COLL_ALGO", "").strip(),
         "MPI4JAX_TPU_COLL_QUANT": quant_mode(),
         "MPI4JAX_TPU_HIER": hier_mode(),
+        "MPI4JAX_TPU_ICI_LEG": ici_leg_mode(),
         "MPI4JAX_TPU_URING": uring_mode(),
         "MPI4JAX_TPU_PLAN": plan_spec() or "0",
     }

@@ -121,6 +121,9 @@ def test_native_pack_bit_identical_to_reference(native_lib, n):
         scales, codes = q.quant_pack_ref(x)
         ref = np.concatenate([scales.view(np.int8), codes])
         np.testing.assert_array_equal(packed, ref)
+        # the whole-frame reference (what the ICI leg ships on the
+        # leader tier) is the same bytes — three codecs, one format
+        np.testing.assert_array_equal(q.quant_pack_wire_ref(x), ref)
         # unpack round-trips exactly the reference's dequantization
         back = np.empty(n, np.float32)
         rc = native_lib.tpucomm_quant_unpack(_p(packed), ctypes.c_int64(n),
@@ -163,6 +166,61 @@ def test_native_pack_bf16(native_lib):
     scales, codes = q.quant_pack_ref(f_from_bf)
     np.testing.assert_array_equal(
         packed, np.concatenate([scales.view(np.int8), codes]))
+
+
+def test_wire_ref_matches_reference_layout():
+    # pure numpy, no native build needed: the frame is scale bytes then
+    # codes, nothing else (the ICI leg's _unpack_fold depends on it)
+    rng = np.random.RandomState(3)
+    for n in (1, q.QUANT_BLOCK - 1, q.QUANT_BLOCK, q.QUANT_BLOCK + 1, 1000):
+        x = (rng.randn(n) * 5).astype(np.float32)
+        scales, codes = q.quant_pack_ref(x)
+        nb = (n + q.QUANT_BLOCK - 1) // q.QUANT_BLOCK
+        wire = q.quant_pack_wire_ref(x)
+        assert wire.shape == (4 * nb + n,) and wire.dtype == np.int8
+        np.testing.assert_array_equal(
+            wire, np.concatenate([scales.view(np.int8), codes]))
+
+
+def _pallas_codec_ok():
+    """The in-kernel codec needs the gated jax AND an importable Pallas
+    TPU backend (interpret mode runs it on CPU)."""
+    try:
+        import jax
+
+        parts = []
+        for piece in jax.__version__.split(".")[:3]:
+            parts.append(int("".join(c for c in piece if c.isdigit()) or 0))
+        if tuple(parts) < (0, 6, 0):
+            return False
+        from mpi4jax_tpu.ops import pallas_collectives  # noqa: F401
+
+        return True
+    except Exception:
+        return False
+
+
+@pytest.mark.skipif(not _pallas_codec_ok(),
+                    reason="needs jax >= 0.6 with Pallas")
+@pytest.mark.parametrize("n", [1, 255, 256, 257, 1000, 4096])
+def test_pallas_pack_bit_identical_to_reference(n):
+    """The cross-ISA contract extended to the THIRD codec: the Pallas
+    in-kernel pack (interpret mode here; the leader leg of the ICI data
+    plane on a slice) emits byte-identical wire frames to
+    ``quant_pack_ref``/``tpucomm_quant_pack``."""
+    import jax.numpy as jnp
+
+    from mpi4jax_tpu.ops import pallas_collectives as pc
+
+    rng = np.random.RandomState(n)
+    for x in (
+        (rng.randn(n) * rng.choice([1e-4, 1.0, 1e4])).astype(np.float32),
+        np.zeros(n, np.float32),
+        np.full(n, -3.25, np.float32),
+    ):
+        wire = np.asarray(pc.quant_pack_pallas(jnp.asarray(x),
+                                               interpret=True))
+        np.testing.assert_array_equal(wire, q.quant_pack_wire_ref(x))
 
 
 # ---------------- schedule simulators (accuracy-harness backbone) -----

@@ -176,6 +176,37 @@ def test_hier_simulator_single_island_is_member_fold():
     assert got[0] == np.float32(np.float32(1e8 + 1.0) - 1e8)
 
 
+def test_hier_simulator_intra_ring_is_the_ring_association():
+    # intra="ring" (the ICI-leg data plane) folds each island with the
+    # ring reduce-scatter association, NOT the sequential member fold
+    rng = np.random.RandomState(7)
+    vals = [rng.randn(513).astype(np.float32) for _ in range(5)]
+    islands = [[0, 1, 2], [3, 4]]
+    got = topo.simulate_hring_sum(vals, islands, intra="ring")
+    # phase 1 of the schedule == per-island simulate_ring_sum
+    isl0 = topo.simulate_ring_sum([vals[r] for r in islands[0]])
+    isl1 = topo.simulate_ring_sum([vals[r] for r in islands[1]])
+    want = topo.simulate_ring_sum([isl0, isl1])
+    assert np.array_equal(got, want)
+    assert np.allclose(got, np.sum(np.stack(vals), axis=0),
+                       rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError):
+        topo.simulate_hring_sum(vals, islands, intra="banana")
+
+
+def test_simulate_ici_q_sum_bound_and_determinism():
+    rng = np.random.RandomState(11)
+    vals = [rng.randn(700).astype(np.float32) * 3 for _ in range(6)]
+    islands = [[0, 1, 2, 3], [4, 5]]
+    got = topo.simulate_ici_q_sum(vals, islands)
+    exact = np.sum(np.stack(vals).astype(np.float64), axis=0)
+    denom = max(float(np.max(np.abs(exact))), 1e-6)
+    err = float(np.max(np.abs(got.astype(np.float64) - exact))) / denom
+    assert err < 5e-2, err  # the documented int8 wire bound
+    assert got.dtype == np.float32
+    assert np.array_equal(got, topo.simulate_ici_q_sum(vals, islands))
+
+
 # ---------------- topology-keyed tune cache ----------------
 
 def test_cache_path_topology_suffix(monkeypatch):
@@ -288,6 +319,88 @@ def test_topo_and_hier_knob_parsers(monkeypatch):
         config.hier_mode()
     monkeypatch.setenv("MPI4JAX_TPU_FAKE_HOSTS", "r0|r1")
     assert config.fake_hosts_spec() == "r0|r1"
+
+
+def test_ici_leg_knob_parser(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_ICI_LEG", raising=False)
+    assert config.ici_leg_mode() == "auto"
+    for v in ("auto", "off", "force"):
+        monkeypatch.setenv("MPI4JAX_TPU_ICI_LEG", v)
+        assert config.ici_leg_mode() == v
+    monkeypatch.setenv("MPI4JAX_TPU_ICI_LEG", "on")  # typo: abort loudly
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_ICI_LEG"):
+        config.ici_leg_mode()
+    monkeypatch.delenv("MPI4JAX_TPU_ICI_LEG", raising=False)
+    assert config.knob_env()["MPI4JAX_TPU_ICI_LEG"] == "auto"
+
+
+# ---------------- the ICI data-plane leg (process-local) ----------------
+
+
+def _ici_leg_mod():
+    import importlib
+
+    return importlib.import_module(topo.__name__ + "._ici_leg")
+
+
+def test_ici_leg_eligibility_gating(monkeypatch):
+    leg = _ici_leg_mod()
+    monkeypatch.delenv("MPI4JAX_TPU_HIER", raising=False)
+    monkeypatch.delenv("MPI4JAX_TPU_PLAN", raising=False)
+    t_ici = topo.Topology([_fp("a", tpu=4), _fp("a", tpu=4),
+                           _fp("b", tpu=4), _fp("b", tpu=4)])
+    t_shm = topo.Topology([_fp("a"), _fp("a"), _fp("b"), _fp("b")])
+    t_flat = topo.Topology([_fp("a", tpu=4)] * 4)
+    # auto: every multi-member island must be fully ici-tier
+    assert leg.eligible(t_ici, mode="auto")
+    assert not leg.eligible(t_shm, mode="auto")
+    # force skips ONLY the tier check (the off-TPU tier-1 axis)
+    assert leg.eligible(t_shm, mode="force")
+    # off / no topology / flat world: never
+    assert not leg.eligible(t_ici, mode="off")
+    assert not leg.eligible(None, mode="force")
+    assert not leg.eligible(t_flat, mode="force")
+    # hier deny must keep degrading to the flat twins
+    monkeypatch.setenv("MPI4JAX_TPU_HIER", "deny")
+    assert not leg.eligible(t_ici, mode="force")
+    monkeypatch.delenv("MPI4JAX_TPU_HIER", raising=False)
+    # plan execution owns the schedule: the leg steps aside
+    monkeypatch.setenv("MPI4JAX_TPU_PLAN", "/tmp/plan.json")
+    assert not leg.eligible(t_ici, mode="force")
+
+
+def test_ici_leg_status_and_backend(monkeypatch):
+    leg = _ici_leg_mod()
+    monkeypatch.setenv("MPI4JAX_TPU_ICI_LEG", "force")
+    st = topo.ici_leg_status()
+    assert st["mode"] == "force"
+    assert st["backend"] in ("pallas", "numpy")
+    assert st["backend"] == leg.ici_leg_backend()
+    assert st["active"] is False  # no handle given
+    monkeypatch.delenv("MPI4JAX_TPU_ICI_LEG", raising=False)
+    assert topo.ici_leg_status()["mode"] == "auto"
+
+
+def test_joint_ici_combos_need_the_leg():
+    _joint = tune._submodule("_joint")
+    base = dict(multi_island=True, quant_mode="allow", hier_mode="allow")
+    # without the leg (the 3-kwarg legacy call shape): +ici excluded
+    legless = _joint.eligible_combos("allreduce", **base)
+    assert not any("ici" in c for c in legless)
+    with_leg = _joint.eligible_combos("allreduce", ici_leg=True, **base)
+    for c in ("hring+ici", "htree+ici", "hring+q+ici", "htree+q+ici"):
+        assert c in with_leg
+    # quant deny drops the +q+ici composites but keeps the exact +ici
+    qdeny = _joint.eligible_combos("allreduce", ici_leg=True,
+                                   multi_island=True, quant_mode="deny",
+                                   hier_mode="allow")
+    assert "hring+ici" in qdeny and "hring+q+ici" not in qdeny
+    assert _joint.combo_algo("hring+q+ici") == "hring"
+    assert _joint.combo_gates("htree+q+ici") == {
+        "MPI4JAX_TPU_COLL_QUANT": "force",
+        "MPI4JAX_TPU_ICI_LEG": "force"}
+    assert _joint.combo_gates("hring+ici") == {
+        "MPI4JAX_TPU_ICI_LEG": "force"}
 
 
 # ---------------- obs: tier split ----------------

@@ -14,6 +14,10 @@ elastic bridge tests.
 - ``MPI4JAX_TPU_HIER=deny`` runs the same program with the
   hierarchical default degraded (the program's flat-vs-hring pair
   still holds: forced hring degrades to ring bit-for-bit);
+- ``MPI4JAX_TPU_ICI_LEG=force`` runs it with the ICI data-plane leg
+  active (exact, and composed with ``COLL_QUANT=force`` for the
+  in-kernel int8 wire), parity against ``simulate_hring_sum(...,
+  intra="ring")`` / ``simulate_ici_q_sum``; ``off`` must be inert;
 - elastic: a rank death that EMPTIES an island shrinks np=3 (2+1) to
   np=2 and the rebuilt world re-discovers a clean flat topology.
 """
@@ -66,6 +70,35 @@ def test_hier_equivalence(np_, fake, expect, shm):
     res = _launch("topo_ops.py", np_, fake, expect, env_extra=env)
     assert res.returncode == 0, res.stderr + res.stdout
     assert res.stdout.count("topo_ops OK") == np_
+
+
+@pytest.mark.parametrize("np_,fake,expect,quant", [
+    (4, "r0,r1|r2,r3", "0,0,1,1", False),
+    (6, "r0,r1,r2,r3|r4,r5", "0,0,0,0,1,1", False),
+    (4, "r0,r1|r2,r3", "0,0,1,1", True),
+])
+def test_ici_leg_forced_equivalence(np_, fake, expect, quant):
+    # MPI4JAX_TPU_ICI_LEG=force routes every f32 SUM hring/htree
+    # through the ICI data plane (topo/_ici_leg.py — the Pallas fused
+    # ring's numpy twin in a jax-less container): the program's
+    # simulator expectation switches to intra="ring" and every exact
+    # row must stay bit-identical to the native paths.  With
+    # COLL_QUANT=force on top, the leader leg exchanges the in-kernel
+    # int8 wire frames and parity is against simulate_ici_q_sum.
+    env = {"MPI4JAX_TPU_ICI_LEG": "force"}
+    if quant:
+        env["MPI4JAX_TPU_COLL_QUANT"] = "force"
+    res = _launch("topo_ops.py", np_, fake, expect, env_extra=env)
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("topo_ops OK") == np_
+
+
+def test_ici_leg_off_is_inert():
+    # the explicit off mode must leave the native schedules untouched
+    res = _launch("topo_ops.py", 4, "r0,r1|r2,r3", "0,0,1,1",
+                  env_extra={"MPI4JAX_TPU_ICI_LEG": "off"})
+    assert res.returncode == 0, res.stderr + res.stdout
+    assert res.stdout.count("topo_ops OK") == 4
 
 
 def test_noncontiguous_islands():

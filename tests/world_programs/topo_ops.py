@@ -23,6 +23,17 @@ np=6, shm on and off).  Asserts:
   results (exact payloads);
 - MPI4JAX_TPU_HIER=deny degrades hring to the flat ring bit-for-bit.
 
+Under ``MPI4JAX_TPU_ICI_LEG=force`` the same battery asserts the ICI
+data-plane leg instead: f32 SUM routes through ``topo/_ici_leg.py``
+(the Pallas fused ring's numpy twin in a jax-less container — the
+identical association by contract), so the simulator expectation
+switches to ``intra="ring"``; with ``MPI4JAX_TPU_COLL_QUANT=force`` on
+top, to ``topo.simulate_ici_q_sum`` (and the flat-default comparison
+loosens to the int8 error bound — quantization is lossy by design).
+Everything else (integer/MAX/bf16 rows, allgather, bcast/reduce) is
+ineligible for the leg and must stay bit-identical to the native
+paths.
+
 Bridge-level with the parent-package shim (no jax import): runs in ANY
 container, like the coalescing bridge programs.
 """
@@ -80,11 +91,17 @@ def main():
     if len(my_members) > 1:
         intra_active, _, _ = bridge.shm_info(subs[0])
         assert intra_active == shm_on, (intra_active, shm_on)
-    if not os.environ.get("MPI4JAX_TPU_COLL_ALGO"):
+    if (not os.environ.get("MPI4JAX_TPU_COLL_ALGO")
+            and not os.environ.get("MPI4JAX_TPU_COLL_QUANT")):
+        # (a forced quant gate upgrades the default table to the
+        # quantized twins — the quant suite owns those assertions)
         assert comm.coll_algo("allreduce", 16 << 20) == "hring"
         assert comm.coll_algo("allreduce", 1024) == "tree"
 
     deny = os.environ.get("MPI4JAX_TPU_HIER", "allow").strip() == "deny"
+    leg = os.environ.get("MPI4JAX_TPU_ICI_LEG", "").strip() == "force"
+    legq = leg and (os.environ.get("MPI4JAX_TPU_COLL_QUANT", "").strip()
+                    == "force")
 
     rng = np.random.RandomState(5)
     for count in (3, 513, 70000):  # < n_islands, odd small, > 64KB f32
@@ -99,6 +116,10 @@ def main():
             for dcode, base, op in ((I32, base_i, SUM), (F32, base_x, SUM),
                                     (F32, base_f, MAX),
                                     (BF16, bf_bits, MAX)):
+                if legq and dcode == F32 and op == SUM:
+                    # the quantized leg is lossy by design: this row is
+                    # covered by the simulate_ici_q_sum parity below
+                    continue
                 x = base[rank].copy()
                 ref = np.empty_like(x)
                 bridge.allreduce_raw(h, x, ref, dcode, op)
@@ -122,17 +143,28 @@ def main():
                     assert np.array_equal(out, want), (
                         f"denied {algo}: not the flat ring")
             else:
-                sim_fn = (topo.simulate_hring_sum if algo == "hring"
-                          else topo.simulate_htree_sum)
-                want = sim_fn([base_f[r] for r in range(size)], t.islands)
+                parts = [base_f[r] for r in range(size)]
+                if legq:
+                    want = topo.simulate_ici_q_sum(parts, t.islands)
+                else:
+                    sim_fn = (topo.simulate_hring_sum if algo == "hring"
+                              else topo.simulate_htree_sum)
+                    want = sim_fn(parts, t.islands,
+                                  intra="ring" if leg else "member")
                 assert np.array_equal(out, want), (
-                    f"{algo} count={count}: native diverges from the "
-                    f"numpy simulator (maxdiff "
+                    f"{algo} count={count} leg={leg} q={legq}: native "
+                    f"diverges from the numpy simulator (maxdiff "
                     f"{np.max(np.abs(out - want))})")
-            # ...and within fp tolerance of the flat default
+            # ...and within fp tolerance of the flat default (the int8
+            # error bound when the quantized leg is forced)
             ref = np.empty_like(x)
             bridge.allreduce_raw(h, x, ref, F32, SUM)
-            assert np.allclose(out, ref, rtol=1e-5, atol=1e-5 * size)
+            if legq:
+                denom = max(float(np.max(np.abs(ref))), 1e-6)
+                err = float(np.max(np.abs(out - ref))) / denom
+                assert err < 5e-2, f"{algo} quant leg rel err {err:.2e}"
+            else:
+                assert np.allclose(out, ref, rtol=1e-5, atol=1e-5 * size)
             # rank consistency: every rank holds the same bits
             rows = bridge.allgather(h, out, size)
             for r in range(size):
@@ -182,7 +214,13 @@ def main():
     b = np.empty_like(xi)
     bridge.allreduce_raw(h, xi, a, F32, SUM, algo=tune.ALGO_CODES["ring"])
     bridge.allreduce_raw(h, xi, b, F32, SUM, algo=tune.ALGO_CODES["hring"])
-    assert np.array_equal(a, b), "exact-int hring != ring"
+    if legq:
+        # the quantized leg handles the forced hring: integer payloads
+        # survive only to the int8 error bound
+        denom = max(float(np.max(np.abs(a))), 1e-6)
+        assert float(np.max(np.abs(a - b))) / denom < 5e-2, "quant leg hring"
+    else:
+        assert np.array_equal(a, b), "exact-int hring != ring"
 
     print(f"topo_ops OK (shm={int(shm_on)})", flush=True)
 
