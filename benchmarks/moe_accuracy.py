@@ -1,0 +1,225 @@
+"""Accuracy harness for quantized MoE dispatch: expert-parallel
+training steps with int8-compressed alltoall exchange vs the exact
+wire.
+
+    python benchmarks/moe_accuracy.py [--steps 20] [--np 4] [--seed 0]
+                                      [--legs dispatch|combine|both]
+
+Trains a tiny top-1 MoE classifier on synthetic data twice from the
+same initialization — once with exact dispatch/combine alltoalls, once
+with every off-rank chunk pushed through the NATIVE int8+scales codec
+arithmetic (the same per-256-element-block quantization ``qalltoall``
+runs on the wire; the in-harness jnp twin is bit-pinned against
+``ops/quantized.py``'s reference codec by ``tests/test_moe_accuracy.py``)
+— and reports the per-step loss deviation.  One JSON line per step plus
+a summary record.
+
+The documented bound (docs/usage.md § MoE expert parallelism): with
+block-256 int8 quantization of the routed activations the relative loss
+deviation of a short expert-parallel training run stays under **5e-2**;
+``tests/test_moe_accuracy.py`` enforces it in CI.  No transport, no
+launcher: the harness measures the QUANTIZATION error in isolation,
+deterministically — the backward pass sees the quantized values through
+a straight-through estimator, matching how a real run trains through
+the lossy wire.  (For the live schedules over real sockets, see
+``tests/world/test_moe_alltoall.py``.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+QUANT_BLOCK = 256
+
+
+# ---------------- the wire codec, as a traced jnp twin ----------------
+
+
+def qdq_vals(v):
+    """Quantize+dequantize along the last axis with the native codec's
+    block layout: per-256-element absmax scale, symmetric int8 codes,
+    round-half-even — the exact arithmetic ``qalltoall`` runs on every
+    off-rank chunk.  Works on numpy and traced jnp arrays alike."""
+    import jax.numpy as jnp
+
+    v = jnp.asarray(v, jnp.float32)
+    n = v.shape[-1]
+    pad = (-n) % QUANT_BLOCK
+    vp = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(0, pad)])
+    b = vp.reshape(v.shape[:-1] + (-1, QUANT_BLOCK))
+    amax = jnp.max(jnp.abs(b), axis=-1, keepdims=True)
+    scale = amax / jnp.float32(127.0)
+    safe = jnp.where(scale > 0, scale, jnp.float32(1.0))
+    codes = jnp.clip(jnp.round(b / safe), -127, 127)
+    deq = (codes * scale).reshape(vp.shape)
+    return deq[..., :n] if pad else deq
+
+
+def _make_qdq_st():
+    """Straight-through wrapper: forward = wire codec, backward =
+    identity — gradients flow through the lossy exchange the way a real
+    quantized-dispatch training run sees them."""
+    import jax
+
+    @jax.custom_vjp
+    def qdq_st(x):
+        return qdq_vals(x)
+
+    def fwd(x):
+        return qdq_vals(x), None
+
+    def bwd(_, g):
+        return (g,)
+
+    qdq_st.defvjp(fwd, bwd)
+    return qdq_st
+
+
+# ---------------- tiny expert-parallel MoE classifier ----------------
+
+
+def moe_init(rng, d_model, d_ff, n_experts, vocab):
+    def norm(*shape, scale=0.2):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    return {
+        "w_gate": norm(d_model, n_experts, scale=0.5),
+        "w_in": norm(n_experts, d_model, d_ff),
+        "b_in": np.zeros((n_experts, d_ff), np.float32),
+        "w_out": norm(n_experts, d_ff, d_model),
+        "b_out": np.zeros((n_experts, d_model), np.float32),
+        "w_cls": norm(d_model, vocab, scale=0.1),
+    }
+
+
+def moe_loss(params, x, targets, capacity, wire):
+    """Full-batch forward of the emulated expert-parallel MoE: ``x`` is
+    ``(shards, tokens, d)`` — shard ``s`` owns expert ``s`` — and
+    ``wire`` transforms each flattened (src, dst) chunk of the dispatch
+    and combine exchanges (identity for the exact run, the int8 codec
+    for the quantized one; own-rank chunks are ALWAYS exact, matching
+    ``qalltoall``)."""
+    import jax
+    import jax.numpy as jnp
+
+    S, T, D = x.shape
+    E = S  # one expert per shard
+
+    logits = x @ params["w_gate"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)  # (S, T)
+    prob = jnp.take_along_axis(probs, idx[..., None], axis=-1)[..., 0]
+
+    oh = jax.nn.one_hot(idx, E, dtype=jnp.int32)
+    pos = jnp.sum(jnp.cumsum(oh, axis=1) * oh, axis=-1) - 1  # (S, T)
+    keep = (pos >= 0) & (pos < capacity)
+    pos_c = jnp.clip(pos, 0, capacity - 1)
+
+    buf = jnp.zeros((S, E, capacity, D), x.dtype)
+    src = jnp.arange(S)[:, None].repeat(T, 1)
+    buf = buf.at[src, idx, pos_c].add(
+        jnp.where(keep[..., None], x, jnp.zeros_like(x)))
+
+    def exchange(b):
+        # wire every off-diagonal (src, dst) chunk; the own chunk never
+        # leaves the rank and stays exact
+        flat = b.reshape(S, E, capacity * D)
+        wired = wire(flat).reshape(b.shape)
+        own = jnp.eye(S, E, dtype=bool)[:, :, None, None]
+        return jnp.where(own, b, wired)
+
+    sent = exchange(buf)  # dispatch leg
+    recv = sent.transpose(1, 0, 2, 3)  # (E, S, cap, D): expert e's view
+    h = jnp.maximum(
+        jnp.einsum("escd,edf->escf", recv, params["w_in"])
+        + params["b_in"][:, None, None], 0.0)
+    out = (jnp.einsum("escf,efd->escd", h, params["w_out"])
+           + params["b_out"][:, None, None])
+    back = exchange(out.transpose(1, 0, 2, 3)).transpose(1, 0, 2, 3)
+    # (E, S, cap, D) -> shard s gathers its tokens back
+    per_shard = back.transpose(1, 0, 2, 3)  # (S, E, cap, D)
+    y = per_shard[src, idx, pos_c]  # (S, T, D)
+    y = jnp.where(keep[..., None], y, jnp.zeros_like(y))
+    hres = x + y * prob[..., None]
+
+    cls = hres @ params["w_cls"]
+    cls = cls - jnp.max(cls, -1, keepdims=True)
+    logp = cls - jnp.log(jnp.sum(jnp.exp(cls), -1, keepdims=True))
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1)
+    return jnp.mean(nll)
+
+
+def run_training(steps, nshards, quantized, *, seed=0, d_model=16,
+                 d_ff=32, vocab=16, tokens_per_shard=8,
+                 capacity_factor=1.25, lr=0.1):
+    """Train from a fixed init; returns the per-step losses."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    params = moe_init(rng, d_model, d_ff, nshards, vocab)
+    data = rng.randn(steps, nshards, tokens_per_shard,
+                     d_model).astype(np.float32)
+    targets = rng.randint(0, vocab,
+                          size=(steps, nshards, tokens_per_shard))
+    capacity = max(1, int(np.ceil(
+        tokens_per_shard / nshards * capacity_factor)))
+
+    wire = _make_qdq_st() if quantized else (lambda v: v)
+    loss_fn = jax.jit(lambda p, x, t: moe_loss(p, x, t, capacity, wire))
+    grad_fn = jax.jit(jax.grad(
+        lambda p, x, t: moe_loss(p, x, t, capacity, wire)))
+
+    losses = []
+    for step in range(steps):
+        x = jnp.asarray(data[step])
+        tgt = jnp.asarray(targets[step])
+        losses.append(float(loss_fn(params, x, tgt)))
+        g = grad_fn(params, x, tgt)
+        params = jax.tree_util.tree_map(
+            lambda p, gg: np.asarray(p - lr * gg, np.float32), params, g)
+    return losses
+
+
+def run_harness(steps=20, nshards=4, seed=0, emit=print, **model_kw):
+    exact = run_training(steps, nshards, False, seed=seed, **model_kw)
+    quant = run_training(steps, nshards, True, seed=seed, **model_kw)
+    rels = []
+    for i, (le, lq) in enumerate(zip(exact, quant)):
+        rel = abs(lq - le) / max(abs(le), 1e-9)
+        rels.append(rel)
+        emit(json.dumps({"step": i, "loss_exact": round(le, 6),
+                         "loss_quant": round(lq, 6),
+                         "rel_diff": round(rel, 6)}))
+    summary = {
+        "harness": "moe_accuracy",
+        "model": "moe-top1-tiny",
+        "steps": steps,
+        "experts": nshards,
+        "final_loss_exact": round(exact[-1], 6),
+        "final_loss_quant": round(quant[-1], 6),
+        "max_rel_diff": round(max(rels), 6),
+        "bound": 5e-2,
+        "within_bound": max(rels) < 5e-2,
+    }
+    emit(json.dumps(summary))
+    return summary
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--np", type=int, default=4, dest="np_",
+                    help="emulated expert-parallel shard count")
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+    summary = run_harness(steps=args.steps, nshards=args.np_,
+                          seed=args.seed)
+    sys.exit(0 if summary["within_bound"] else 1)

@@ -1034,12 +1034,25 @@ def _host_scatter(x, *, comm, root):
                           root=root, nbytes=x.nbytes)
 
 
-def _host_alltoall(x, *, comm):
+def _host_alltoall(x, *, comm, algo=None):
     from ..runtime import bridge
 
-    with tracing.CallTrace(comm.rank(), "Alltoall", "", nbytes=x.nbytes):
+    if algo is not None:
+        from .. import tune as _tune
+
+        algo_code = _tune.ALGO_CODES[algo]
+        detail = f"algo {algo} (forced)"
+    else:
+        algo_code = None
+        detail = ""
+    with tracing.CallTrace(comm.rank(), "Alltoall", detail,
+                           nbytes=x.nbytes):
+        # the plan signature stays ("alltoall", nbytes): a quantized or
+        # hierarchical exchange IS an alltoall to the verifier and the
+        # schedule compiler — only the wire encoding/routing differs
         return _plan_sync(comm, "alltoall",
-                          lambda: bridge.alltoall(comm.handle, x),
+                          lambda: bridge.alltoall(comm.handle, x,
+                                                  algo=algo_code),
                           nbytes=x.nbytes)
 
 
@@ -1713,7 +1726,10 @@ def scatter(x, root, comm):
     return scatter_p.bind(x, comm=comm, root=root, ordered=_ordered_now())
 
 
-def alltoall(x, comm):
+def alltoall(x, comm, algo=None):
+    """``algo`` forces an alltoall schedule name for this one call —
+    the quantized-compression route passes "qalltoall" here; None (the
+    default) keeps engine selection."""
     x = jnp.asarray(x)
     if x.ndim < 1 or x.shape[0] != comm.size():
         raise ValueError(
@@ -1721,7 +1737,8 @@ def alltoall(x, comm):
             f"({comm.size()}), got shape {x.shape} [alltoall, rank "
             f"{comm.rank()}/{comm.size()}, dtype {x.dtype}]"
         )
-    return alltoall_p.bind(x, comm=comm, ordered=_ordered_now())
+    return alltoall_p.bind(x, comm=comm, ordered=_ordered_now(),
+                           algo=algo)
 
 
 def _note_if_unthreaded(comm, token):

@@ -13,13 +13,65 @@ from ..utils import validation as _validation
 from . import _dispatch, _mesh_impl
 
 
-def alltoall(x, *, comm=None, token=None):
+def alltoall(x, *, comm=None, token=None, compression=None, algo=None):
     """Exchange chunks: output row ``j`` is rank ``j``'s input row ``rank``.
 
     ``x`` must have shape ``(size, ...)`` on every rank.
+
+    Args:
+        x: array of shape ``(size, ...)``.
+        comm: communicator (default: ambient).
+        token: optional ordering token; if given, returns ``(result,
+            token)``.
+        compression: ``"int8"`` for the bandwidth-saving quantized wire
+            format on a world comm (real floating dtypes, ~1e-2
+            relative error on off-rank chunks; the own-rank chunk stays
+            exact).  Degrades to the exact exchange — consistently on
+            every rank — when the native quantized engine is absent or
+            ``MPI4JAX_TPU_COLL_QUANT=deny``.
+        algo: force an alltoall schedule for THIS call on a world comm
+            (``"ring"``/``"qalltoall"``/``"halltoall"``/
+            ``"hqalltoall"``) instead of the engine's selection.  Every
+            rank must force the same one; ineligible picks degrade
+            exactly like table rows (``mpi4jax_tpu.tune``), and the
+            schedule signature stays plain ``alltoall`` — forcing is
+            invisible to the static verifier.
     """
     x = _validation.check_array("x", x)
     comm = _dispatch.resolve_comm(comm)
+
+    if algo is not None:
+        from .. import tune
+
+        algo = tune._check_algo(algo, "alltoall")
+        if _dispatch.is_mesh(comm):
+            _validation.fail(
+                "algo= forces a WORLD-tier transport schedule; the mesh "
+                "tier compiles to one XLA collective",
+                op="alltoall", comm=comm, x=x, exc=NotImplementedError)
+        if compression is not None:
+            _validation.fail(
+                "compression='int8' selects its own wire format; do not "
+                "combine it with algo=",
+                op="alltoall", comm=comm, x=x, exc=ValueError)
+
+    if compression is not None:
+        if compression != "int8":
+            _validation.fail(
+                f"unknown compression {compression!r}; supported: 'int8'",
+                op="alltoall", comm=comm, x=x, exc=ValueError)
+        if _dispatch.is_mesh(comm):
+            _validation.fail(
+                "compression='int8' rides the world-tier transport wire "
+                "format; the mesh tier compiles to one XLA collective",
+                op="alltoall", comm=comm, x=x, exc=NotImplementedError)
+        from .quantized import check_quantizable, native_quant_alltoall
+
+        check_quantizable(x, comm)
+        # None -> exact exchange (pre-quant native library, or
+        # COLL_QUANT=deny) — the same process-wide signals on every
+        # rank, so the degrade is rank-consistent
+        algo = native_quant_alltoall(comm)
 
     if _dispatch.is_mesh(comm):
         body = lambda v: _mesh_impl.alltoall(v, comm.axis)
@@ -27,7 +79,7 @@ def alltoall(x, *, comm=None, token=None):
         from . import _world_impl
 
         _validation.check_wire_dtype("alltoall", x, comm)
-        body = lambda v: _world_impl.alltoall(v, comm)
+        body = lambda v: _world_impl.alltoall(v, comm, algo=algo)
         if x.ndim < 1 or x.shape[0] != comm.size():
             _validation.fail(
                 f"alltoall requires leading axis == communicator size "
@@ -35,5 +87,6 @@ def alltoall(x, *, comm=None, token=None):
                 op="alltoall", comm=comm, x=x, exc=ValueError)
         return _dispatch.maybe_tokenized(
             body, x, token,
-            token_fn=_world_impl.token_variant_fn("alltoall", comm=comm))
+            token_fn=_world_impl.token_variant_fn("alltoall", comm=comm,
+                                                  algo=algo))
     return _dispatch.maybe_tokenized(body, x, token)

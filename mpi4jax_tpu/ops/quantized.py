@@ -133,6 +133,34 @@ def native_quant_algo(comm, x):
     return tune.quantized_algorithm(nbytes)
 
 
+def native_quant_alltoall(comm):
+    """The algorithm name ("qalltoall") carrying a world-tier
+    ``compression="int8"`` alltoall, or None to run the exact exchange:
+    unlike allreduce there is no Python fallback schedule — a
+    pre-quant native library or ``MPI4JAX_TPU_COLL_QUANT=deny``
+    degrades to the exact twin, consistently on every rank (both
+    signals are process-wide and identical across the job)."""
+    from ..utils import config
+
+    if config.quant_mode() == "deny":
+        return None
+    from . import _world_impl
+
+    ex = _world_impl._analysis_executor
+    if ex is None or not ex.owns(comm):
+        if type(comm).__name__ == "AbstractComm":
+            # abstract-eval analysis: route as if the native engine were
+            # present — the schedule signature is plain "alltoall"
+            # either way
+            pass
+        else:
+            from ..runtime import bridge
+
+            if not bridge.quant_available():
+                return None
+    return "qalltoall"
+
+
 def _pack_scales(q, scale):
     """Append each row's f32 scale to its int8 payload (bitcast, no
     widening): (rows, k) int8 + (rows,) f32 -> (rows, k+4) int8.  One

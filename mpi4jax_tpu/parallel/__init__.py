@@ -13,5 +13,19 @@ __all__ = [
     "current_comm",
     "get_default_comm",
     "make_mesh",
+    "moe",
     "spmd",
 ]
+
+
+def __getattr__(name):  # lazy: the layer modules pull in ops/jax.nn
+    if name == "moe":
+        # import_module, NOT `from . import`: the fromlist path re-reads
+        # the attribute off this package and would recurse right back
+        # here while the submodule import is still in flight
+        import importlib
+
+        mod = importlib.import_module(".moe", __name__)
+        globals()["moe"] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

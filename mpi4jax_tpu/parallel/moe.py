@@ -1,0 +1,145 @@
+"""Mixture-of-Experts expert parallelism: the alltoall dispatch/combine.
+
+Experts are rank-sharded (one expert per rank of ``comm``); every token
+is routed top-1 and shipped to its expert's rank with one ``alltoall``,
+the expert FFN runs locally, and a second ``alltoall`` brings the
+outputs home — the GShard/Switch dispatch pattern, where the exchange
+volume is the activation traffic that dominates MoE scaling.  Both
+transposes are :func:`mpi4jax_tpu.ops.alltoall`, so they ride whatever
+the engine picks — and accept the same per-call controls:
+``compression="int8"`` for the quantized wire format (EQuARX's
+observation that routed activations tolerate low-precision transport,
+arXiv:2506.17615) and ``algo=`` to force a schedule
+(``"qalltoall"``/``"halltoall"``/``"hqalltoall"``) on a world comm.
+
+Composes with the other axes exactly like :mod:`.tp`/:mod:`.ulysses`:
+``comm`` names the expert axis (a ``MeshComm`` sub-axis or a world
+comm), so dp/tp/pp can own the remaining axes.  Capacity-based binning
+keeps every shape static for jit: each rank sends exactly ``capacity``
+token slots to every expert, overflow tokens are dropped (their output
+is the zero vector — the standard Switch capacity discipline).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .. import ops
+from .mesh import get_default_comm
+
+
+def _resolve(comm):
+    return comm if comm is not None else get_default_comm()
+
+
+def expert_capacity(tokens: int, n_experts: int,
+                    capacity_factor: float = 1.25) -> int:
+    """Token slots each rank reserves per expert (static for jit)."""
+    return max(1, int(math.ceil(tokens / n_experts * capacity_factor)))
+
+
+def router_top1(x, w_gate):
+    """Top-1 routing: ``(expert_idx, gate_prob, full_probs)`` per token.
+
+    The softmax runs in f32 regardless of the activation dtype — the
+    gate probabilities weight the combine and must not collapse in
+    bf16.
+    """
+    logits = jnp.asarray(x) @ w_gate
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1).astype(jnp.int32)
+    prob = jnp.take_along_axis(probs, idx[:, None], axis=-1)[:, 0]
+    return idx, prob, probs
+
+
+def dispatch(x, expert_idx, capacity: int, *, comm=None,
+             compression=None, algo=None):
+    """Bin tokens per destination expert and exchange: returns
+    ``(expert_inputs, route)`` where ``expert_inputs`` is the
+    ``(size, capacity, d)`` buffer of tokens routed to THIS rank's
+    expert (row ``j`` from rank ``j``) and ``route`` is the opaque
+    state :func:`combine` needs to scatter outputs home.
+
+    ``compression``/``algo`` pass straight to the underlying
+    :func:`~mpi4jax_tpu.ops.alltoall` — the dispatch direction and the
+    combine direction are independent calls, so a caller may quantize
+    one and not the other.
+    """
+    comm = _resolve(comm)
+    size = comm.size()
+    t, d = x.shape
+    oh = jax.nn.one_hot(expert_idx, size, dtype=jnp.int32)
+    # position of each token inside its expert's queue (0-based)
+    pos = jnp.sum(jnp.cumsum(oh, axis=0) * oh, axis=-1) - 1
+    keep = (pos >= 0) & (pos < capacity)
+    pos_c = jnp.clip(pos, 0, capacity - 1)
+    buf = jnp.zeros((size, capacity, d), jnp.asarray(x).dtype)
+    # .add, not .set: dropped tokens contribute zeros to a clipped slot
+    # that may also hold a kept token — overwriting would corrupt it
+    buf = buf.at[expert_idx, pos_c].add(
+        jnp.where(keep[:, None], x, jnp.zeros_like(x)))
+    recv = ops.alltoall(buf, comm=comm, compression=compression,
+                        algo=algo)
+    return recv, (expert_idx, pos_c, keep)
+
+
+def combine(expert_out, route, *, comm=None, compression=None,
+            algo=None):
+    """Return trip of :func:`dispatch`: ship each expert's outputs back
+    to the ranks that sent the tokens and scatter them into token
+    order.  Dropped tokens come back as zeros."""
+    comm = _resolve(comm)
+    expert_idx, pos_c, keep = route
+    back = ops.alltoall(expert_out, comm=comm, compression=compression,
+                        algo=algo)
+    y = back[expert_idx, pos_c]
+    return jnp.where(keep[:, None], y, jnp.zeros_like(y))
+
+
+def moe_ffn(x, params, *, comm=None, capacity_factor: float = 1.25,
+            compression=None, algo=None):
+    """One expert-parallel MoE FFN block: route, dispatch, this rank's
+    expert (a two-layer relu FFN), combine, gate-weight.
+
+    ``x``: ``(tokens, d_model)`` — this rank's local tokens.
+    ``params``: ``w_gate (d_model, size)`` (replicated) plus THIS
+    rank's expert ``w_in (d_model, d_ff) / b_in / w_out (d_ff,
+    d_model) / b_out`` (see :func:`init_moe_params`).
+    """
+    comm = _resolve(comm)
+    size = comm.size()
+    idx, prob, _ = router_top1(x, params["w_gate"])
+    cap = expert_capacity(x.shape[0], size, capacity_factor)
+    recv, route = dispatch(x, idx, cap, comm=comm,
+                           compression=compression, algo=algo)
+    flat = recv.reshape(size * cap, -1)
+    h = jnp.maximum(flat @ params["w_in"] + params["b_in"], 0)
+    out = (h @ params["w_out"] + params["b_out"]).astype(x.dtype)
+    y = combine(out.reshape(size, cap, -1), route, comm=comm,
+                compression=compression, algo=algo)
+    return y * prob[:, None].astype(y.dtype)
+
+
+def init_moe_params(key, d_model: int, d_ff: int, n_experts: int,
+                    expert_rank: int, dtype=jnp.float32):
+    """Replicated gate + rank ``expert_rank``'s expert weights.
+
+    Every rank derives the expert bank from the same ``key`` and slices
+    its own expert, so the sharding is reproducible without a broadcast.
+    """
+    kg, ki, ko = jax.random.split(key, 3)
+    scale_in = 1.0 / math.sqrt(d_model)
+    scale_out = 1.0 / math.sqrt(d_ff)
+    w_in = jax.random.normal(ki, (n_experts, d_model, d_ff), dtype) * scale_in
+    w_out = jax.random.normal(ko, (n_experts, d_ff, d_model), dtype) * scale_out
+    return {
+        "w_gate": jax.random.normal(kg, (d_model, n_experts), dtype)
+        * scale_in,
+        "w_in": w_in[expert_rank],
+        "b_in": jnp.zeros((d_ff,), dtype),
+        "w_out": w_out[expert_rank],
+        "b_out": jnp.zeros((d_model,), dtype),
+    }

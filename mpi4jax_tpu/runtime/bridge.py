@@ -1415,19 +1415,52 @@ def scatter(handle, buf, root: int) -> np.ndarray:
     return out
 
 
-def alltoall(handle, buf) -> np.ndarray:
-    buf = _contig(buf)
-    out = np.empty_like(buf)
-    chunk = buf.nbytes // buf.shape[0]
+def alltoall_raw(handle, buf: np.ndarray, out: np.ndarray,
+                 algo: Optional[int] = None,
+                 dtype_code: Optional[int] = None):
+    """Zero-marshalling alltoall (tuner/benchmark inner loop); ``algo``
+    as in :func:`allreduce_raw` (raises on a pre-engine .so).
+
+    The typed entry (per-chunk element count + dtype) is what makes the
+    quantized/hierarchical schedules (qalltoall/halltoall/hqalltoall)
+    resolvable — the legacy byte-chunk call always runs the exact
+    exchange.  ``dtype_code`` overrides the wire code derived from
+    ``buf.dtype`` (bf16 payloads carried as uint16 bit views).
+    """
+    count = buf.size // buf.shape[0]
+    if dtype_code is None:
+        dtype_code = _dtypes.wire_code(buf.dtype)
     if _exec_fn is not None:
-        hc, d, ref = _exec_desc(handle, _K_ALLTOALL)
+        hc, d, ref = _exec_desc(handle, _K_ALLTOALL,
+                                ("dtype", int(dtype_code)),
+                                ("algo", int(algo or 0)))
         d.sbuf = _data_ptr(buf)
         d.rbuf = _data_ptr(out)
-        d.snbytes = chunk
+        d.count = count
         _check("Alltoall", _exec_fn(hc, ref))
-        return out
-    rc = get_lib().tpucomm_alltoall(
-        _i64(handle), _ptr(buf), _ptr(out), _i64(chunk)
-    )
+        return
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_alltoall_algo"):
+        if algo:
+            raise RuntimeError(
+                "forced collective algorithms need a native library with "
+                "the algorithm engine (tpucomm_alltoall_algo); rebuild "
+                "native/"
+            )
+        rc = lib.tpucomm_alltoall(
+            _i64(handle), _ptr(buf), _ptr(out),
+            _i64(buf.nbytes // buf.shape[0])
+        )
+    else:
+        rc = lib.tpucomm_alltoall_algo(
+            _i64(handle), _ptr(buf), _ptr(out), _i64(count),
+            int(dtype_code), int(algo or 0)
+        )
     _check("Alltoall", rc)
+
+
+def alltoall(handle, buf, algo: Optional[int] = None) -> np.ndarray:
+    buf = _contig(buf)
+    out = np.empty_like(buf)
+    alltoall_raw(handle, buf, out, algo=algo)
     return out

@@ -119,6 +119,19 @@ def check_coll_algo_engine():
                 f"block {b}"
     ratio = x.nbytes / packed.nbytes
     detail += f" quant=qring,qrd (codec round-trip ok, {ratio:.2f}x wire)"
+    # the alltoall family (MoE expert exchange): the typed engine entry
+    # is what makes qalltoall/halltoall/hqalltoall resolvable; the
+    # quantized members additionally need the codec probed above
+    if hasattr(bridge.get_lib(), "tpucomm_alltoall_algo"):
+        fam = sorted(tune.A2A_ALGOS)
+        ok = ok and all(
+            tune._check_algo(a, "alltoall") == a for a in fam)
+        detail += " alltoall=" + ",".join(fam)
+        detail += (f" (default@1KB={tune.get_algorithm('alltoall', 1024)}"
+                   f" @16MB={tune.get_algorithm('alltoall', 16 << 20)})")
+    else:
+        detail += " alltoall=EXACT-ONLY (library predates the typed " \
+            "alltoall engine entry; rebuild native/)"
     return ok, detail
 
 

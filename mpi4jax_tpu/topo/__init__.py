@@ -50,6 +50,17 @@ FINGERPRINT_VERSION = 1
 #: ROADMAP's "ici > shm > tcp" pillar)
 TIERS = ("ici", "shm", "tcp")
 
+QUANT_BLOCK = 256  # codec block (native tpucomm_quant_packed_bytes)
+
+
+def _quant_wire_bytes(nbytes: int) -> int:
+    """On-wire bytes of an ``nbytes`` f32 payload under the int8+scales
+    codec: one int8 code per element plus one f32 scale per 256-element
+    block — mirrors ``bridge.quant_packed_bytes`` without loading the
+    native library."""
+    count = nbytes // 4
+    return count + 4 * ((count + QUANT_BLOCK - 1) // QUANT_BLOCK)
+
 
 def parse_fake_hosts(spec: Optional[str], size: int) -> Optional[List[Optional[str]]]:
     """Parse ``MPI4JAX_TPU_FAKE_HOSTS`` (``r0,r1|r2,r3``: groups of
@@ -240,6 +251,42 @@ class Topology:
                 rem = L - pof2
                 inter = (pof2 * pof2.bit_length() - pof2 + 2 * rem) * nbytes
             return {"intra": int(intra), "inter": int(inter)}
+        if algo in ("halltoall", "hqalltoall"):
+            # nbytes is the per-rank send buffer; one chunk per peer
+            chunk = nbytes // n
+            packed = _quant_wire_bytes  # codec arithmetic, f32 elements
+            intra = sum(len(m) * (len(m) - 1) for m in self.islands) * chunk
+            inter = 0
+            for ia, A in enumerate(self.islands):
+                for ib, B in enumerate(self.islands):
+                    if ia == ib:
+                        continue
+                    cross = len(A) * len(B) * chunk
+                    # leader-tier block: ONE codec frame per (A, B) pair
+                    # on the quantized leg
+                    inter += (packed(cross) if algo == "hqalltoall"
+                              else cross)
+                    # staging hops: non-leader members of A hand their
+                    # cross chunks to leader_a; leader_b fans out to the
+                    # non-leader members of B — always exact bytes
+                    intra += (len(A) - 1) * len(B) * chunk
+                    intra += len(A) * (len(B) - 1) * chunk
+            return {"intra": int(intra), "inter": int(inter)}
+        if algo == "qalltoall":
+            # flat quantized pairwise exchange: every off-rank chunk is
+            # a codec frame
+            chunk = nbytes // n
+            total = n * (n - 1) * _quant_wire_bytes(chunk)
+            if not self.multi:
+                return {"intra": int(total), "inter": 0}
+            return {"intra": 0, "inter": int(total)}
+        if algo == "alltoall":
+            # flat exact pairwise exchange: (n-1) off-rank chunks out of
+            # every rank
+            total = n * (n - 1) * (nbytes // n)
+            if not self.multi:
+                return {"intra": int(total), "inter": 0}
+            return {"intra": 0, "inter": int(total)}
         total = 2 * (n - 1) * nbytes  # ring-style total wire bytes
         if not self.multi:
             return {"intra": int(total), "inter": 0}
@@ -310,7 +357,8 @@ def __getattr__(name):
     # lazy numpy-needing re-exports, keeping the package stdlib-importable
     if name in ("simulate_hring_sum", "simulate_htree_sum",
                 "simulate_ring_sum", "simulate_rd_sum",
-                "simulate_ici_q_sum"):
+                "simulate_ici_q_sum", "simulate_qalltoall",
+                "simulate_halltoall", "simulate_hqalltoall"):
         from . import _simulate
 
         return getattr(_simulate, name)

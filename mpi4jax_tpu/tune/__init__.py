@@ -83,10 +83,11 @@ def _config_mod():
 
 # keep in sync with native/tpucomm.h (TpuCollAlgo / TpuCollOpKind)
 ALGO_CODES = {"auto": 0, "ring": 1, "rd": 2, "tree": 3, "shm": 4,
-              "qring": 5, "qrd": 6, "hring": 7, "htree": 8}
+              "qring": 5, "qrd": 6, "hring": 7, "htree": 8,
+              "qalltoall": 9, "halltoall": 10, "hqalltoall": 11}
 ALGO_NAMES = {v: k for k, v in ALGO_CODES.items()}
-OPS = ("allreduce", "allgather")
-OP_KIND = {"allreduce": 0, "allgather": 1}
+OPS = ("allreduce", "allgather", "alltoall")
+OP_KIND = {"allreduce": 0, "allgather": 1, "alltoall": 2}
 
 #: hierarchical (topology-aware) schedules: intra-island reduce ->
 #: leader-tier allreduce (ring for hring, recursive doubling for
@@ -115,10 +116,28 @@ QUANT_ALGOS = frozenset(("qring", "qrd"))
 #: and there is no whole-schedule quantized hierarchical code — the
 #: hierarchy's quantized inter-host leg rides COLL_QUANT=force instead
 #: (docs/usage.md § Transport tiers and topology).
-EXACT_TWIN = {"qring": "ring", "qrd": "rd"}
+EXACT_TWIN = {"qring": "ring", "qrd": "rd",
+              "qalltoall": "ring", "hqalltoall": "halltoall"}
 QUANT_TWIN = {"ring": "qring", "rd": "qrd", "tree": "qrd",
               "qring": "qring", "qrd": "qrd",
               "hring": "qring", "htree": "qrd"}
+
+#: the alltoall schedule family (PR 8 + PR 10 treatment for the
+#: expert-routing exchange): qalltoall quantizes every off-rank chunk
+#: with the int8+scales wire codec; halltoall is the hierarchical
+#: exchange (intra-island over shm/TCP, only cross-island BLOCKS over
+#: the leader tier — a pure permutation, bit-identical to the flat
+#: exchange); hqalltoall quantizes the leader leg only.  Gated by the
+#: same MPI4JAX_TPU_COLL_QUANT / MPI4JAX_TPU_HIER knobs as the
+#: allreduce twins; HIER_ALGOS/QUANT_ALGOS keep their historic
+#: allreduce/allgather meaning (test-pinned), so the alltoall family
+#: gets its own sets.
+A2A_ALGOS = frozenset(("qalltoall", "halltoall", "hqalltoall"))
+A2A_QUANT = frozenset(("qalltoall", "hqalltoall"))
+A2A_HIER = frozenset(("halltoall", "hqalltoall"))
+#: flat twin a hierarchical pick degrades to under MPI4JAX_TPU_HIER=deny
+HIER_FLAT_TWIN = {"hring": "ring", "htree": "tree",
+                  "halltoall": "ring", "hqalltoall": "qalltoall"}
 
 #: --from-trace promotion thresholds: an exact allreduce winner at or
 #: above this payload whose recorded wire share (dur - wait - dispatch)
@@ -172,6 +191,7 @@ Table = Dict[str, List[Entry]]
 _DEFAULT_TABLE: Table = {
     "allreduce": [(0, "tree"), (64 * 1024, "ring")],
     "allgather": [(0, "ring")],
+    "alltoall": [(0, "ring")],
 }
 
 #: defaults on a comm with a discovered MULTI-ISLAND topology
@@ -183,6 +203,7 @@ _DEFAULT_TABLE: Table = {
 _HIER_DEFAULT_TABLE: Table = {
     "allreduce": [(0, "tree"), (64 * 1024, "hring")],
     "allgather": [(0, "ring")],
+    "alltoall": [(0, "ring")],
 }
 
 _overrides: Dict[str, Dict[int, str]] = {op: {} for op in OPS}
@@ -207,12 +228,24 @@ def _check_algo(algo: str, op: Optional[str] = None) -> str:
     if name not in ALGO_CODES or name == "shm":
         raise ValueError(
             f"unknown collective algorithm {algo!r} "
-            "(expected auto, ring, rd, tree, qring, qrd, hring, or htree)"
+            "(expected auto, ring, rd, tree, qring, qrd, hring, htree, "
+            "qalltoall, halltoall, or hqalltoall)"
         )
     if op == "allgather" and name in QUANT_ALGOS:
         raise ValueError(
             f"{name} is an allreduce-only algorithm: quantized wire "
             "formats are lossy and allgather is pure data movement"
+        )
+    if op == "alltoall" and name not in ("auto", "ring") \
+            and name not in A2A_ALGOS:
+        raise ValueError(
+            f"{name} is not an alltoall schedule (expected auto, ring, "
+            "qalltoall, halltoall, or hqalltoall)"
+        )
+    if op in ("allreduce", "allgather") and name in A2A_ALGOS:
+        raise ValueError(
+            f"{name} is an alltoall-only algorithm (the allreduce twins "
+            "are qring/qrd and hring/htree)"
         )
     return name
 
@@ -388,6 +421,14 @@ def _env_table() -> Table:
         # allgather schedule); other ops keep their normal selection
         if algo in QUANT_ALGOS:
             return {"allreduce": [(0, algo)]}
+        # the alltoall family only has alltoall schedules
+        if algo in A2A_ALGOS:
+            return {"alltoall": [(0, algo)]}
+        # rd/tree/hring/htree have no alltoall schedule; only
+        # auto/ring are valid for every op
+        if algo not in ("auto", "ring"):
+            return {op: [(0, algo)]
+                    for op in ("allreduce", "allgather")}
         return {op: [(0, algo)] for op in OPS}
     for part in raw.split(","):
         part = part.strip()
@@ -564,7 +605,8 @@ def _notice_shadowed() -> None:
             algo = joint.combo_algo(combo)
             gates = joint.combo_gates(combo)
             where = f"{op} >= {mb} B (cache: {_cache_origin})"
-            if algo in QUANT_ALGOS and qm == "deny":
+            if (algo in QUANT_ALGOS or algo in A2A_QUANT) \
+                    and qm == "deny":
                 msgs.append(
                     f"MPI4JAX_TPU_COLL_QUANT=deny degrades the installed "
                     f"cache pick '{combo}' to its exact twin "
@@ -581,8 +623,8 @@ def _notice_shadowed() -> None:
                     f"Pallas ICI intra-island leg; MPI4JAX_TPU_ICI_LEG=off "
                     f"keeps the native intra paths ('{algo}' runs) for "
                     f"{where}")
-            if algo in HIER_ALGOS and hm == "deny":
-                flat = "ring" if algo == "hring" else "tree"
+            if (algo in HIER_ALGOS or algo in A2A_HIER) and hm == "deny":
+                flat = HIER_FLAT_TWIN[algo]
                 msgs.append(
                     f"MPI4JAX_TPU_HIER=deny degrades the installed cache "
                     f"pick '{combo}' to its flat twin '{flat}' for {where}")

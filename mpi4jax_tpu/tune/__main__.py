@@ -1,7 +1,7 @@
 """Offline collective autotuner CLI.
 
     python -m mpi4jax_tpu.tune [--np 4] [--sizes 1024,...,16777216]
-                               [--repeats N] [--ops allreduce,allgather]
+                               [--repeats N] [--ops allreduce,alltoall]
                                [--cache PATH] [--port P] [--joint]
 
 Sweeps every selectable algorithm (ring / recursive doubling / tree,
@@ -95,6 +95,7 @@ DEFAULT_SIZES = [1 << 10, 4 << 10, 16 << 10, 64 << 10, 256 << 10,
 CANDIDATES = {
     "allreduce": ("ring", "rd", "tree", "qring", "qrd"),
     "allgather": ("ring", "rd", "tree"),
+    "alltoall": ("ring", "qalltoall"),
 }
 
 
@@ -109,7 +110,7 @@ def _parse_args(argv=None):
                          "(default: 1KB..16MB x4 ladder)")
     ap.add_argument("--repeats", type=int, default=0,
                     help="timed iterations per point (0 = auto-scale)")
-    ap.add_argument("--ops", default="allreduce,allgather")
+    ap.add_argument("--ops", default="allreduce,allgather,alltoall")
     ap.add_argument("--cache", default=None,
                     help="cache file path (default: tune.cache_path(np))")
     ap.add_argument("--port", type=int, default=None,
@@ -222,6 +223,15 @@ def _time_point(comm, bridge, np, op, nbytes, algo, repeats):
 
         def run():
             bridge.allreduce_raw(h, x, out, _F32, _SUM, algo=code)
+    elif op == "alltoall":
+        # nbytes is the whole send buffer (size rows of nbytes/size),
+        # matching the public op's (size, ...) contract
+        x = np.ones((comm.size(),
+                     max(nbytes // 4 // comm.size(), 1)), np.float32)
+        out = np.empty_like(x)
+
+        def run():
+            bridge.alltoall_raw(h, x, out, algo=code)
     else:
         x = np.ones(max(nbytes // 4, 1), np.float32)
         out = np.empty((comm.size(),) + x.shape, np.float32)
@@ -290,11 +300,14 @@ def _rank(args) -> int:
             per_algo = {}
             cands = CANDIDATES[op]
             if hier_ok:
-                cands = cands + tuple(a for a in ("hring", "htree")
+                extra = (("halltoall", "hqalltoall") if op == "alltoall"
+                         else ("hring", "htree"))
+                cands = cands + tuple(a for a in extra
                                       if a not in cands)
             if quant_mode() == "deny":
                 cands = tuple(a for a in cands
-                              if a not in tune.QUANT_ALGOS)
+                              if a not in tune.QUANT_ALGOS
+                              and a not in tune.A2A_QUANT)
             for algo in cands:
                 dt = _time_point(comm, bridge, np, op, nbytes, algo, repeats)
                 per_algo[algo] = dt
@@ -405,6 +418,8 @@ def _joint_rank(args) -> int:
                 return False  # upgraded to the quantized twin
             if algo in tune.HIER_ALGOS:
                 return False  # leader leg quantized: that IS +q
+            if algo == "halltoall":
+                return False  # leader leg quantized: that IS hqalltoall
         if hm == "force" and multi and algo in ("ring", "rd", "tree"):
             return False  # upgraded to the hierarchical twin
         return True

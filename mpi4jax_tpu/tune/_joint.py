@@ -76,6 +76,12 @@ JOINT_CANDIDATES: Dict[str, Tuple[str, ...]] = {
                   "hring", "htree", "hring+q", "htree+q",
                   "hring+ici", "htree+ici", "hring+q+ici", "htree+q+ici"),
     "allgather": ("ring", "rd", "tree", "hring", "htree"),
+    # the expert-routing exchange: quantized/hierarchical twins are
+    # first-class per-call-forcible codes (hqalltoall quantizes ONLY
+    # the leader leg, so no "+q" gated sub-job is needed — the code
+    # itself names the quantized-leader schedule), and there is no
+    # ICI-leg variant (the leg is an allreduce schedule)
+    "alltoall": ("ring", "qalltoall", "halltoall", "hqalltoall"),
 }
 
 
@@ -125,19 +131,23 @@ def eligible_combos(op: str, *, multi_island: bool, quant_mode: str,
     ``MPI4JAX_TPU_ICI_LEG=off``, excludes them: a row timing the
     native intra path under an ``+ici`` label would be a lie)."""
     try:
-        from . import HIER_ALGOS, QUANT_ALGOS  # shared vocabulary
+        # shared vocabulary (A2A_*: the alltoall schedule family)
+        from . import A2A_HIER, A2A_QUANT, HIER_ALGOS, QUANT_ALGOS
     except ImportError:  # standalone load: the engine's stable names
         HIER_ALGOS = frozenset(("hring", "htree"))
         QUANT_ALGOS = frozenset(("qring", "qrd"))
+        A2A_QUANT = frozenset(("qalltoall", "hqalltoall"))
+        A2A_HIER = frozenset(("halltoall", "hqalltoall"))
 
     out = []
     for combo in JOINT_CANDIDATES[op]:
         algo, legs = _combo_parts(combo)
-        quantized = algo in QUANT_ALGOS or "q" in legs
+        quantized = algo in QUANT_ALGOS or algo in A2A_QUANT \
+            or "q" in legs
         if quantized and quant_mode == "deny":
             continue
-        if algo in HIER_ALGOS and (not multi_island
-                                   or hier_mode == "deny"):
+        if (algo in HIER_ALGOS or algo in A2A_HIER) \
+                and (not multi_island or hier_mode == "deny"):
             continue
         if "ici" in legs and not ici_leg:
             continue

@@ -112,11 +112,18 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 never changes which frames match, only
                                 their contents; ``force`` upgrades every
                                 eligible (real floating dtype, SUM)
-                                allreduce to the quantized twin of its
-                                selected algorithm.  Must agree across
-                                ranks (frame sizes differ between exact
-                                and quantized schedules; a divergent
-                                gate fails fast on the size check).
+                                allreduce — and every eligible-dtype
+                                alltoall — to the quantized twin of its
+                                selected algorithm.  alltoall rides the
+                                same gate: ``qalltoall``/``hqalltoall``
+                                (the MoE dispatch wire) degrade under
+                                ``deny`` to their exact twins, and
+                                codec-ineligible dtypes (ints) always
+                                run the exact exchange, consistently on
+                                every rank.  Must agree across ranks
+                                (frame sizes differ between exact and
+                                quantized schedules; a divergent gate
+                                fails fast on the size check).
 - ``MPI4JAX_TPU_TUNE_CACHE``  — full path of the persistent autotune cache
                                 (default ``~/.cache/mpi4jax_tpu/
                                 tune_<world_size>.json``), written by

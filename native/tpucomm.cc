@@ -3282,7 +3282,7 @@ struct CollTable {
   /* (min_bytes ascending, TpuCollAlgo); empty = built-in heuristic */
   std::vector<std::pair<int64_t, int32_t>> entries;
 };
-CollTable g_coll_table[2];  // indexed by TpuCollOpKind
+CollTable g_coll_table[3];  // indexed by TpuCollOpKind
 std::mutex g_coll_table_mu;
 
 int coll_table_lookup(int op_kind, int64_t nbytes) {
@@ -3304,6 +3304,9 @@ const char* coll_algo_name(int algo) {
     case TPU_COLL_QRD: return "qrd";
     case TPU_COLL_HRING: return "hring";
     case TPU_COLL_HTREE: return "htree";
+    case TPU_COLL_QA2A: return "qalltoall";
+    case TPU_COLL_HA2A: return "halltoall";
+    case TPU_COLL_HQA2A: return "hqalltoall";
     default: return "auto";
   }
 }
@@ -3411,6 +3414,19 @@ int resolve_coll_algo(Comm* c, int op_kind, int64_t nbytes, int64_t count,
     else
       algo = TPU_COLL_RING;
   }
+  /* per-op canonicalization: the alltoall family (qalltoall/halltoall/
+   * hqalltoall) exists only for alltoall, and alltoall has only the
+   * pairwise exchange outside that family (rd/tree/qring/... have no
+   * alltoall schedule).  Map strays to RING — the exact flat exchange —
+   * BEFORE the gates, so deny/force act on canonical codes. */
+  if (op_kind == TPU_OPKIND_ALLTOALL) {
+    if (algo != TPU_COLL_RING && algo != TPU_COLL_QA2A &&
+        algo != TPU_COLL_HA2A && algo != TPU_COLL_HQA2A)
+      algo = TPU_COLL_RING;
+  } else if (algo == TPU_COLL_QA2A || algo == TPU_COLL_HA2A ||
+             algo == TPU_COLL_HQA2A) {
+    algo = TPU_COLL_RING;
+  }
   /* hierarchical eligibility: needs a discovered multi-island topology
    * on this comm.  A hierarchical pick on a flat comm (or under
    * MPI4JAX_TPU_HIER=deny) degrades to its flat twin; =force upgrades
@@ -3425,6 +3441,18 @@ int resolve_coll_algo(Comm* c, int op_kind, int64_t nbytes, int64_t count,
     if (algo == TPU_COLL_HRING || algo == TPU_COLL_HTREE) {
       if (!h_ok || hier_mode() == HIER_DENY)
         algo = algo == TPU_COLL_HRING ? TPU_COLL_RING : TPU_COLL_TREE;
+    } else if (algo == TPU_COLL_HA2A || algo == TPU_COLL_HQA2A) {
+      /* the hierarchical alltoall degrades one axis at a time: HQA2A
+       * keeps its quantized wire (QA2A) on a flat comm — the quant
+       * block below settles the other axis */
+      if (!h_ok || hier_mode() == HIER_DENY)
+        algo = algo == TPU_COLL_HA2A ? TPU_COLL_RING : TPU_COLL_QA2A;
+    } else if (hier_mode() == HIER_FORCE && h_ok &&
+               op_kind == TPU_OPKIND_ALLTOALL) {
+      /* same non-upgrade rule as qring below: an explicitly quantized
+       * flat exchange only gains the hierarchical route when the quant
+       * force gate re-quantizes the leader leg anyway */
+      if (algo == TPU_COLL_RING) algo = TPU_COLL_HA2A;
     } else if (hier_mode() == HIER_FORCE && h_ok &&
                algo != TPU_COLL_SHM && algo != TPU_COLL_QRING &&
                algo != TPU_COLL_QRD) {
@@ -3443,11 +3471,23 @@ int resolve_coll_algo(Comm* c, int op_kind, int64_t nbytes, int64_t count,
    * allgather degrades and then takes the normal rd/ring legality
    * path. */
   {
-    const bool q_ok = op_kind == TPU_OPKIND_ALLREDUCE &&
-                      quant_dtype_ok(dtype) && rop == TPU_SUM;
+    /* alltoall is pure data movement — no reduction op to gate on, the
+     * wire format just needs a codec-legal dtype */
+    const bool q_ok =
+        op_kind == TPU_OPKIND_ALLTOALL
+            ? quant_dtype_ok(dtype)
+            : op_kind == TPU_OPKIND_ALLREDUCE && quant_dtype_ok(dtype) &&
+                  rop == TPU_SUM;
     if (algo == TPU_COLL_QRING || algo == TPU_COLL_QRD) {
       if (!q_ok || quant_mode() == QUANT_DENY)
         algo = algo == TPU_COLL_QRING ? TPU_COLL_RING : TPU_COLL_RD;
+    } else if (algo == TPU_COLL_QA2A || algo == TPU_COLL_HQA2A) {
+      if (!q_ok || quant_mode() == QUANT_DENY)
+        algo = algo == TPU_COLL_QA2A ? TPU_COLL_RING : TPU_COLL_HA2A;
+    } else if (quant_mode() == QUANT_FORCE && q_ok &&
+               op_kind == TPU_OPKIND_ALLTOALL) {
+      if (algo == TPU_COLL_RING) algo = TPU_COLL_QA2A;
+      else if (algo == TPU_COLL_HA2A) algo = TPU_COLL_HQA2A;
     } else if (quant_mode() == QUANT_FORCE && q_ok &&
                algo != TPU_COLL_HRING && algo != TPU_COLL_HTREE) {
       algo = algo == TPU_COLL_RING ? TPU_COLL_QRING : TPU_COLL_QRD;
@@ -4307,6 +4347,90 @@ int qrd_allreduce(Comm* c, void* recvbuf, int64_t count, int dtype, int op) {
   return 0;
 }
 
+/* ============ alltoall schedules ============ */
+
+/* The exact pairwise exchange (the historic tpucomm_alltoall body):
+ * round r trades chunks with ranks ±r, one in-flight send overlapping
+ * the matching receive.  Shared by the flat dispatch path and the
+ * intra-island leg of the hierarchical alltoall. */
+int flat_alltoall(Comm* c, const void* sendbuf, void* recvbuf,
+                  int64_t chunk) {
+  const char* in = static_cast<const char*>(sendbuf);
+  char* out = static_cast<char*>(recvbuf);
+  std::memcpy(out + (int64_t)c->rank * chunk,
+              in + (int64_t)c->rank * chunk, chunk);
+  for (int round = 1; round < c->size; round++) {
+    int dest = (c->rank + round) % c->size;
+    int src = (c->rank - round + c->size) % c->size;
+    SendJob job;
+    if (async_send(c, &job, dest, kCollectiveTag,
+                   in + (int64_t)dest * chunk, chunk))
+      return 1;
+    int recv_rc = recv_msg(c, src, kCollectiveTag,
+                           out + (int64_t)src * chunk, chunk);
+    if (wait_send(c, &job) || recv_rc) return 1;
+  }
+  return 0;
+}
+
+/* Quantized pairwise alltoall (TPU_COLL_QA2A): the same round schedule
+ * with every off-rank chunk on the int8+scales codec wire —
+ * quant_packed_bytes(count) per chunk instead of count*esize (~4x
+ * fewer payload bytes for f32, ~2x for bf16/f16).  Every outgoing
+ * chunk is packed up-front (one codec pass per chunk; the own-rank
+ * chunk never crosses the wire and is copied EXACT), then the rounds
+ * move only packed frames.  Rank-consistent by construction: each
+ * destination dequantizes the bytes its source packed — there is no
+ * cross-rank reduction to disagree on.  resolve_coll_algo gates
+ * dtypes (F16/BF16/F32/F64). */
+int q_alltoall(Comm* c, const void* sendbuf, void* recvbuf, int64_t count,
+               int dtype) {
+  const int size = c->size, rank = c->rank;
+  const int64_t chunk = count * dtype_size(dtype);
+  const int64_t ppc = quant_packed_bytes(count);
+  const char* in = static_cast<const char*>(sendbuf);
+  char* out = static_cast<char*>(recvbuf);
+  std::vector<char>& spacks = quant_tls_buf(0, ppc * size);
+  if (dtype == TPU_F32) {
+    for (int d = 0; d < size; d++) {
+      if (d == rank) continue;
+      quant_pack_f32(reinterpret_cast<const float*>(in + d * chunk), count,
+                     spacks.data() + d * ppc);
+    }
+  } else {
+    std::vector<char>& staged = quant_tls_buf(1, 4 * count);
+    float* st = reinterpret_cast<float*>(staged.data());
+    for (int d = 0; d < size; d++) {
+      if (d == rank) continue;
+      quant_load_f32(in + d * chunk, dtype, count, st);
+      quant_pack_f32(st, count, spacks.data() + d * ppc);
+    }
+  }
+  std::memcpy(out + (int64_t)rank * chunk, in + (int64_t)rank * chunk,
+              chunk);
+  for (int round = 1; round < size; round++) {
+    int dest = (rank + round) % size;
+    int src = (rank - round + size) % size;
+    SendJob job;
+    if (async_send(c, &job, dest, kCollectiveTag, spacks.data() + dest * ppc,
+                   ppc))
+      return 1;
+    int rc;
+    if (dtype == TPU_F32) {
+      rc = recv_quant_msg(c, src, count,
+                          reinterpret_cast<float*>(out + src * chunk),
+                          false);
+    } else {
+      std::vector<char>& staged = quant_tls_buf(1, 4 * count);
+      float* st = reinterpret_cast<float*>(staged.data());
+      rc = recv_quant_msg(c, src, count, st, false);
+      if (!rc) quant_store_f32(st, dtype, count, out + src * chunk);
+    }
+    if (wait_send(c, &job) || rc) return 1;
+  }
+  return 0;
+}
+
 /* ============ hierarchical (topology-aware) schedules ============
  *
  * hring / htree compose the flat kernels above over the sub-groups a
@@ -4509,6 +4633,261 @@ int hier_allgather(Comm* c, const void* sendbuf, int64_t nbytes,
       std::memcpy(out + (int64_t)t->members[(size_t)i][m] * nbytes,
                   stage.data() + ioff[(size_t)i] + (int64_t)m * nbytes,
                   (size_t)nbytes);
+  return 0;
+}
+
+/* Hierarchical alltoall (TPU_COLL_HA2A / HQA2A) — hier_allgather's
+ * uneven-island block machinery generalized to the all-pairs exchange:
+ *
+ *   A. intra-island alltoall of the local chunks (shm arena when the
+ *      island shares a host, the pairwise exchange otherwise);
+ *   B. intra gather of every member's CROSS-island chunks to the
+ *      leader (member order);
+ *   C. leader-tier pairwise exchange of the cross-island blocks —
+ *      block li->k carries p_li*p_k chunks laid out src-member-major,
+ *      variable-size per island pair (uneven islands are first-class);
+ *      under `quant_leg` each block rides the int8+scales codec wire
+ *      as ONE packed frame (256-element codec blocks span chunk
+ *      boundaries inside the frame — the numpy simulator replays the
+ *      exact concatenation);
+ *   D. intra scatter of the received blocks to their destination
+ *      members, then a local reorder into world-rank positions.
+ *
+ * Only phase C touches the slow tier: (n-p_i)*p_i chunks per LEADER
+ * instead of (n-1) chunks per RANK crossing islands.  The exact
+ * variant is a pure permutation — output bit-identical to the flat
+ * pairwise exchange; quant_leg quantizes exactly the chunks that
+ * cross islands (intra chunks stay exact).  Every leg records one obs
+ * event labeled with its transport tier inside the whole-op record,
+ * like the allreduce twins. */
+int h_alltoall(Comm* c, const void* sendbuf, void* recvbuf, int64_t chunk,
+               int64_t count, int dtype, bool quant_leg) {
+  TopoInfo* t = c->topo;
+  Comm* intra = t->intra;
+  Comm* lead = t->leader;
+  const int L = t->n_islands;
+  const int li = t->my_island;
+  const std::vector<int32_t>& mine = t->members[(size_t)li];
+  const int pi = (int)mine.size();
+  const int n = c->size;
+  const char* in = static_cast<const char*>(sendbuf);
+  char* out = static_cast<char*>(recvbuf);
+
+  /* phase A: intra-island exchange (compact to member order, exchange,
+   * scatter back to world positions) */
+  if (intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_ALLTOALL, -1, 0, chunk * pi,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_RING);
+    obs.set_tier(TPU_TIER_INTRA);
+    std::vector<char> sa((size_t)(chunk * pi)), ra((size_t)(chunk * pi));
+    for (int m = 0; m < pi; m++)
+      std::memcpy(sa.data() + (int64_t)m * chunk,
+                  in + (int64_t)mine[(size_t)m] * chunk, (size_t)chunk);
+    int rc = intra->arena
+                 ? shm_alltoall(intra, sa.data(), ra.data(), chunk)
+                 : flat_alltoall(intra, sa.data(), ra.data(), chunk);
+    if (rc) return 1;
+    for (int m = 0; m < pi; m++)
+      std::memcpy(out + (int64_t)mine[(size_t)m] * chunk,
+                  ra.data() + (int64_t)m * chunk, (size_t)chunk);
+  } else {
+    std::memcpy(out + (int64_t)c->rank * chunk,
+                in + (int64_t)c->rank * chunk, (size_t)chunk);
+  }
+  if (n == pi) return 0;  // single island: resolve degrades before here
+
+  const bool is_leader = c->rank == t->leaders[(size_t)li];
+  const int rloc = intra ? intra->rank : 0;  // my island member index
+  const int64_t cross_bytes = (int64_t)(n - pi) * chunk;
+  /* xoff[k]: byte offset of island k's run inside any (island-order,
+   * skipping li; member-order within) cross buffer */
+  std::vector<int64_t> xoff((size_t)L, 0);
+  {
+    int64_t o = 0;
+    for (int k = 0; k < L; k++) {
+      if (k == li) continue;
+      xoff[(size_t)k] = o;
+      o += (int64_t)t->members[(size_t)k].size() * chunk;
+    }
+  }
+  /* my cross-island chunks, (island k != li, dst member t_) order */
+  std::vector<char> cross((size_t)cross_bytes);
+  {
+    int64_t off = 0;
+    for (int k = 0; k < L; k++) {
+      if (k == li) continue;
+      for (int32_t w : t->members[(size_t)k]) {
+        std::memcpy(cross.data() + off, in + (int64_t)w * chunk,
+                    (size_t)chunk);
+        off += chunk;
+      }
+    }
+  }
+
+  /* phase B: gather the members' cross buffers at the leader, member
+   * order (G[m] = member m's cross buffer) */
+  std::vector<char> G;
+  if (is_leader) G.resize((size_t)(cross_bytes * pi));
+  if (intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_GATHER, t->my_leader, 0, cross_bytes,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    if (intra->arena) {
+      if (shm_allgather(intra, cross.data(), cross_bytes, G.data(), 0,
+                        false))
+        return 1;
+    } else if (rloc == 0) {
+      std::memcpy(G.data(), cross.data(), (size_t)cross_bytes);
+      for (int r = 1; r < intra->size; r++)
+        if (recv_msg(intra, r, kCollectiveTag,
+                     G.data() + (int64_t)r * cross_bytes, cross_bytes))
+          return 1;
+    } else {
+      if (send_msg(intra, 0, kCollectiveTag, cross.data(), cross_bytes))
+        return 1;
+    }
+  } else if (is_leader) {
+    std::memcpy(G.data(), cross.data(), (size_t)cross_bytes);
+  }
+
+  /* phases C on the leaders: reorder G into per-dest-island blocks,
+   * trade blocks pairwise, reorder into per-member scatter payloads */
+  std::vector<char> D;  // phase D payload: pi members x cross_bytes
+  if (is_leader) {
+    /* boff[k]: byte offset of the island-k block in the send (and,
+     * p_i*p_k being symmetric in the pair, receive) staging buffer */
+    std::vector<int64_t> boff((size_t)L + 1, 0);
+    for (int k = 0; k < L; k++)
+      boff[(size_t)k + 1] =
+          boff[(size_t)k] +
+          (k == li ? 0
+                   : (int64_t)pi * t->members[(size_t)k].size() * chunk);
+    std::vector<char> sblk((size_t)boff[(size_t)L]);
+    std::vector<char> rblk((size_t)boff[(size_t)L]);
+    for (int k = 0; k < L; k++) {
+      if (k == li) continue;
+      const int pk = (int)t->members[(size_t)k].size();
+      for (int m = 0; m < pi; m++)
+        std::memcpy(sblk.data() + boff[(size_t)k] +
+                        (int64_t)m * pk * chunk,
+                    G.data() + (int64_t)m * cross_bytes + xoff[(size_t)k],
+                    (size_t)((int64_t)pk * chunk));
+    }
+    {
+      ObsScope obs(TPU_OBS_ALLTOALL, -1, 0, boff[(size_t)L],
+                   quant_leg ? TPU_COLL_QA2A : TPU_COLL_RING);
+      obs.set_tier(TPU_TIER_INTER);
+      if (quant_leg) {
+        int64_t wire = 0;
+        for (int k = 0; k < L; k++)
+          if (k != li)
+            wire += quant_packed_bytes((boff[(size_t)k + 1] -
+                                        boff[(size_t)k]) /
+                                       dtype_size(dtype));
+        obs.set_wire(wire);
+      }
+      for (int round = 1; round < L; round++) {
+        const int kd = (li + round) % L;
+        const int ks = (li - round + L) % L;
+        const int64_t snb = boff[(size_t)kd + 1] - boff[(size_t)kd];
+        const int64_t rnb = boff[(size_t)ks + 1] - boff[(size_t)ks];
+        SendJob job;
+        int rc;
+        if (!quant_leg) {
+          if (async_send(lead, &job, kd, kCollectiveTag,
+                         sblk.data() + boff[(size_t)kd], snb))
+            return 1;
+          rc = recv_msg(lead, ks, kCollectiveTag,
+                        rblk.data() + boff[(size_t)ks], rnb);
+        } else {
+          /* one codec frame per block: load the whole block to f32,
+           * pack (codec 256-blocks span chunk boundaries), ship */
+          const int64_t scount = snb / dtype_size(dtype);
+          const int64_t rcount = rnb / dtype_size(dtype);
+          std::vector<char>& qs =
+              quant_tls_buf(0, quant_packed_bytes(scount));
+          if (dtype == TPU_F32) {
+            quant_pack_f32(reinterpret_cast<const float*>(
+                               sblk.data() + boff[(size_t)kd]),
+                           scount, qs.data());
+          } else {
+            std::vector<char>& st = quant_tls_buf(1, 4 * scount);
+            quant_load_f32(sblk.data() + boff[(size_t)kd], dtype, scount,
+                           reinterpret_cast<float*>(st.data()));
+            quant_pack_f32(reinterpret_cast<const float*>(st.data()),
+                           scount, qs.data());
+          }
+          if (async_send(lead, &job, kd, kCollectiveTag, qs.data(),
+                         quant_packed_bytes(scount)))
+            return 1;
+          if (dtype == TPU_F32) {
+            rc = recv_quant_msg(lead, ks, rcount,
+                                reinterpret_cast<float*>(
+                                    rblk.data() + boff[(size_t)ks]),
+                                false);
+          } else {
+            std::vector<char>& st = quant_tls_buf(1, 4 * rcount);
+            float* stf = reinterpret_cast<float*>(st.data());
+            rc = recv_quant_msg(lead, ks, rcount, stf, false);
+            if (!rc)
+              quant_store_f32(stf, dtype, rcount,
+                              rblk.data() + boff[(size_t)ks]);
+          }
+        }
+        if (wait_send(lead, &job) || rc) return 1;
+      }
+    }
+    /* per-member scatter payloads: member t_ gets (island k != li, src
+     * member m) order — the same run layout as `cross`, so xoff
+     * addresses both */
+    D.resize((size_t)(cross_bytes * pi));
+    for (int k = 0; k < L; k++) {
+      if (k == li) continue;
+      const int pk = (int)t->members[(size_t)k].size();
+      for (int m = 0; m < pk; m++)
+        for (int t_ = 0; t_ < pi; t_++)
+          std::memcpy(D.data() + (int64_t)t_ * cross_bytes +
+                          xoff[(size_t)k] + (int64_t)m * chunk,
+                      rblk.data() + boff[(size_t)k] +
+                          ((int64_t)m * pi + t_) * chunk,
+                      (size_t)chunk);
+    }
+  }
+
+  /* phase D: scatter each member its cross chunks */
+  std::vector<char> stage((size_t)cross_bytes);
+  if (intra && intra->size > 1) {
+    ObsScope obs(TPU_OBS_SCATTER, t->my_leader, 0, cross_bytes,
+                 intra->arena ? TPU_COLL_SHM : TPU_COLL_TREE);
+    obs.set_tier(TPU_TIER_INTRA);
+    if (intra->arena) {
+      if (shm_scatter(intra, D.data(), stage.data(), cross_bytes, 0))
+        return 1;
+    } else if (rloc == 0) {
+      std::memcpy(stage.data(), D.data(), (size_t)cross_bytes);
+      for (int r = 1; r < intra->size; r++)
+        if (p2p_send(intra, r, kCollectiveTag,
+                     D.data() + (int64_t)r * cross_bytes, cross_bytes))
+          return 1;
+    } else {
+      if (recv_msg(intra, 0, kCollectiveTag, stage.data(), cross_bytes))
+        return 1;
+    }
+  } else {
+    std::memcpy(stage.data(), D.data(), (size_t)cross_bytes);
+  }
+  /* (island, src member) order -> world-rank positions */
+  {
+    int64_t off = 0;
+    for (int k = 0; k < L; k++) {
+      if (k == li) continue;
+      for (int32_t w : t->members[(size_t)k]) {
+        std::memcpy(out + (int64_t)w * chunk, stage.data() + off,
+                    (size_t)chunk);
+        off += chunk;
+      }
+    }
+  }
   return 0;
 }
 
@@ -4945,28 +5324,40 @@ int engine_run_body(EngineOp* o) {
       }
     }
     case TPU_OBS_ALLTOALL: {
-      const int64_t chunk = o->snb;
-      ObsScope obs(TPU_OBS_ALLTOALL, -1, 0, chunk * c->size,
-                   c->arena ? TPU_COLL_SHM : -1, tp);
-      LogScope log(c->rank, "Alltoall",
-                   [&] { return std::to_string(chunk) + " bytes/chunk"; });
-      if (c->arena) return shm_alltoall(c, o->sbuf, o->rbuf, chunk);
-      const char* in = static_cast<const char*>(o->sbuf);
-      char* out = static_cast<char*>(o->rbuf);
-      std::memcpy(out + (int64_t)c->rank * chunk,
-                  in + (int64_t)c->rank * chunk, chunk);
-      for (int round = 1; round < c->size; round++) {
-        int dest = (c->rank + round) % c->size;
-        int src = (c->rank - round + c->size) % c->size;
-        SendJob job;
-        if (async_send(c, &job, dest, kCollectiveTag,
-                       in + (int64_t)dest * chunk, chunk))
-          return 1;
-        int recv_rc = recv_msg(c, src, kCollectiveTag,
-                               out + (int64_t)src * chunk, chunk);
-        if (wait_send(c, &job) || recv_rc) return 1;
+      /* count > 0 marks the typed entry (tpucomm_alltoall_algo); the
+       * legacy byte-chunk tpucomm_alltoall has no dtype context and
+       * always resolves to the exact schedules. */
+      const bool typed = o->count > 0;
+      int64_t chunk = o->snb;
+      if (typed) {
+        int64_t esize = dtype_size(o->dtype);
+        if (esize == 0) FAIL(c, "bad dtype %d", o->dtype);
+        chunk = o->count * esize;
       }
-      return 0;
+      int chosen =
+          resolve_coll_algo(c, TPU_OPKIND_ALLTOALL, chunk * c->size,
+                            o->count, o->algo, typed ? o->dtype : -1);
+      ObsScope obs(TPU_OBS_ALLTOALL, -1, 0, chunk * c->size, chosen, tp);
+      if (chosen == TPU_COLL_QA2A)
+        obs.set_wire(quant_packed_bytes(o->count) * c->size);
+      LogScope log(c->rank, "Alltoall", [&] {
+        return std::to_string(chunk) + " bytes/chunk " +
+               coll_algo_name(chosen);
+      });
+      switch (chosen) {
+        case TPU_COLL_SHM:
+          return shm_alltoall(c, o->sbuf, o->rbuf, chunk);
+        case TPU_COLL_QA2A:
+          return q_alltoall(c, o->sbuf, o->rbuf, o->count, o->dtype);
+        case TPU_COLL_HA2A:
+          return h_alltoall(c, o->sbuf, o->rbuf, chunk, o->count,
+                            o->dtype, false);
+        case TPU_COLL_HQA2A:
+          return h_alltoall(c, o->sbuf, o->rbuf, chunk, o->count,
+                            o->dtype, true);
+        default:
+          return flat_alltoall(c, o->sbuf, o->rbuf, chunk);
+      }
     }
     case TPU_OBS_ALLREDUCE: {
       int64_t esize = dtype_size(o->dtype);
@@ -6166,6 +6557,20 @@ int tpucomm_alltoall(int64_t h, const void* sendbuf, void* recvbuf,
   return engine_submit(c, &op);
 }
 
+int tpucomm_alltoall_algo(int64_t h, const void* sendbuf, void* recvbuf,
+                          int64_t count, int dtype, int algo) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  EngineOp op;
+  op.kind = TPU_OBS_ALLTOALL;
+  op.sbuf = sendbuf;
+  op.rbuf = recvbuf;
+  op.count = count;
+  op.dtype = dtype;
+  op.algo = algo;
+  return engine_submit(c, &op);
+}
+
 int tpucomm_allreduce_algo(int64_t h, const void* sendbuf, void* recvbuf,
                            int64_t count, int dtype, int op, int algo) {
   Comm* c = get_comm(h);
@@ -6189,11 +6594,11 @@ int tpucomm_allreduce(int64_t h, const void* sendbuf, void* recvbuf,
 
 void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
                             const int32_t* algos, int n) {
-  if (op_kind < 0 || op_kind > 1) return;
+  if (op_kind < 0 || op_kind > 2) return;
   std::vector<std::pair<int64_t, int32_t>> entries;
   for (int i = 0; i < n; i++) {
     int32_t a = algos[i];
-    if (a < TPU_COLL_AUTO || a > TPU_COLL_HTREE || a == TPU_COLL_SHM)
+    if (a < TPU_COLL_AUTO || a > TPU_COLL_HQA2A || a == TPU_COLL_SHM)
       continue;  // SHM not forcible; unknown codes dropped
     entries.emplace_back(min_bytes[i], a);
   }
@@ -6204,7 +6609,7 @@ void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
 
 int tpucomm_coll_algo_for(int64_t h, int op_kind, int64_t nbytes) {
   Comm* c = get_comm(h);
-  if (!c || op_kind < 0 || op_kind > 1) return -1;
+  if (!c || op_kind < 0 || op_kind > 2) return -1;
   /* count only gates the built-in allreduce heuristic's ring cutoff;
    * approximate with 4-byte elements (the table path ignores it).
    * The probe has no dtype/op context: assume the quant-eligible case

@@ -71,12 +71,34 @@ enum TpuCollAlgo {
    * shapes).  Must agree across ranks like every other algorithm. */
   TPU_COLL_HRING = 7, /* hierarchical: intra reduce + leader ring + bcast */
   TPU_COLL_HTREE = 8, /* hierarchical: intra reduce + leader rd + bcast */
+  /* Alltoall family (MoE expert dispatch/combine is the workload).  The
+   * flat pairwise exchange keeps code TPU_COLL_RING — rd/tree have no
+   * alltoall schedule, so any other non-alltoall code canonicalizes to
+   * RING at resolution.  QA2A puts the qring/qrd int8 block codec on
+   * every off-rank chunk (per-256-element absmax scales packed into the
+   * frame; the own-rank chunk never crosses the wire and stays exact;
+   * rank-consistent by construction — each destination dequantizes the
+   * sender's packed bytes).  HA2A is the hierarchical schedule
+   * (generalizing hier_allgather's uneven-island block machinery):
+   * intra-island exchange over the shm/ici tier, then ONLY the
+   * cross-island chunk blocks travel the leader tier, then an
+   * intra-island scatter — a pure permutation, bit-identical to the
+   * flat exchange.  HQA2A quantizes the leader leg only (each
+   * cross-island block packed as one codec frame).  Alltoall only;
+   * gated by MPI4JAX_TPU_COLL_QUANT / MPI4JAX_TPU_HIER with the exact
+   * allow/deny/force semantics of the allreduce twins — an ineligible
+   * dtype or a flat comm degrades toward the exact flat exchange
+   * consistently across ranks. */
+  TPU_COLL_QA2A = 9,   /* quantized pairwise alltoall */
+  TPU_COLL_HA2A = 10,  /* hierarchical alltoall (exact) */
+  TPU_COLL_HQA2A = 11, /* hierarchical alltoall, quantized leader leg */
 };
 
 /* op kinds for the per-op decision tables */
 enum TpuCollOpKind {
   TPU_OPKIND_ALLREDUCE = 0,
   TPU_OPKIND_ALLGATHER = 1,
+  TPU_OPKIND_ALLTOALL = 2,
 };
 
 /* Create a communicator: rank/size, base TCP port, comma-separated host
@@ -239,6 +261,13 @@ int tpucomm_allreduce_algo(int64_t h, const void* sendbuf, void* recvbuf,
                            int64_t count, int dtype, int op, int algo);
 int tpucomm_allgather_algo(int64_t h, const void* sendbuf, int64_t nbytes,
                            void* recvbuf, int algo);
+/* Typed alltoall: `count` elements of `dtype` per destination chunk
+ * (sendbuf/recvbuf hold size*count elements).  The dtype context is
+ * what makes the quantized wire formats (TPU_COLL_QA2A / HQA2A)
+ * resolvable — the legacy byte-chunk tpucomm_alltoall has none and
+ * always runs the exact exchange. */
+int tpucomm_alltoall_algo(int64_t h, const void* sendbuf, void* recvbuf,
+                          int64_t count, int dtype, int algo);
 
 /* Install the process-wide decision table for one op kind: `n` entries
  * of (min_bytes ascending, TpuCollAlgo).  A call with payload `nbytes`
@@ -416,7 +445,9 @@ double tpucomm_obs_clock(void);
  *   GATHER     sbuf,snbytes -> rbuf (root only), peer(root)
  *   SCATTER    sbuf -> rbuf,rnbytes per rank, peer(root)
  *   ALLGATHER  sbuf,snbytes -> rbuf (size*snbytes); algo
- *   ALLTOALL   sbuf -> rbuf, snbytes = per-peer chunk
+ *   ALLTOALL   sbuf -> rbuf, snbytes = per-peer chunk bytes; count > 0
+ *              makes the call typed (count elems/chunk, dtype; algo
+ *              then resolves the quantized/hierarchical schedules)
  *   ALLREDUCE  sbuf -> rbuf, count/dtype/rop; algo
  *   REDUCE     sbuf -> rbuf, count/dtype/rop, peer(root)
  *   SCAN       sbuf -> rbuf, count/dtype/rop */

@@ -28,6 +28,12 @@ def test_diag_cpu_checks():
     topo_check = next(r for r in data["results"] if r["check"] == "topology")
     assert "island0[" in topo_check["detail"]
     assert "algo16mb=" in topo_check["detail"]
+    # the algorithm engine reports the alltoall family (MoE exchange)
+    # next to the quantized wire formats
+    ce = next(r for r in data["results"]
+              if r["check"] == "coll_algo_engine")
+    assert "quant=qring,qrd" in ce["detail"]
+    assert "alltoall=halltoall,hqalltoall,qalltoall" in ce["detail"]
     # the static verifier check proves both verdict directions
     sv = next(r for r in data["results"] if r["check"] == "static_verify")
     assert "tag_mismatch flagged" in sv["detail"]

@@ -488,3 +488,142 @@ def test_hier_algo_keeps_plain_allreduce_schedule_signature():
     assert rp.ok and rh.ok
     assert rp.schedules == rh.schedules
     assert rp.cache_key == rh.cache_key
+
+
+# ---------------- the alltoall family (MoE expert exchange) ----------------
+
+def test_check_algo_accepts_alltoall_family():
+    assert tune._check_algo("qalltoall", "alltoall") == "qalltoall"
+    assert tune._check_algo("halltoall", "alltoall") == "halltoall"
+    assert tune._check_algo("hqalltoall", "alltoall") == "hqalltoall"
+    assert tune.ALGO_CODES["qalltoall"] == 9
+    assert tune.ALGO_CODES["halltoall"] == 10
+    assert tune.ALGO_CODES["hqalltoall"] == 11
+    assert tune.A2A_ALGOS == {"qalltoall", "halltoall", "hqalltoall"}
+    assert tune.A2A_QUANT == {"qalltoall", "hqalltoall"}
+    assert tune.A2A_HIER == {"halltoall", "hqalltoall"}
+    # the degrade chain: one gate axis at a time
+    assert tune.HIER_FLAT_TWIN["halltoall"] == "ring"
+    assert tune.HIER_FLAT_TWIN["hqalltoall"] == "qalltoall"
+    # family names are alltoall-only; the allreduce twins stay theirs
+    with pytest.raises(ValueError):
+        tune._check_algo("qalltoall", "allreduce")
+    with pytest.raises(ValueError):
+        tune._check_algo("qring", "alltoall")
+
+
+def test_alltoall_simulators_permutation_and_quant_bound():
+    rng = np.random.RandomState(3)
+    n = 5
+    base = (rng.randn(n, n, 97) * 4).astype(np.float32)
+    inputs = [base[r] for r in range(n)]
+    want = [base[:, r] for r in range(n)]  # alltoall IS this transpose
+    # halltoall is a pure permutation: bit-identical to the flat exchange
+    got_h = topo.simulate_halltoall(inputs)
+    assert all(np.array_equal(g, w) for g, w in zip(got_h, want))
+    # qalltoall: own chunk exact, off-rank chunks int8-bounded
+    got_q = topo.simulate_qalltoall(inputs)
+    for r in range(n):
+        assert np.array_equal(got_q[r][r], want[r][r])
+        err = np.max(np.abs(got_q[r] - want[r]))
+        assert 0 < err < np.max(np.abs(base)) / 127.0 + 1e-6
+    # hqalltoall on a 3+2 split: intra chunks exact, cross bounded,
+    # and deterministic
+    islands = [[0, 1, 2], [3, 4]]
+    got_hq = topo.simulate_hqalltoall(inputs, islands)
+    again = topo.simulate_hqalltoall(inputs, islands)
+    for r in range(n):
+        assert np.array_equal(got_hq[r], again[r])
+        my = islands[0] if r in islands[0] else islands[1]
+        for s in range(n):
+            if s in my:
+                assert np.array_equal(got_hq[r][s], want[r][s]), (r, s)
+            else:
+                assert not np.array_equal(got_hq[r][s], want[r][s])
+                assert np.max(np.abs(got_hq[r][s] - want[r][s])) < (
+                    np.max(np.abs(base)) / 127.0 + 1e-6)
+    # single island degenerates to the exact permutation
+    one = topo.simulate_hqalltoall(inputs, [[0, 1, 2, 3, 4]])
+    assert all(np.array_equal(g, w) for g, w in zip(one, want))
+
+
+def test_leg_bytes_alltoall_family_geometry():
+    t = topo.Topology([_fp("a")] * 4 + [_fp("b")] * 4)
+    n, chunk = 8, 1000
+    nbytes = n * chunk
+    flat = t.leg_bytes("alltoall", nbytes)
+    assert flat == {"intra": 0, "inter": n * (n - 1) * chunk}
+    # halltoall: direct intra chunks + cross-chunk staging hops stay
+    # intra; only the cross blocks cross the leader tier
+    h = t.leg_bytes("halltoall", nbytes)
+    assert h["intra"] == (2 * 4 * 3 * chunk        # direct, both islands
+                          + 2 * (3 * 4 + 4 * 3) * chunk)  # staging
+    assert h["inter"] == 2 * 4 * 4 * chunk
+    # hqalltoall: same geometry, leader blocks through the codec
+    hq = t.leg_bytes("hqalltoall", nbytes)
+    assert hq["intra"] == h["intra"]
+    assert hq["inter"] == 2 * topo._quant_wire_bytes(4 * 4 * chunk)
+    assert hq["inter"] < h["inter"]
+    # flat quantized: every off-rank chunk is a codec frame
+    q = t.leg_bytes("qalltoall", nbytes)
+    assert q == {"intra": 0,
+                 "inter": n * (n - 1) * topo._quant_wire_bytes(chunk)}
+    # codec arithmetic matches the native formula 4*ceil(count/256)+count
+    assert topo._quant_wire_bytes(1024) == 256 + 4 * 1
+    assert topo._quant_wire_bytes(1028) == 257 + 4 * 2
+    # single island: everything is intra
+    tf = topo.Topology([_fp("a")] * 4)
+    assert tf.leg_bytes("qalltoall", 4000)["inter"] == 0
+    assert tf.leg_bytes("alltoall", 4000)["inter"] == 0
+
+
+def test_alltoall_leg_events_carry_no_tuning_signal():
+    # hierarchical alltoall's per-leg events (intra shm leg, inter ring/
+    # qalltoall leg) are labeled with the LEG algorithm and a tier: the
+    # tuner must read only the tier-less whole-op record
+    legs = [
+        {"name": "Alltoall", "src": "native", "algo": "shm",
+         "bytes": 4096, "dur_us": 5.0, "tier": "intra"},
+        {"name": "Alltoall", "src": "native", "algo": "qalltoall",
+         "bytes": 8192, "wire_bytes": 2176, "dur_us": 20.0,
+         "tier": "inter"},
+    ]
+    whole = {"name": "Alltoall", "src": "native", "algo": "hqalltoall",
+             "bytes": 1 << 15, "dur_us": 60.0}
+    m = tune.measurements_from_events(legs + [whole])
+    a2a = m.get("alltoall", {})
+    assert all("shm" not in by_algo for by_algo in a2a.values())
+    assert all("qalltoall" not in by_algo for by_algo in a2a.values())
+    assert a2a[1 << 15]["hqalltoall"] == pytest.approx(60e-6)
+
+
+def test_stats_alltoall_quant_rows_carry_wire_bytes():
+    events = [
+        # flat qalltoall whole-op record: packed wire, no tier
+        {"name": "Alltoall", "src": "native", "ts_us": 0.0,
+         "dur_us": 40.0, "wait_us": 0.0, "dispatch_us": 0.0,
+         "bytes": 8192, "wire_bytes": 2176, "peer": -1, "tag": 0,
+         "algo": "qalltoall"},
+        # hqalltoall legs: tier split, quantized leader leg
+        {"name": "Alltoall", "src": "native", "ts_us": 1.0,
+         "dur_us": 10.0, "wait_us": 0.0, "dispatch_us": 0.0,
+         "bytes": 4096, "peer": -1, "tag": 0, "algo": "shm",
+         "tier": "intra"},
+        {"name": "Alltoall", "src": "native", "ts_us": 2.0,
+         "dur_us": 30.0, "wait_us": 0.0, "dispatch_us": 0.0,
+         "bytes": 8192, "wire_bytes": 2176, "peer": -1, "tag": 0,
+         "algo": "qalltoall", "tier": "inter"},
+        {"name": "Alltoall", "src": "native", "ts_us": 3.0,
+         "dur_us": 60.0, "wait_us": 0.0, "dispatch_us": 0.0,
+         "bytes": 1 << 15, "peer": -1, "tag": 0, "algo": "hqalltoall"},
+    ]
+    stats = _stats.summarize(events)
+    assert stats["tier_bytes"] == {"intra": 4096, "inter": 8192}
+    rows = {(r["algo"], r.get("tier")): r for r in stats["per_op"]}
+    flatq = rows[("qalltoall", None)]
+    assert flatq["wire_bytes"] == 2176
+    assert flatq["compression"] == pytest.approx(8192 / 2176, rel=1e-3)
+    # the whole-op hqalltoall row is exact-payload (its compression
+    # lives on the leader-leg row), and never merges with its legs
+    assert "wire_bytes" not in rows[("hqalltoall", None)]
+    assert rows[("qalltoall", "inter")]["wire_bytes"] == 2176

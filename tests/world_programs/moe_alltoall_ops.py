@@ -1,0 +1,217 @@
+"""Cross-algorithm equivalence for the alltoall family — the
+qalltoall/halltoall/hqalltoall sibling of ``topo_ops.py``, and the
+verification spine of the MoE dispatch/combine path (the expert
+exchange IS this alltoall).
+
+Run under the launcher with ``MPI4JAX_TPU_FAKE_HOSTS`` partitioning the
+ranks into islands (the test drives 2x2 at np=4 and uneven 4+2 at np=6,
+shm on and off).  Asserts:
+
+- discovery: the Topology matches the partition and the default
+  decision table picks the flat pairwise exchange for alltoall at every
+  size (no quant/hier env set);
+- forced ring is bit-identical to the AUTO default;
+- ``halltoall`` (exact hierarchical) is a pure permutation: bit-identical
+  to the flat exchange on every partition — including uneven islands
+  and the non-contiguous interleaved one;
+- ``qalltoall`` matches ``topo.simulate_qalltoall`` bit-for-bit (the
+  destination dequantizes the SENDER's packed bytes, so parity with the
+  shared numpy codec IS the rank-consistency proof), keeps the own-rank
+  chunk exact, and stays inside the documented int8 error bound of the
+  exact exchange;
+- ``hqalltoall`` matches ``topo.simulate_hqalltoall`` bit-for-bit
+  (intra-island chunks exact; each cross-island block quantized as ONE
+  codec frame on the leader leg, 256-element blocks spanning chunk
+  boundaries), plus a global allgather cross-check of every rank's
+  output against the simulator;
+- bf16 payloads ride the f32 staging (upcast exact, RNE store) with the
+  same simulator parity; exact paths move the bf16 bits verbatim;
+- int32 is codec-ineligible: forced qalltoall/hqalltoall degrade to the
+  exact exchange bit-for-bit on every rank;
+- ``MPI4JAX_TPU_COLL_QUANT=deny`` degrades qalltoall -> ring and
+  hqalltoall -> halltoall (exact bits); ``=force`` upgrades the default
+  AND forced-ring paths to qalltoall and halltoall to hqalltoall
+  (simulator parity switches accordingly); ``MPI4JAX_TPU_HIER=deny``
+  degrades hqalltoall to the flat quantized exchange.
+
+Bridge-level with the parent-package shim (no jax import): runs in ANY
+container, like the coalescing bridge programs.
+"""
+
+import os
+import sys
+import types
+
+REPO = os.path.join(os.path.dirname(__file__), "..", "..")
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+
+import numpy as np  # noqa: E402
+
+from mpi4jax_tpu import topo, tune  # noqa: E402
+from mpi4jax_tpu.runtime import bridge, transport  # noqa: E402
+
+# wire codes (native/tpucomm.h)
+F32, BF16, I32 = 11, 10, 3
+
+
+def f32_to_bf16_bits(a32):
+    bits = a32.view(np.uint32)
+    rounded = bits + np.uint32(0x7FFF) + ((bits >> np.uint32(16))
+                                          & np.uint32(1))
+    return (rounded >> np.uint32(16)).astype(np.uint16)
+
+
+def bf16_bits_to_f32(b):
+    return (b.astype(np.uint32) << 16).view(np.float32)
+
+
+def forced(h, x, name, dtype_code=None):
+    out = np.empty_like(x)
+    bridge.alltoall_raw(h, x, out, algo=tune.ALGO_CODES[name],
+                        dtype_code=dtype_code)
+    return out
+
+
+def main():
+    comm = transport.get_world_comm()
+    rank, size = comm.rank(), comm.size()
+    h = comm.handle
+    shm_on = os.environ.get("MPI4JAX_TPU_DISABLE_SHM", "") in ("", "0")
+
+    # ---- discovery + default-table assertions ---------------------
+    t = comm.topology()
+    assert t is not None and t.multi, f"expected a multi-island map, got {t}"
+    expect = [int(x) for x in os.environ["TOPO_EXPECT_ISLANDS"].split(",")]
+    assert t.island_of == expect, (t.island_of, expect)
+    if (not os.environ.get("MPI4JAX_TPU_COLL_ALGO")
+            and not os.environ.get("MPI4JAX_TPU_COLL_QUANT")):
+        # alltoall's default is the flat pairwise exchange at EVERY
+        # size (the quantized/hierarchical twins are opt-in via the
+        # tuner cache or a forced algo)
+        assert comm.coll_algo("alltoall", 64) == "ring"
+        assert comm.coll_algo("alltoall", 16 << 20) == "ring"
+
+    qmode = os.environ.get("MPI4JAX_TPU_COLL_QUANT", "allow").strip()
+    qdeny, qforce = qmode == "deny", qmode == "force"
+    hdeny = os.environ.get("MPI4JAX_TPU_HIER", "allow").strip() == "deny"
+    islands = t.islands
+
+    rng = np.random.RandomState(11)
+    for count in (3, 513, 20000):  # < codec block, odd multi-block, 80KB
+        # every rank derives the same base from the shared seed:
+        # base[r] is rank r's (size, count) send matrix
+        base_f = (rng.randn(size, size, count) * 3).astype(np.float32)
+        base_i = rng.randint(-900, 900,
+                             size=(size, size, count)).astype(np.int32)
+        bf_bits = f32_to_bf16_bits(base_f)
+        inputs_f = [base_f[r] for r in range(size)]
+        inputs_b = [bf16_bits_to_f32(bf_bits[r]) for r in range(size)]
+
+        sim_h = topo.simulate_halltoall(inputs_f)  # == flat exact
+        sim_q = topo.simulate_qalltoall(inputs_f)
+        sim_hq = topo.simulate_hqalltoall(inputs_f, islands)
+
+        # ---- f32 -------------------------------------------------
+        x = base_f[rank].copy()
+        ref = bridge.alltoall(h, x)
+        ring = forced(h, x, "ring")
+        assert np.array_equal(ring, ref), (
+            f"count={count}: forced ring != AUTO default")
+        # under COLL_QUANT=force the default (and forced ring) ride
+        # the quantized wire; anywhere else the flat exchange is exact
+        want_ref = sim_q[rank] if qforce else sim_h[rank]
+        assert np.array_equal(ref, want_ref), (
+            f"count={count} qforce={qforce}: default path diverges from "
+            f"the simulator (maxdiff {np.max(np.abs(ref - want_ref))})")
+
+        out = forced(h, x, "qalltoall")
+        if qdeny:
+            assert np.array_equal(out, sim_h[rank]), (
+                f"count={count}: denied qalltoall is not the exact ring")
+        else:
+            assert np.array_equal(out, sim_q[rank]), (
+                f"count={count}: qalltoall diverges from the simulator "
+                f"(maxdiff {np.max(np.abs(out - sim_q[rank]))})")
+            assert np.array_equal(out[rank], x[rank]), (
+                "qalltoall own chunk must stay exact")
+            denom = max(float(np.max(np.abs(sim_h[rank]))), 1e-6)
+            err = float(np.max(np.abs(out - sim_h[rank]))) / denom
+            assert err < 5e-2, f"qalltoall rel err {err:.2e}"
+
+        out = forced(h, x, "halltoall")
+        # exact hierarchical = pure permutation: bit-identical to flat
+        # under allow AND deny (the degrade target moves the same
+        # bytes); quant force upgrades it to the quantized-leader twin
+        want = sim_hq[rank] if (qforce and not hdeny) else sim_h[rank]
+        assert np.array_equal(out, want), (
+            f"count={count} qforce={qforce}: halltoall diverges "
+            f"(maxdiff {np.max(np.abs(out - want))})")
+
+        out = forced(h, x, "hqalltoall")
+        if qdeny:
+            want, label = sim_h[rank], "halltoall (exact)"
+        elif hdeny:
+            want, label = sim_q[rank], "flat qalltoall"
+        else:
+            want, label = sim_hq[rank], "the hqalltoall simulator"
+        assert np.array_equal(out, want), (
+            f"count={count}: hqalltoall should match {label} "
+            f"(maxdiff {np.max(np.abs(out - want))})")
+        if not (qdeny or hdeny):
+            for s in t.island(rank):
+                assert np.array_equal(out[s], base_f[s][rank]), (
+                    "hqalltoall intra-island chunk must stay exact")
+            # global consistency: every rank's output must be the
+            # simulator's row for that rank (the leader quantizes each
+            # cross block once; everyone dequantizes the same bytes)
+            rows = bridge.allgather(h, out.reshape(-1).copy(), size)
+            for r in range(size):
+                assert np.array_equal(rows[r], sim_hq[r].reshape(-1)), (
+                    f"count={count}: rank {r}'s hqalltoall output "
+                    "disagrees with the shared simulator")
+
+        # ---- bf16 (f32 staging: upcast exact, RNE store) ---------
+        xb = bf_bits[rank].copy()
+        outb = forced(h, xb, "qalltoall", dtype_code=BF16)
+        if qdeny:
+            assert np.array_equal(outb, bf_bits[:, rank]), (
+                "denied bf16 qalltoall must move the bits verbatim")
+        else:
+            want_bits = f32_to_bf16_bits(
+                topo.simulate_qalltoall(inputs_b)[rank])
+            assert np.array_equal(outb, want_bits), (
+                f"count={count}: bf16 qalltoall diverges from the "
+                "simulator (RNE staging contract)")
+        outb = forced(h, xb, "hqalltoall", dtype_code=BF16)
+        if qdeny:
+            want_bits = bf_bits[:, rank]
+        elif hdeny:
+            want_bits = f32_to_bf16_bits(
+                topo.simulate_qalltoall(inputs_b)[rank])
+        else:
+            want_bits = f32_to_bf16_bits(
+                topo.simulate_hqalltoall(inputs_b, islands)[rank])
+        assert np.array_equal(outb, want_bits), (
+            f"count={count}: bf16 hqalltoall diverges")
+        if not qforce:
+            outb = forced(h, xb, "halltoall", dtype_code=BF16)
+            assert np.array_equal(outb, bf_bits[:, rank]), (
+                "bf16 halltoall must move the bits verbatim")
+
+        # ---- int32: codec-ineligible, degrades to exact ----------
+        xi = base_i[rank].copy()
+        refi = bridge.alltoall(h, xi)
+        assert np.array_equal(refi, base_i[:, rank]), "i32 flat exchange"
+        for name in ("qalltoall", "halltoall", "hqalltoall"):
+            outi = forced(h, xi, name)
+            assert np.array_equal(outi, refi), (
+                f"i32 {name} must degrade to the exact exchange")
+
+    print(f"moe_alltoall_ops OK (shm={int(shm_on)})", flush=True)
+
+
+if __name__ == "__main__":
+    main()
