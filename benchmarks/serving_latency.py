@@ -1,42 +1,54 @@
-"""Request-latency percentiles for the elastic serving harness —
-before, during, and after an injected rank failure.
+"""Load generator for the serving plane (mpi4jax_tpu/serving): open-loop
+arrivals, per-phase latency percentiles before / during / after an
+injected rank death, goodput across the recovery, and the KV-cache
+speedup over full recomputation.
 
-Run as a rank program under the launcher (bridge-level: no jax, works
-in any container), rank 0 prints one ``obs.bench_record`` JSON row per
-phase:
+Two ways to run it:
 
-    # steady-state baseline
-    python -m mpi4jax_tpu.runtime.launch -n 3 --elastic \
-        benchmarks/serving_latency.py
+**Driver mode** (no launcher — spawns its own jobs and writes the
+committed artifact)::
 
-    # with a worker death mid-stream
-    MPI4JAX_TPU_FAULT=rank=1,point=recv,after=40,action=exit \
-    MPI4JAX_TPU_TIMEOUT_S=8 MPI4JAX_TPU_DISABLE_SHM=1 \
-    python -m mpi4jax_tpu.runtime.launch -n 3 --elastic \
-        benchmarks/serving_latency.py
+    python benchmarks/serving_latency.py --write   # BENCH_serving_v2.json
 
-Phases: ``before`` — requests that completed before the failure was
-detected; ``during`` — requests that were in flight across the
-recovery (their iterations were retried on the shrunk world; their
-latency carries the detection deadline + the rebuild, which is why
-p99 spikes there); ``after`` — requests submitted after recovery,
-i.e. the shrunk world's steady state.  Without a fault everything
-lands in one ``steady`` row.  The rows share the benchmark field
-names (op/bytes/us/p50_us/p95_us/p99_us), so they join with
-``obs.stats`` tables and the ``profile report`` rendering of any
-``--trace`` recording taken alongside.
+runs a steady and a fault-injected scenario (np=4, two virtual islands,
+forced disaggregation, a decode rank killed mid-stream) plus the
+in-process KV-cache-vs-recompute measurement, and enforces the
+acceptance gates: zero lost requests, post-recovery goodput >= 80% of
+pre-fault, cached decode >= 5x over full recompute at seqlen 512.
+
+**Rank mode** (under the launcher — what the driver spawns; also usable
+directly)::
+
+    python -m mpi4jax_tpu.runtime.launch -n 4 --elastic \
+        --fake-hosts "r0,r1|r2,r3" benchmarks/serving_latency.py \
+        --requests 500 --roles disagg
+
+Open loop means the arrival clock never waits for the server: request
+i is submitted when its (seeded, exponential inter-arrival) timestamp
+passes, however loaded the plane is — so latency percentiles reflect
+queueing, not a closed feedback loop that self-throttles under load.
+
+Phase buckets: ``before`` — completed with no retries before the first
+recovery finished; ``during`` — in flight across the recovery (their
+latency carries the detection deadline + rebuild + re-prefill, which
+is why p99 spikes there); ``after`` — the shrunk world's steady state.
+Each bucket row carries request-latency, TTFT (the prefill phase), and
+per-token decode percentiles — the same split the obs
+``phase=prefill|decode`` spans record — via ``obs.bench_record``, so
+rows join the usual benchmark artifacts.
 """
 
 import argparse
 import json
 import os
 import sys
+import time
 import types
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 if "mpi4jax_tpu" not in sys.modules:
-    # parent-package shim: obs + elastic + the bridge import without
+    # parent-package shim: obs + serving + the bridge import without
     # jax, so the benchmark runs wherever the launcher does
     pkg = types.ModuleType("mpi4jax_tpu")
     pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
@@ -44,103 +56,291 @@ if "mpi4jax_tpu" not in sys.modules:
 
 import numpy as np  # noqa: E402
 
-from mpi4jax_tpu import obs  # noqa: E402
-from mpi4jax_tpu.elastic import serving  # noqa: E402
-from mpi4jax_tpu.runtime import transport  # noqa: E402
+from mpi4jax_tpu import obs, serving  # noqa: E402
+
+DEFAULT_REQUESTS = 500
+DEFAULT_RATE = 250.0  # open-loop arrivals per second
+FAKE_HOSTS = "r0,r1|r2,r3"
+FAULT = "rank=3,point=send,after=2500,action=exit"  # a decode rank
 
 
-def decode_fn(toks, lengths, start, stop):
-    """Toy next-token function (pure function of the row, so retried
-    iterations and shrunk worlds reproduce identical transcripts)."""
-    out = np.zeros(stop - start, np.int32)
-    for i in range(start, stop):
-        n = int(lengths[i])
-        row = toks[i, :n].astype(np.int64)
-        out[i - start] = int((row.sum() * 31 + n * 7 + int(row[-1])) % 997)
-    return out
+# ---------------- rank mode ----------------
 
 
-def _phase_row(phase, reqs, *, ranks, recoveries):
-    lat_us = sorted(r.latency_s * 1e6 for r in reqs)
-    mean_bytes = int(np.mean([4 * len(r.tokens) for r in reqs]))
+def _phase_row(bucket, reqs, *, ranks, recoveries, window_s):
+    lat = sorted(r.latency_s * 1e6 for r in reqs)
+    ttft = sorted(r.ttft_s * 1e6 for r in reqs)
+    dtok = sorted((r.completed_at - r.first_token_at) * 1e6
+                  / max(len(r.generated) - 1, 1) for r in reqs)
+    toks = sum(len(r.generated) for r in reqs)
     return obs.bench_record(
-        op="serve_request", nbytes=mean_bytes,
-        seconds=obs.percentile(lat_us, 50) / 1e6, ranks=None,
-        tier="serving", reps=len(reqs),
-        phase=phase,
-        p50_us=round(obs.percentile(lat_us, 50), 1),
-        p95_us=round(obs.percentile(lat_us, 95), 1),
-        p99_us=round(obs.percentile(lat_us, 99), 1),
-        completed=len(reqs), recoveries=recoveries,
-        world_size_end=ranks,
+        op="serve_request",
+        nbytes=int(np.mean([4 * len(r.tokens) for r in reqs])),
+        seconds=obs.percentile(lat, 50) / 1e6, ranks=None,
+        tier="serving", reps=len(reqs), phase=bucket,
+        p50_us=round(obs.percentile(lat, 50), 1),
+        p95_us=round(obs.percentile(lat, 95), 1),
+        p99_us=round(obs.percentile(lat, 99), 1),
+        ttft_p50_us=round(obs.percentile(ttft, 50), 1),
+        ttft_p95_us=round(obs.percentile(ttft, 95), 1),
+        ttft_p99_us=round(obs.percentile(ttft, 99), 1),
+        decode_tok_p50_us=round(obs.percentile(dtok, 50), 1),
+        decode_tok_p95_us=round(obs.percentile(dtok, 95), 1),
+        decode_tok_p99_us=round(obs.percentile(dtok, 99), 1),
+        completed=len(reqs), tokens=toks,
+        goodput_tok_s=(round(toks / window_s, 1) if window_s else None),
+        recoveries=recoveries, world_size_end=ranks,
     )
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--requests", type=int, default=24,
-                    help="total requests (half submitted up front, "
-                         "half streamed in while serving)")
-    ap.add_argument("--max-new", type=int, default=6)
-    ap.add_argument("--max-batch", type=int, default=4)
-    args = ap.parse_args()
+def rank_main(args):
+    from mpi4jax_tpu.runtime import transport
 
     comm = transport.get_world_comm()
     _ = comm.handle
+    adapter = serving.ToyAdapter()
     if comm.rank() != 0:
-        serving.serve_worker(comm, decode_fn)
+        serving.serve_worker(comm, adapter, roles_mode=args.roles)
         return
 
-    server = serving.Server(comm, decode_fn, max_batch=args.max_batch)
-    rng = np.random.RandomState(11)
+    server = serving.Server(comm, adapter, max_batch=args.max_batch,
+                            chunk_tokens=args.chunk_tokens,
+                            queue_cap=args.requests + 1,
+                            roles_mode=args.roles)
+    print(f"serving_latency {server.roles.describe()}", flush=True)
+    rng = np.random.RandomState(args.seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / args.rate,
+                                         size=args.requests))
+    prompts = [rng.randint(0, 900, size=rng.randint(3, 9)).tolist()
+               for _ in range(args.requests)]
 
-    def submit(n):
-        for _ in range(n):
-            server.submit(rng.randint(0, 900, size=rng.randint(2, 5)),
-                          max_new=args.max_new)
-
-    first = args.requests // 2
-    submit(first)
-    import time
-
-    recovery_at = None  # perf_counter of the first completed recovery
-    streamed = False
+    t_start = time.perf_counter()
+    submitted = 0
+    recovery_at = None  # perf_counter when the first recovery finished
     iters = 0
-    while server.active or len(server.completed) < args.requests:
-        iters += 1
-        if iters > 2000:
-            raise RuntimeError("serving did not drain")
+    while submitted < args.requests or server.active:
+        elapsed = time.perf_counter() - t_start
+        while (submitted < args.requests
+               and arrivals[submitted] <= elapsed):
+            v = server.submit(prompts[submitted], max_new=args.max_new)
+            assert v.admitted, v.reason
+            submitted += 1
         pre = server.recoveries
-        server.step()
+        if not server.step() and not server.active:
+            time.sleep(0.0005)  # idle: the next arrival is in the future
         if server.recoveries > pre and recovery_at is None:
             recovery_at = time.perf_counter()
-        # stream the second half in: after recovery when a fault is
-        # armed (the "after" phase), else once serving is warm
-        if not streamed and (recovery_at is not None or iters == 4):
-            submit(args.requests - first)
-            streamed = True
+        iters += 1
+        if iters > 500000:
+            raise RuntimeError("serving did not drain")
     server.stop()
 
     done = server.completed
-    assert len(done) == args.requests, (len(done), args.requests)
+    # zero lost: every admitted request completed, exactly once
+    assert len(done) == submitted == args.requests, (
+        len(done), submitted, args.requests)
+    assert len({r.id for r in done}) == len(done)
+
     rows = []
+    t_end = max(r.completed_at for r in done)
     if server.recoveries == 0:
         rows.append(_phase_row("steady", done, ranks=comm.size(),
-                               recoveries=0))
+                               recoveries=0, window_s=t_end - t_start))
     else:
         before = [r for r in done if r.retries == 0
                   and r.completed_at < recovery_at]
         during = [r for r in done if r.retries > 0]
         after = [r for r in done if r.retries == 0
                  and r.completed_at >= recovery_at]
-        for phase, reqs in (("before", before), ("during", during),
-                            ("after", after)):
+        windows = {"before": recovery_at - t_start,
+                   "during": None,  # spans the recovery, not a rate
+                   "after": t_end - recovery_at}
+        for bucket, reqs in (("before", before), ("during", during),
+                             ("after", after)):
             if reqs:
-                rows.append(_phase_row(phase, reqs, ranks=comm.size(),
-                                       recoveries=server.recoveries))
+                rows.append(_phase_row(
+                    bucket, reqs, ranks=comm.size(),
+                    recoveries=server.recoveries,
+                    window_s=windows[bucket]))
     for row in rows:
         print(json.dumps(row), flush=True)
+    print(f"serving_latency done submitted={submitted} "
+          f"completed={len(done)} recoveries={server.recoveries} "
+          f"iters={iters}", flush=True)
+
+
+# ---------------- KV-cache speedup (in-process, no launcher) ----------------
+
+
+def kv_speedup(seqlen=512, gen=8):
+    """Per-token cost of cached ``decode_step`` vs the toy plane's cost
+    model (one full forward per generated token) on the numpy GPT at
+    ``seqlen`` — the number that justifies the KV cache existing."""
+    a = serving.make_numpy_gpt_adapter(max_seq=seqlen + gen + 1)
+    prompt = (np.arange(seqlen, dtype=np.int64) * 7 + 3) % a.vocab
+
+    past, logits = a.prefill(prompt.astype(np.int32))
+    cached_toks, cached_us = [], []
+    for _ in range(gen):
+        nxt = int(np.argmax(logits))
+        cached_toks.append(nxt)
+        t0 = time.perf_counter()
+        entry, logits = a.decode_step(past, nxt)
+        cached_us.append((time.perf_counter() - t0) * 1e6)
+        past = np.concatenate([past, entry[None]])
+
+    toks = list(prompt)
+    logits = a.prefill(np.asarray(toks, np.int32))[1]
+    full_toks, full_us = [], []
+    for _ in range(gen):
+        nxt = int(np.argmax(logits))
+        full_toks.append(nxt)
+        toks.append(nxt)
+        t0 = time.perf_counter()
+        logits = a.prefill(np.asarray(toks, np.int32))[1]
+        full_us.append((time.perf_counter() - t0) * 1e6)
+
+    assert cached_toks == full_toks, "cached and recompute paths diverged"
+    cached = obs.percentile(sorted(cached_us), 50)
+    full = obs.percentile(sorted(full_us), 50)
+    return {
+        "seqlen": seqlen, "generated": gen,
+        "cached_us_per_tok": round(cached, 1),
+        "recompute_us_per_tok": round(full, 1),
+        "speedup": round(full / cached, 1),
+        "transcripts_identical": True,
+    }
+
+
+# ---------------- driver mode ----------------
+
+
+def _spawn(label, np_, port, extra_env, prog_args):
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra_env)
+    res = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "mpi4jax_tpu", "runtime", "launch.py"),
+         "-n", str(np_), "--port", str(port), "--elastic",
+         "--fake-hosts", FAKE_HOSTS, os.path.abspath(__file__)]
+        + prog_args,
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
+    if res.returncode != 0 or "serving_latency done" not in res.stdout:
+        sys.stderr.write(res.stderr + res.stdout)
+        raise SystemExit(f"scenario {label} failed")
+    rows = [json.loads(ln) for ln in res.stdout.splitlines()
+            if ln.startswith("{")]
+    tail = [ln for ln in res.stdout.splitlines()
+            if ln.startswith("serving_latency done")][0]
+    meta = dict(kv.split("=") for kv in tail.split()[2:])
+    return {"rows": rows, "submitted": int(meta["submitted"]),
+            "completed": int(meta["completed"]),
+            "recoveries": int(meta["recoveries"])}
+
+
+def drive(requests, out_path):
+    prog_args = ["--requests", str(requests), "--roles", "disagg"]
+    scenarios = {}
+    scenarios["steady_np4_disagg"] = _spawn(
+        "steady", 4, 47810, {"MPI4JAX_TPU_DISABLE_SHM": "1"}, prog_args)
+    scenarios["fault_np4_disagg"] = _spawn(
+        "fault", 4, 47840,
+        {"MPI4JAX_TPU_DISABLE_SHM": "1", "MPI4JAX_TPU_TIMEOUT_S": "8",
+         "MPI4JAX_TPU_FAULT": FAULT}, prog_args)
+    kv = kv_speedup()
+
+    fault = scenarios["fault_np4_disagg"]
+    buckets = {r["phase"]: r for r in fault["rows"]}
+    assert fault["recoveries"] >= 1, "the fault did not fire"
+    assert {"before", "during", "after"} <= set(buckets), (
+        f"missing phase buckets: {sorted(buckets)}")
+    for label, sc in scenarios.items():
+        assert sc["completed"] == sc["submitted"] == requests, (
+            label, sc["completed"], sc["submitted"])
+    goodput_ratio = round(buckets["after"]["goodput_tok_s"]
+                          / buckets["before"]["goodput_tok_s"], 3)
+    assert goodput_ratio >= 0.8, (
+        f"post-recovery goodput ratio {goodput_ratio} < 0.8")
+    assert kv["speedup"] >= 5.0, f"KV speedup {kv['speedup']} < 5x"
+
+    artifact = {
+        "note": (
+            "Serving-plane load test (benchmarks/serving_latency.py): "
+            f"{requests} open-loop requests (seeded exponential "
+            f"arrivals, ~{DEFAULT_RATE:g}/s) against the disaggregated "
+            "prefill/decode plane on a 2-island np=4 virtual mesh "
+            f"({FAKE_HOSTS}; frontend=r0, prefill=r1, decode=r2,r3), "
+            "toy adapter (exactly prefix-consistent, so retried "
+            "transcripts are byte-identical).  The fault scenario "
+            f"kills decode rank 3 mid-stream ({FAULT}); the plane "
+            "recovers, re-derives roles on the shrunk world, "
+            "re-prefills in-flight requests, and completes every "
+            "admitted request (zero lost, driver-asserted).  Buckets: "
+            "before = completed pre-failure, during = in flight "
+            "across the recovery (latency carries detection + rebuild "
+            "+ re-prefill), after = the shrunk world.  kv_cache: "
+            "per-token cached decode_step vs one full forward per "
+            "token (the toy plane's cost model) on the numpy GPT at "
+            "seqlen 512, transcripts asserted identical."),
+        "config": {
+            "requests": requests, "rate_rps": DEFAULT_RATE,
+            "max_new": 4, "max_batch": 16, "chunk_tokens": 64,
+            "adapter": "ToyAdapter", "roles": "disagg",
+            "fake_hosts": FAKE_HOSTS, "fault": FAULT,
+            "env": {"JAX_PLATFORMS": "cpu",
+                    "MPI4JAX_TPU_DISABLE_SHM": "1"},
+        },
+        "scenarios": scenarios,
+        "kv_cache": kv,
+        "findings": {
+            "zero_lost": True,
+            "goodput_after_over_before": goodput_ratio,
+            "kv_cache_speedup_seqlen512": kv["speedup"],
+            "during_p99_over_after_p99": round(
+                buckets["during"]["p99_us"] / buckets["after"]["p99_us"],
+                1),
+        },
+    }
+    text = json.dumps(artifact, indent=1)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {out_path}")
+    else:
+        print(text)
+
+
+def _parse_rank_args(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=DEFAULT_REQUESTS)
+    ap.add_argument("--rate", type=float, default=DEFAULT_RATE)
+    ap.add_argument("--max-new", type=int, default=4)
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--chunk-tokens", type=int, default=64)
+    ap.add_argument("--roles", default="auto")
+    ap.add_argument("--seed", type=int, default=11)
+    return ap.parse_args(argv)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("MPI4JAX_TPU_RANK"):
+        rank_main(_parse_rank_args())
+        sys.exit(0)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=DEFAULT_REQUESTS)
+    ap.add_argument("--kv-only", action="store_true",
+                    help="only the in-process KV speedup measurement")
+    ap.add_argument("--write", action="store_true",
+                    help=f"write {os.path.join(REPO, 'BENCH_serving_v2.json')}")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+    if args.kv_only:
+        print(json.dumps(kv_speedup(), indent=1))
+        sys.exit(0)
+    out = args.out or (os.path.join(REPO, "BENCH_serving_v2.json")
+                       if args.write else None)
+    drive(args.requests, out)

@@ -1,30 +1,36 @@
-"""Continuous-batching GPT serving over the world tier — elastically.
+"""KV-cached GPT serving over the world tier — elastically.
 
     python -m mpi4jax_tpu.runtime.launch -n 3 --elastic \
         examples/serve_gpt.py --requests 12 --max-new 8
 
-Rank 0 is the frontend (request queue + sequence state), every rank
-decodes its slice of the running batch (the DP pattern over the
-world-tier transport), and the whole job keeps answering requests
-across a rank death: kill a worker mid-stream —
+Rank 0 is the frontend (admission queue, commit point), the other
+ranks run the serving-plane worker loop: prefill ranks chew prompt
+chunks against a paged KV cache and ship the finished KV to the decode
+ranks, which then produce one token per iteration with an O(1)
+``decode_step`` instead of re-running the full sequence
+(docs/serving.md).  On a multi-island world (or with
+``MPI4JAX_TPU_SERVE_ROLES=disagg``) the two phases land on different
+ranks; the whole job keeps answering requests across a rank death —
+kill a worker mid-stream:
 
     MPI4JAX_TPU_FAULT=rank=1,point=recv,after=60,action=exit \
     MPI4JAX_TPU_TIMEOUT_S=8 MPI4JAX_TPU_DISABLE_SHM=1 \
     python -m mpi4jax_tpu.runtime.launch -n 3 --elastic \
         examples/serve_gpt.py
 
-— and the survivors shrink, retry the in-flight requests, and drain
-the queue (docs/elasticity.md walks through this).
+— the survivors shrink, roles re-derive, in-flight requests re-prefill
+from their committed tokens, and the queue drains (docs/elasticity.md
+covers the recovery machinery).
 
-The model is the tiny GPT-2 from ``benchmarks/quant_accuracy.py`` with
-random weights (a serving-mechanics demo, not a language demo); greedy
-argmax decoding, so completions are deterministic and independent of
-the world size — an elastic run returns exactly what an uninterrupted
-run would.
+The model is the tiny seeded GPT the benchmarks share
+(``serving.make_jax_gpt_adapter``: jitted fixed-shape decode kernel;
+where jax is unusable the identical numpy model serves instead).
+Greedy argmax decoding, so completions are deterministic and
+independent of world size and role split — an elastic run returns
+exactly what an uninterrupted run would.
 """
 
 import argparse
-import importlib.util
 import os
 import sys
 import time
@@ -35,41 +41,15 @@ sys.path.insert(0, REPO)
 import numpy as np  # noqa: E402
 
 import mpi4jax_tpu  # noqa: E402,F401
-from mpi4jax_tpu.elastic import serving  # noqa: E402
+from mpi4jax_tpu import serving  # noqa: E402
 from mpi4jax_tpu.runtime import transport  # noqa: E402
 
-_spec = importlib.util.spec_from_file_location(
-    "m4j_serve_model", os.path.join(REPO, "benchmarks",
-                                    "quant_accuracy.py"))
-_qa = importlib.util.module_from_spec(_spec)
-_spec.loader.exec_module(_qa)
 
-VOCAB, D_MODEL, N_LAYER, N_HEAD, SEQ = 64, 32, 2, 4, 48
-
-
-def make_decode_fn():
-    import jax
-    import jax.numpy as jnp
-
-    # device arrays: numpy params fancy-indexed by a traced token array
-    # would call __array__ on the tracer
-    params = jax.tree.map(jnp.asarray, _qa.gpt2_init(
-        np.random.RandomState(0), VOCAB, D_MODEL, N_LAYER, N_HEAD, SEQ))
-
-    @jax.jit
-    def logits_fn(toks):
-        return _qa.gpt2_logits(params, jnp.asarray(toks), N_LAYER, N_HEAD)
-
-    def decode_fn(toks, lengths, start, stop):
-        # greedy argmax at each row's last real position: a pure
-        # function of the row contents, so retried iterations (and
-        # shrunk worlds) produce identical tokens
-        logits = np.asarray(logits_fn(toks[start:stop]))
-        idx = np.asarray(lengths[start:stop], np.int64) - 1
-        rows = logits[np.arange(stop - start), idx]
-        return rows.argmax(-1).astype(np.int32)
-
-    return decode_fn
+def make_adapter():
+    try:
+        return serving.make_jax_gpt_adapter(), "jax (jitted decode)"
+    except Exception as err:  # noqa: BLE001 — any jax breakage
+        return serving.make_numpy_gpt_adapter(), f"numpy ({err})"
 
 
 def main():
@@ -77,30 +57,39 @@ def main():
     ap.add_argument("--requests", type=int, default=12)
     ap.add_argument("--max-new", type=int, default=8)
     ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--roles", default=None,
+                    help="auto | colocated | disagg (default: the "
+                         "MPI4JAX_TPU_SERVE_ROLES knob, then auto)")
     args = ap.parse_args()
 
     comm = transport.get_world_comm()
     _ = comm.handle
-    decode_fn = make_decode_fn()
+    adapter, backend = make_adapter()
 
     if comm.rank() != 0:
-        serving.serve_worker(comm, decode_fn)
+        serving.serve_worker(comm, adapter, roles_mode=args.roles)
         return
 
-    server = serving.Server(comm, decode_fn, max_batch=args.max_batch)
+    server = serving.Server(comm, adapter, max_batch=args.max_batch,
+                            chunk_tokens=32, roles_mode=args.roles)
+    print(f"adapter backend: {backend}; {server.roles.describe()}",
+          flush=True)
     rng = np.random.RandomState(7)
     t0 = time.perf_counter()
-    for i in range(args.requests):
-        prompt = rng.randint(0, VOCAB, size=rng.randint(2, 6)).tolist()
-        server.submit(prompt, max_new=args.max_new)
+    for _ in range(args.requests):
+        prompt = rng.randint(0, adapter.vocab,
+                             size=rng.randint(2, 6)).tolist()
+        verdict = server.submit(prompt, max_new=args.max_new)
+        assert verdict.admitted, verdict.reason
     done = server.run_until_drained()
     server.stop()
     dt = time.perf_counter() - t0
 
     for r in sorted(done, key=lambda r: r.id):
         print(f"req {r.id}: prompt {r.prompt} -> {r.generated} "
-              f"({r.latency_s * 1e3:.1f} ms"
-              + (f", {r.retries} retried iter(s)" if r.retries else "")
+              f"({r.latency_s * 1e3:.1f} ms, ttft "
+              f"{r.ttft_s * 1e3:.1f} ms"
+              + (f", {r.retries} re-prefill(s)" if r.retries else "")
               + ")")
     lat = sorted(r.latency_s for r in done)
     print(f"served {len(done)} requests in {dt:.2f} s "

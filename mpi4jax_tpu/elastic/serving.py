@@ -1,5 +1,11 @@
 """Continuous-batching inference serving that survives rank death.
 
+This is the *toy* plane: full-sequence re-decode every iteration, no
+KV cache, no admission control — kept small as the elastic regression
+surface.  The real serving subsystem (KV-cache-backed decode,
+prefill/decode disaggregation, admission control, SLO adaptation) is
+:mod:`mpi4jax_tpu.serving` — see docs/serving.md.
+
 A minimal serving harness over the world tier (docs/elasticity.md):
 rank 0 is the *frontend* — it owns the request queue and the generation
 state of every in-flight sequence — and every rank (frontend included)
@@ -119,6 +125,19 @@ def serve_worker(comm, decode_fn) -> None:
                 raise
             recover(comm)
             if comm.rank() == 0:
+                # Release the other survivors FIRST: they re-enter this
+                # loop blocked in a bcast rooted at the new rank 0 — if
+                # this promoted worker raised immediately, they would
+                # hang there until the transport deadline with no idea
+                # the frontend is gone.  Only after the survivors'
+                # collective state is consistent (they received STOP
+                # and returned) is the unrecoverable condition raised
+                # here.
+                try:
+                    _bcast(comm, np.array([_OP_STOP, 0, 0], np.int64))
+                except BaseException as stop_err:  # noqa: BLE001
+                    if not is_rank_failure(stop_err):
+                        raise
                 raise RuntimeError(
                     "this worker became the frontend after recovery — "
                     "frontend state (the request queue) lived on the "

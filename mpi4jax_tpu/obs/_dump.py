@@ -121,6 +121,8 @@ def load_events_meta(path: str):
                 evd["wire_bytes"] = int(args["wire_bytes"])
             if args.get("tier"):
                 evd["tier"] = args["tier"]  # hierarchical leg label
+            if args.get("phase"):
+                evd["phase"] = args["phase"]  # serving phase label
             events.append(evd)
         other = data.get("otherData") or {}
         gens = {int(g) for g in (other.get("generations") or {}).values()}

@@ -170,14 +170,19 @@ def reset() -> None:
 
 
 def record_span(name: str, t_unix: float, dur_s: float, *, peer=-1,
-                nbytes=0, tag=0, algo=None, tier=None) -> None:
+                nbytes=0, tag=0, algo=None, tier=None,
+                phase=None) -> None:
     """Ops-layer span hook (called by ``tracing.CallTrace`` only when
     :func:`enabled` — callers guard, so the disabled path never reaches
     here).  ``tier`` marks a per-leg event (e.g. the Pallas ICI intra
     leg's ``tier="ici"``) nested inside a whole-op record: stats then
     attributes the leg's bytes in ``tier_bytes`` while the tuner keeps
     ignoring tier-carrying events (``_usable_trace_event``), exactly as
-    it does for the native hierarchical leg events."""
+    it does for the native hierarchical leg events.  ``phase`` labels a
+    serving-plane span (``prefill`` / ``decode`` / ``kv_xfer``) so
+    stats and the load generator split percentiles per phase; absent
+    on every non-serving span, so pre-serving recordings stay
+    schema-identical."""
     if _state.spans is None:
         return
     ev = {
@@ -194,6 +199,8 @@ def record_span(name: str, t_unix: float, dur_s: float, *, peer=-1,
     }
     if tier:
         ev["tier"] = str(tier)
+    if phase:
+        ev["phase"] = str(phase)
     _state.spans.append(ev)
 
 

@@ -93,16 +93,18 @@ def summarize(events, dropped=None, rank=None) -> dict:
         # them would double-count every send/recv and dilute wait_frac.
         # tier is part of the key too: a hierarchical collective's
         # intra/inter leg events must not merge with (or into) the
-        # whole-op record.
+        # whole-op record.  phase likewise: a serving span labeled
+        # prefill must not pool its latencies with decode or kv_xfer
+        # ones — per-phase percentiles are the SLO loop's signal.
         key = (ev.get("name", "?"), ev.get("src", "?"),
                int(ev.get("peer", -1)), ev.get("algo") or "-",
-               ev.get("tier") or "-")
+               ev.get("tier") or "-", ev.get("phase") or "-")
         groups.setdefault(key, []).append(ev)
         if ev.get("tier"):
             tier_bytes[ev["tier"]] = (tier_bytes.get(ev["tier"], 0)
                                       + int(ev.get("bytes", 0)))
     rows = []
-    for (op, src, peer, algo, tier), evs in sorted(groups.items()):
+    for (op, src, peer, algo, tier, phase), evs in sorted(groups.items()):
         durs = [float(e.get("dur_us", 0.0)) for e in evs]
         waits = [float(e.get("wait_us", 0.0)) for e in evs]
         disps = [float(e.get("dispatch_us", 0.0)) for e in evs]
@@ -129,6 +131,10 @@ def summarize(events, dropped=None, rank=None) -> dict:
             # hierarchical per-leg row: name the transport tier it
             # moved on (exact rows stay schema-identical)
             row["tier"] = tier
+        if phase != "-":
+            # serving-plane row: prefill / decode / kv_xfer — present
+            # only on labeled spans, so non-serving stats are unchanged
+            row["phase"] = phase
         if wire_bytes != nbytes:
             # quantized wire formats: logical vs on-wire payload.  The
             # column appears only when it says something (exact rows
@@ -171,6 +177,10 @@ def render_table(stats: dict, *, by=("op", "algo")) -> str:
         # hierarchical per-leg rows present: show the transport tier
         # (flat rows render blank)
         cols = cols + ("tier",)
+    if any("phase" in r for r in rows):
+        # serving-plane rows present: show the phase split
+        # (non-serving rows render blank)
+        cols = cols + ("phase",)
     if any("compression" in r for r in rows):
         # quantized rows present: show the on-wire compression ratio
         # (exact rows render blank — their wire IS the logical payload)

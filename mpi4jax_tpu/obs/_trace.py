@@ -54,6 +54,8 @@ def rank_trace_events(events, rank: int):
             args["algo"] = ev["algo"]
         if ev.get("tier"):
             args["tier"] = ev["tier"]  # hierarchical leg: intra / inter
+        if ev.get("phase"):
+            args["phase"] = ev["phase"]  # serving: prefill/decode/kv_xfer
         if "syscalls" in ev:
             # transport syscalls of this op (uring-generation events):
             # the submit-batching win, visible per span in Perfetto

@@ -414,6 +414,118 @@ def check_elasticity(port):
                   "resume from committed checkpoint)")
 
 
+def check_serving(port):
+    """Serving v2 end to end on a loopback 3-rank job under forced
+    disaggregation (docs/serving.md): roles derive to frontend=r0 /
+    prefill=r1 / decode=r2, one request is prefilled on rank 1, its KV
+    shipped to rank 2 and decoded there, the KV wire bytes show up in
+    each worker's ``obs.stats()`` tier rows, and a second submit over
+    the queue cap is shed with a loud verdict instead of admitted."""
+    import tempfile
+
+    from ..utils import config
+
+    knobs = (f"roles={config.serve_roles()} "
+             f"max_batch={config.serve_max_batch()} "
+             f"queue_cap={config.serve_queue_cap()} "
+             f"slo_ms={config.serve_slo_ms():g}")
+    code = (
+        "import sys, types, os; sys.path.insert(0, %r)\n"
+        "pkg = types.ModuleType('mpi4jax_tpu')\n"
+        "pkg.__path__ = [os.path.join(%r, 'mpi4jax_tpu')]\n"
+        "sys.modules['mpi4jax_tpu'] = pkg\n"
+        "from mpi4jax_tpu import obs, serving\n"
+        "from mpi4jax_tpu.runtime import transport\n"
+        "comm = transport.get_world_comm()\n"
+        "_ = comm.handle\n"
+        "obs.start(rank=comm.rank(), size=comm.size())\n"
+        "adapter = serving.ToyAdapter()\n"
+        "if comm.rank() != 0:\n"
+        "    roles = serving.serve_worker(comm, adapter,\n"
+        "                                 roles_mode='disagg')\n"
+        "    st = obs.stats()\n"
+        "    kv = st.get('tier_bytes', {}).get('kv', 0)\n"
+        "    phases = sorted({r['phase'] for r in st['per_op']\n"
+        "                     if 'phase' in r})\n"
+        "    msg = ' '.join(['diag_serving worker', str(comm.rank()),\n"
+        "                    roles.role_of(comm.rank()), str(kv),\n"
+        "                    ','.join(phases)])\n"
+        "    sys.stdout.write(msg + chr(10)); sys.stdout.flush()\n"
+        "else:\n"
+        "    server = serving.Server(comm, adapter, max_batch=2,\n"
+        "                            chunk_tokens=4, queue_cap=1,\n"
+        "                            roles_mode='disagg')\n"
+        "    ok_v = server.submit([3, 1, 4, 1, 5], max_new=4)\n"
+        "    assert ok_v.admitted, ok_v.reason\n"
+        "    shed_v = server.submit([2, 7], max_new=4)\n"
+        "    assert not shed_v.admitted, 'over-cap submit was admitted'\n"
+        "    server.run_until_drained()\n"
+        "    server.stop()\n"
+        "    req = server.completed[0]\n"
+        "    msg = ' '.join(['diag_serving frontend', server.roles.mode,\n"
+        "                    str(len(server.completed)),\n"
+        "                    str(len(req.generated)),\n"
+        "                    str(server.admission.shed), shed_v.reason])\n"
+        "    sys.stdout.write(msg + chr(10)); sys.stdout.flush()\n"
+        % (REPO, REPO)
+    )
+    with tempfile.TemporaryDirectory(prefix="m4j_diag_serving_") as td:
+        prog = os.path.join(td, "prog.py")
+        with open(prog, "w") as f:
+            f.write(code)
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "MPI4JAX_TPU_DISABLE_SHM": "1",
+            "MPI4JAX_TPU_TIMEOUT_S": "8",
+        }
+        t0 = time.perf_counter()
+        # launcher as a FILE (not -m) for the same reason as
+        # check_elasticity: the rank program's parent-package shim must
+        # survive environments where the package's jax gate blocks
+        # imports
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "mpi4jax_tpu", "runtime", "launch.py"),
+             "-n", "3", "--port", str(port), prog],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+        )
+    dt = time.perf_counter() - t0
+    import re
+
+    fe = re.search(r"diag_serving frontend (\S+) (\d+) (\d+) (\d+) (.+)",
+                   res.stdout)
+    workers = {
+        int(m.group(1)): (m.group(2), int(m.group(3)), m.group(4))
+        for m in re.finditer(
+            r"diag_serving worker (\d+) (\S+) (\d+) (\S*)", res.stdout)
+    }
+    ok = (
+        res.returncode == 0
+        and fe is not None
+        and fe.group(1) == "disagg"
+        and int(fe.group(2)) == 1          # the admitted request drained
+        and int(fe.group(3)) == 4          # all 4 tokens generated
+        and int(fe.group(4)) >= 1          # the over-cap submit was shed
+        and "capacity" in fe.group(5)
+        and "SHED" in res.stderr           # ... loudly
+        and workers.get(1, ("", 0, ""))[0] == "prefill"
+        and workers.get(2, ("", 0, ""))[0] == "decode"
+        and workers[1][1] > 0 and workers[2][1] > 0  # KV bytes in stats
+        and "prefill" in workers[1][2]
+        and "kv_xfer" in workers[1][2]
+        and "decode" in workers[2][2]
+        and "kv_xfer" in workers[2][2]
+    )
+    if not ok:
+        tail = (res.stderr.strip() or res.stdout.strip())[-220:]
+        return False, f"{knobs}; serving run failed: {tail}"
+    return True, (f"{knobs}; np=3 disagg roles prefill=r1 decode=r2, "
+                  f"request prefilled r1 -> KV {workers[1][1]} B shipped "
+                  f"-> decoded r2, kv tier bytes in both workers' stats, "
+                  f"over-cap submit shed loudly in {dt:.1f}s")
+
+
 def check_topology(port):
     """The topology subsystem end to end on a loopback 4-rank job
     virtually partitioned into two islands (MPI4JAX_TPU_FAKE_HOSTS):
@@ -752,6 +864,7 @@ def main(argv=None):
         ("failure_detection",
          lambda: check_failure_detection(args.port + 7)),
         ("elasticity", lambda: check_elasticity(args.port + 29)),
+        ("serving", lambda: check_serving(args.port + 43)),
     ]
     if args.device:
         checks += [

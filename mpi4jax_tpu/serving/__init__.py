@@ -1,0 +1,59 @@
+"""Serving v2: the disaggregated prefill/decode inference plane.
+
+The toy plane (``mpi4jax_tpu.elastic.serving``) proved the elastic
+story — requests survive rank death — but re-decodes every full
+sequence every iteration, runs both phases undifferentiated on every
+rank, and admits unboundedly.  This package is the real subsystem
+(docs/serving.md):
+
+- **KV-cache-backed decode** — the ``decode_fn`` contract is replaced
+  by a model adapter (:class:`ModelAdapter`: ``prefill`` /
+  ``decode_step``) over paged, rank-local KV blocks
+  (:class:`KVCache`), turning per-token work from O(sequence) model
+  passes into one cached step;
+- **prefill/decode disaggregation** (:func:`assign_roles`) mapped onto
+  the discovered topology: prefill ranks chew prompt chunks in the
+  frontend's island and ship finished KV to decode ranks across the
+  leader tier (exact wire by default, int8 codec under
+  ``MPI4JAX_TPU_COLL_QUANT=force``), with roles re-derived from the
+  recovered topology after an elastic shrink;
+- **admission control + SLO feedback** (:class:`Admission`,
+  :class:`SLOController`): a bounded queue with loud per-request shed
+  verdicts, token-budgeted chunked prefill, and a rolling-window p99
+  loop over the ``phase=decode`` spans that adapts max-batch/chunk
+  size against ``MPI4JAX_TPU_SERVE_SLO_MS``.
+
+Numpy-only at import time (the world tier's portability contract);
+the jitted GPT adapter imports jax lazily.
+"""
+
+from ._adapter import (  # noqa: F401
+    JaxGPTAdapter,
+    ModelAdapter,
+    NumpyGPTAdapter,
+    ToyAdapter,
+    make_jax_gpt_adapter,
+    make_numpy_gpt_adapter,
+)
+from ._engine import Request, Server, serve_worker  # noqa: F401
+from ._kv import KVCache  # noqa: F401
+from ._roles import RolePlan, assign_roles  # noqa: F401
+from ._scheduler import Admission, SLOController, Verdict  # noqa: F401
+
+__all__ = [
+    "Admission",
+    "JaxGPTAdapter",
+    "KVCache",
+    "ModelAdapter",
+    "NumpyGPTAdapter",
+    "Request",
+    "RolePlan",
+    "SLOController",
+    "Server",
+    "ToyAdapter",
+    "Verdict",
+    "assign_roles",
+    "make_jax_gpt_adapter",
+    "make_numpy_gpt_adapter",
+    "serve_worker",
+]

@@ -1,0 +1,276 @@
+"""The model adapter contract: what the serving engine asks of a model.
+
+The toy plane (``elastic/serving.py``) takes a ``decode_fn`` over the
+*whole* padded token matrix and recomputes every position every
+iteration — O(S) model work per generated token.  The v2 contract
+splits the two phases the KV cache separates:
+
+- ``prefill(toks, past=None) -> (entries, logits)`` — consume a chunk
+  of tokens given an existing cache, returning one cache *entry* per
+  consumed token plus the logits predicting the next token;
+- ``decode_step(past, last_tok) -> (entry, logits)`` — consume exactly
+  one token against the cache: semantically ``prefill([last_tok],
+  past)``, but O(cache-lookup) instead of O(sequence) in model work.
+
+The cache *entry* layout is adapter-declared (``kv_entry_shape`` /
+``kv_dtype``); the engine never looks inside one — it pages them
+(``_kv.KVCache``), ships them between ranks (the KV wire), and hands
+the contiguous ``(ntok, *entry_shape)`` view back to the adapter.
+
+Determinism contract: an adapter must be a pure function of the token
+prefix — same tokens, same entries, same logits, on every rank.  The
+engine greedy-decodes (``argmax``), so disaggregated and colocated
+placements produce identical transcripts.  :class:`ToyAdapter` is
+additionally *exactly* prefix-consistent (integer state): re-prefilling
+a prefix reproduces the incremental cache bit-for-bit, which is what
+the elastic retry path relies on in tests.  The GPT adapters are
+prefix-consistent up to float associativity (chunk boundaries change
+gemm shapes), so fault-retry tests pin the toy adapter.
+
+Numpy-only at import time; :func:`make_jax_gpt_adapter` imports jax
+lazily so CPU containers without a usable jax still serve end-to-end
+through :class:`NumpyGPTAdapter`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class ModelAdapter:
+    """Base contract (see module docstring).  Subclasses set ``vocab``,
+    ``kv_entry_shape``, ``kv_dtype``, ``max_seq`` and implement
+    :meth:`prefill`; :meth:`decode_step` has a correct (if slow)
+    default."""
+
+    vocab: int = 0
+    kv_entry_shape: Tuple[int, ...] = ()
+    kv_dtype = np.float32
+    max_seq: int = 1 << 30
+
+    def prefill(self, toks: np.ndarray,
+                past: Optional[np.ndarray] = None):
+        raise NotImplementedError
+
+    def decode_step(self, past: np.ndarray, last_tok: int):
+        entries, logits = self.prefill(
+            np.asarray([int(last_tok)], np.int32), past)
+        return entries[0], logits
+
+
+class ToyAdapter(ModelAdapter):
+    """The toy plane's hash model, restated with a KV cache: the next
+    token is ``(sum(tokens)*31 + len*7 + last) % 997``, and the cache
+    entry for position i is the running sum over ``tokens[:i+1]`` —
+    integer state, so incremental decode, chunked prefill, and a full
+    re-prefill all agree bit-for-bit.  ``decode_step`` is O(1) where
+    the toy ``decode_fn`` re-sums the whole row."""
+
+    vocab = 997
+    kv_entry_shape = (1,)
+    kv_dtype = np.int64
+
+    def prefill(self, toks, past=None):
+        toks = np.asarray(toks, np.int64).reshape(-1)
+        prev_sum = int(past[-1, 0]) if past is not None and len(past) else 0
+        prev_len = len(past) if past is not None else 0
+        cums = prev_sum + np.cumsum(toks)
+        entries = cums[:, None].astype(np.int64)
+        n = prev_len + len(toks)
+        nxt = int((int(cums[-1]) * 31 + n * 7 + int(toks[-1])) % self.vocab)
+        logits = np.zeros(self.vocab, np.float32)
+        logits[nxt] = 1.0
+        return entries, logits
+
+    def decode_step(self, past, last_tok):
+        s = (int(past[-1, 0]) if len(past) else 0) + int(last_tok)
+        n = len(past) + 1
+        nxt = int((s * 31 + n * 7 + int(last_tok)) % self.vocab)
+        logits = np.zeros(self.vocab, np.float32)
+        logits[nxt] = 1.0
+        return np.array([s], np.int64), logits
+
+
+def _ln(x, g):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mu) / np.sqrt(var + 1e-5) * g
+
+
+class NumpyGPTAdapter(ModelAdapter):
+    """KV-cached pure-numpy twin of the tiny pre-LN GPT in
+    ``benchmarks/quant_accuracy.py`` (``gpt2_init`` params verbatim).
+    One cache entry per token: ``(n_layer, 2, n_head, d_head)`` float32
+    — the per-layer K and V rows — which is the quant-eligible KV wire
+    format (f32, int8-packable by the PR 8 codec)."""
+
+    def __init__(self, params, *, n_layer: int, n_head: int):
+        self.params = params
+        self.n_layer = int(n_layer)
+        self.n_head = int(n_head)
+        self.vocab, self.d_model = params["wte"].shape
+        self.max_seq = params["wpe"].shape[0]
+        self.d_head = self.d_model // self.n_head
+        self.kv_entry_shape = (self.n_layer, 2, self.n_head, self.d_head)
+        self.kv_dtype = np.float32
+
+    def _heads(self, t):
+        # (T, d_model) -> (n_head, T, d_head)
+        T = t.shape[0]
+        return t.reshape(T, self.n_head, self.d_head).transpose(1, 0, 2)
+
+    def prefill(self, toks, past=None):
+        p = self.params
+        toks = np.asarray(toks, np.int64).reshape(-1)
+        P = len(past) if past is not None else 0
+        T = len(toks)
+        if P + T > self.max_seq:
+            raise ValueError(
+                f"sequence {P + T} exceeds the model's max_seq "
+                f"{self.max_seq}")
+        x = p["wte"][toks] + p["wpe"][P:P + T]
+        entries = np.zeros((T,) + self.kv_entry_shape, np.float32)
+        for i in range(self.n_layer):
+            h = p[f"h{i}"]
+            a_in = _ln(x, h["ln1"])
+            qkv = a_in @ h["attn_qkv"]
+            q, k, v = np.split(qkv, 3, axis=-1)
+            entries[:, i, 0] = k.reshape(T, self.n_head, self.d_head)
+            entries[:, i, 1] = v.reshape(T, self.n_head, self.d_head)
+            if P:
+                k_all = np.concatenate(
+                    [past[:, i, 0].reshape(P, -1), k])  # (P+T, d_model)
+                v_all = np.concatenate(
+                    [past[:, i, 1].reshape(P, -1), v])
+            else:
+                k_all, v_all = k, v
+            qh = self._heads(q)                      # (nh, T, dh)
+            kh = self._heads(k_all)                  # (nh, P+T, dh)
+            vh = self._heads(v_all)
+            att = (qh @ kh.transpose(0, 2, 1)) / np.sqrt(self.d_head)
+            # causal: query at absolute position P+r sees keys <= P+r
+            key_pos = np.arange(P + T)
+            q_pos = P + np.arange(T)
+            mask = key_pos[None, :] <= q_pos[:, None]  # (T, P+T)
+            att = np.where(mask[None], att, -1e9)
+            att = np.exp(att - att.max(-1, keepdims=True))
+            att = att / att.sum(-1, keepdims=True)
+            out = (att @ vh).transpose(1, 0, 2).reshape(T, -1)
+            x = x + out @ h["attn_out"]
+            m_in = _ln(x, h["ln2"])
+            m = np.maximum(m_in @ h["mlp_in"], 0.0)
+            x = x + m @ h["mlp_out"]
+        x_last = _ln(x[-1], p["ln_f"])
+        logits = x_last @ p["wte"].T
+        return entries, logits.astype(np.float32)
+
+
+def make_numpy_gpt_adapter(*, seed: int = 0, vocab: int = 64,
+                           d_model: int = 32, n_layer: int = 2,
+                           n_head: int = 4,
+                           max_seq: int = 576) -> NumpyGPTAdapter:
+    """A :class:`NumpyGPTAdapter` over a deterministically-seeded small
+    model — the same ``gpt2_init`` parameter recipe the training and
+    quant-accuracy benchmarks use, so tooling everywhere speaks one
+    model family."""
+    rng = np.random.RandomState(seed)
+
+    def norm(*shape, scale=0.02):
+        return (rng.randn(*shape) * scale).astype(np.float32)
+
+    params = {
+        "wte": norm(vocab, d_model),
+        "wpe": norm(max_seq, d_model),
+        "ln_f": np.ones(d_model, np.float32),
+    }
+    for i in range(n_layer):
+        params[f"h{i}"] = {
+            "ln1": np.ones(d_model, np.float32),
+            "attn_qkv": norm(d_model, 3 * d_model),
+            "attn_out": norm(d_model, d_model),
+            "ln2": np.ones(d_model, np.float32),
+            "mlp_in": norm(d_model, 4 * d_model),
+            "mlp_out": norm(4 * d_model, d_model),
+        }
+    return NumpyGPTAdapter(params, n_layer=n_layer, n_head=n_head)
+
+
+class JaxGPTAdapter(NumpyGPTAdapter):
+    """The jitted tier of the same model: prefill stays numpy (one
+    pass per prompt), the per-token ``decode_step`` runs a jitted
+    fixed-shape kernel over the padded cache — the shape never changes
+    across tokens, so jax traces exactly once.  Import of jax is
+    deferred to construction; on containers without jax the numpy
+    adapter serves the identical model."""
+
+    def __init__(self, params, *, n_layer: int, n_head: int):
+        super().__init__(params, n_layer=n_layer, n_head=n_head)
+        import jax
+        import jax.numpy as jnp
+
+        S = self.max_seq
+
+        def step(wte, wpe, layer_stack, ln_f, past, length, tok):
+            # past: (S, n_layer, 2, n_head, d_head) zero-padded;
+            # length: live entries; tok: the one token to consume
+            def ln(x, g):
+                mu = jnp.mean(x, -1, keepdims=True)
+                var = jnp.var(x, -1, keepdims=True)
+                return (x - mu) / jnp.sqrt(var + 1e-5) * g
+
+            x = wte[tok] + wpe[length]
+            new_entry = jnp.zeros(self.kv_entry_shape, jnp.float32)
+            for i in range(self.n_layer):
+                h = {k: layer_stack[k][i] for k in layer_stack}
+                a_in = ln(x, h["ln1"])
+                qkv = a_in @ h["attn_qkv"]
+                q, k, v = jnp.split(qkv, 3)
+                kh = k.reshape(self.n_head, self.d_head)
+                vh = v.reshape(self.n_head, self.d_head)
+                new_entry = new_entry.at[i, 0].set(kh)
+                new_entry = new_entry.at[i, 1].set(vh)
+                k_all = past[:, i, 0].at[length].set(kh)  # (S, nh, dh)
+                v_all = past[:, i, 1].at[length].set(vh)
+                qh = q.reshape(self.n_head, 1, self.d_head)
+                att = (qh @ k_all.transpose(1, 2, 0)) / np.sqrt(self.d_head)
+                live = jnp.arange(S) <= length
+                att = jnp.where(live[None, None, :], att, -1e9)
+                att = jnp.exp(att - jnp.max(att, -1, keepdims=True))
+                att = att / jnp.sum(att, -1, keepdims=True)
+                out = (att @ v_all.transpose(1, 0, 2)).reshape(-1)
+                x = x + out @ h["attn_out"]
+                m_in = ln(x, h["ln2"])
+                m = jnp.maximum(m_in @ h["mlp_in"], 0.0)
+                x = x + m @ h["mlp_out"]
+            logits = ln(x, ln_f) @ wte.T
+            return new_entry, logits
+
+        self._layer_stack = {
+            k: np.stack([params[f"h{i}"][k] for i in range(self.n_layer)])
+            for k in params["h0"]}
+        self._step = jax.jit(step)
+        self._np = np
+
+    def decode_step(self, past, last_tok):
+        S = self.max_seq
+        padded = self._np.zeros((S,) + self.kv_entry_shape,
+                                self._np.float32)
+        if len(past):
+            padded[:len(past)] = past
+        entry, logits = self._step(
+            self.params["wte"], self.params["wpe"], self._layer_stack,
+            self.params["ln_f"], padded, len(past), int(last_tok))
+        return (self._np.asarray(entry, self._np.float32),
+                self._np.asarray(logits, self._np.float32))
+
+
+def make_jax_gpt_adapter(**kw) -> "JaxGPTAdapter":
+    """Jitted variant of :func:`make_numpy_gpt_adapter` (same seeded
+    params, same transcripts up to float associativity).  Raises
+    ImportError where jax is unavailable — callers fall back to the
+    numpy adapter."""
+    ref = make_numpy_gpt_adapter(**kw)
+    return JaxGPTAdapter(ref.params, n_layer=ref.n_layer,
+                         n_head=ref.n_head)

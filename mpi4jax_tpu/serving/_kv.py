@@ -1,0 +1,122 @@
+"""Paged per-request KV blocks, rank-local.
+
+The decode engine's cache: per request, a list of fixed-size *pages*,
+each holding ``page`` per-token cache entries of the model adapter's
+declared entry shape/dtype.  Pages make append O(1) without repeated
+whole-cache reallocation, keep memory proportional to live tokens
+(rounded up to one page), and free in O(pages) when a request retires.
+
+The cache is deliberately a dumb store: it knows nothing about
+transformers.  An *entry* is whatever the adapter says one token's
+cache state is — ``(n_layer, 2, n_head, d_head)`` float32 for the GPT
+adapters, ``(1,)`` int64 running state for the toy adapter — so the
+same pager backs both, and the KV wire format (``_engine.py``) is just
+``view()``'s contiguous ``(ntok, *entry_shape)`` array.
+
+Everything here is numpy-only: the cache lives on whatever rank runs
+the adapter, never inside jax tracing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class KVCache:
+    """Rank-local paged cache: request id -> growing token-entry log."""
+
+    def __init__(self, entry_shape: Tuple[int, ...], dtype,
+                 page: int = 64):
+        self.entry_shape = tuple(int(d) for d in entry_shape)
+        self.dtype = np.dtype(dtype)
+        self.page = max(int(page), 1)
+        self._pages: Dict[int, List[np.ndarray]] = {}
+        self._len: Dict[int, int] = {}
+        self.pages_allocated = 0  # lifetime counter (stats/tests)
+
+    def __contains__(self, req_id) -> bool:
+        return int(req_id) in self._pages
+
+    def length(self, req_id) -> int:
+        """Tokens cached for ``req_id`` (0 when unknown)."""
+        return self._len.get(int(req_id), 0)
+
+    def entry_nbytes(self) -> int:
+        n = self.dtype.itemsize
+        for d in self.entry_shape:
+            n *= d
+        return n
+
+    def nbytes(self, req_id) -> int:
+        """Logical cache bytes held for ``req_id`` (live entries, not
+        page padding — the number the KV wire actually moves)."""
+        return self.length(req_id) * self.entry_nbytes()
+
+    def append(self, req_id, entries: np.ndarray) -> None:
+        """Append one or more per-token entries.  ``entries`` is either
+        a single entry (``entry_shape``) or a batch
+        (``(n, *entry_shape)``)."""
+        req_id = int(req_id)
+        entries = np.asarray(entries, self.dtype)
+        if entries.shape == self.entry_shape:
+            entries = entries[None]
+        if entries.shape[1:] != self.entry_shape:
+            raise ValueError(
+                f"entry shape {entries.shape[1:]} != declared "
+                f"{self.entry_shape}")
+        pages = self._pages.setdefault(req_id, [])
+        n = self._len.get(req_id, 0)
+        for entry in entries:
+            slot = n % self.page
+            if slot == 0:
+                pages.append(np.zeros((self.page,) + self.entry_shape,
+                                      self.dtype))
+                self.pages_allocated += 1
+            pages[-1][slot] = entry
+            n += 1
+        self._len[req_id] = n
+
+    def view(self, req_id) -> np.ndarray:
+        """Contiguous ``(ntok, *entry_shape)`` copy of the live entries
+        (the adapter-facing and wire-facing form)."""
+        req_id = int(req_id)
+        n = self._len.get(req_id, 0)
+        out = np.zeros((n,) + self.entry_shape, self.dtype)
+        for i, pg in enumerate(self._pages.get(req_id, ())):
+            lo = i * self.page
+            take = min(self.page, n - lo)
+            if take <= 0:
+                break
+            out[lo:lo + take] = pg[:take]
+        return out
+
+    def load(self, req_id, entries: np.ndarray) -> None:
+        """Replace ``req_id``'s cache with ``entries`` (the receive side
+        of a KV transfer)."""
+        self.free(req_id)
+        if len(entries):
+            self.append(req_id, np.asarray(entries, self.dtype))
+        else:
+            self._pages[int(req_id)] = []
+            self._len[int(req_id)] = 0
+
+    def free(self, req_id) -> None:
+        self._pages.pop(int(req_id), None)
+        self._len.pop(int(req_id), None)
+
+    def drop_all(self) -> None:
+        """Forget everything — the elastic-recovery reset: cached state
+        is a pure function of each request's token prefix, so dropping
+        it is always safe (the engine re-prefills)."""
+        self._pages.clear()
+        self._len.clear()
+
+    @property
+    def live_requests(self) -> int:
+        return len(self._pages)
+
+    @property
+    def live_pages(self) -> int:
+        return sum(len(p) for p in self._pages.values())

@@ -1,0 +1,109 @@
+"""Prefill/decode role assignment over the discovered topology.
+
+Disaggregation (the DistServe/Splitwise serving pattern) puts the two
+phases on different ranks: prefill is compute-bound bursts, decode is
+latency-bound steady state, and colocating them makes every prompt
+burst a decode-latency spike.  Here the split maps onto the PR 10
+island map: prefill ranks live in the *frontend's* island (the prompt
+feed is frontend -> prefill, cheap intra-island), decode ranks live in
+the *other* islands, and the finished-KV transfer rides the leader
+tier between them (eligible for the ICI leg / int8 wire like any other
+inter-island traffic).
+
+The assignment is a pure function of (world size, island map, mode) —
+every rank derives the SAME plan from the same broadcast-free inputs,
+and an elastic shrink just re-derives it from the recovered topology
+(falling back to colocated when the survivors cannot hold both roles).
+
+Mode comes from ``MPI4JAX_TPU_SERVE_ROLES`` (``config.serve_roles()``):
+``auto`` disaggregates when the topology is multi-island with >= 3
+ranks, ``colocated``/``disagg`` force either way (``disagg`` on a
+world too small to hold a frontend plus both roles raises — silently
+colocating under a forced split would invalidate what a test thinks
+it measured).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..utils import config
+
+
+class RolePlan:
+    """The derived placement: who prefills, who decodes, who fronts.
+
+    ``mode`` is the *resolved* mode ("colocated" | "disagg"), never
+    "auto".  In colocated mode every rank carries both role lists and
+    each request's prefill rank IS its decode rank."""
+
+    def __init__(self, size: int, mode: str, prefill_ranks: List[int],
+                 decode_ranks: List[int]):
+        self.size = int(size)
+        self.frontend = 0
+        self.mode = mode
+        self.prefill_ranks = list(prefill_ranks)
+        self.decode_ranks = list(decode_ranks)
+
+    def placement(self, seq: int):
+        """(prefill_rank, decode_rank) for the ``seq``-th admitted
+        request — a deterministic round-robin, so the frontend's plan
+        and any replay of it agree."""
+        d = self.decode_ranks[seq % len(self.decode_ranks)]
+        if self.mode == "colocated":
+            return d, d
+        p = self.prefill_ranks[seq % len(self.prefill_ranks)]
+        return p, d
+
+    def role_of(self, rank: int) -> str:
+        parts = []
+        if rank == self.frontend:
+            parts.append("frontend")
+        if rank in self.prefill_ranks:
+            parts.append("prefill")
+        if rank in self.decode_ranks:
+            parts.append("decode")
+        return "+".join(parts) or "idle"
+
+    def describe(self) -> str:
+        return (f"serve roles mode={self.mode} frontend={self.frontend} "
+                f"prefill={self.prefill_ranks} decode={self.decode_ranks}")
+
+
+def _disagg_split(size: int, topology) -> Optional[RolePlan]:
+    """The disaggregated split, or None when this world cannot hold
+    one (needs the frontend plus >= 1 prefill and >= 1 decode rank)."""
+    workers = list(range(1, size))
+    if len(workers) < 2:
+        return None
+    if topology is not None and getattr(topology, "multi", False):
+        home = topology.island_of[0]
+        prefill = [r for r in workers if topology.island_of[r] == home]
+        decode = [r for r in workers if topology.island_of[r] != home]
+        if prefill and decode:
+            return RolePlan(size, "disagg", prefill, decode)
+        # frontend's island holds everyone (or no one): positional split
+    half = max(1, len(workers) // 2)
+    return RolePlan(size, "disagg", workers[:half], workers[half:])
+
+
+def assign_roles(size: int, topology=None, *,
+                 mode: Optional[str] = None) -> RolePlan:
+    """Derive the role plan for a ``size``-rank world with an optional
+    discovered :class:`~mpi4jax_tpu.topo.Topology` (see module
+    docstring for the mode semantics)."""
+    mode = mode or config.serve_roles()
+    if mode == "colocated" or (mode == "auto" and (
+            size < 3 or topology is None
+            or not getattr(topology, "multi", False))):
+        ranks = list(range(size))
+        return RolePlan(size, "colocated", ranks, ranks)
+    plan = _disagg_split(size, topology)
+    if plan is None:
+        if mode == "disagg":
+            raise ValueError(
+                f"MPI4JAX_TPU_SERVE_ROLES=disagg needs >= 3 ranks "
+                f"(frontend + prefill + decode), got {size}")
+        ranks = list(range(size))
+        return RolePlan(size, "colocated", ranks, ranks)
+    return plan

@@ -1,0 +1,149 @@
+"""Admission control and the SLO feedback loop.
+
+Three pure-Python pieces, each unit-testable without ranks:
+
+- :class:`Admission` — a bounded queue.  ``offer()`` returns a loud
+  per-request :class:`Verdict`: ``admitted`` or ``shed`` with the
+  reason (queue at ``MPI4JAX_TPU_SERVE_QUEUE_CAP``, prompt longer than
+  the model's context).  Shedding at submit time is the overload
+  contract: a client learns *immediately* instead of its request aging
+  out inside an unbounded queue.
+
+- token-budgeted batch building — each iteration admits prefill work
+  up to a token budget (``chunk_tokens``) so one giant prompt cannot
+  starve decode latency: prompts are chewed in chunks across
+  iterations (chunked prefill), while every active request always
+  decodes its one token per iteration.
+
+- :class:`SLOController` — the feedback loop.  A rolling window of
+  per-iteration decode-phase durations (the same numbers the obs
+  ``phase=decode`` spans record) is compared against
+  ``MPI4JAX_TPU_SERVE_SLO_MS`` (p99 over the window): overshooting
+  halves the live max-batch (floor 1) and can request an algorithm
+  re-tune; comfortably-under (< half the SLO) regrows toward — never
+  beyond — the configured starting point.  A quiescent run therefore
+  makes ZERO adaptations (test-pinned): the live value starts at the
+  knob and nothing pushes it away.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+from ..obs import _stats
+from ..utils import config
+
+
+class Verdict:
+    """Per-request admission outcome — always returned, never thrown,
+    so callers log/count shed load instead of unwinding."""
+
+    def __init__(self, req_id, admitted: bool, reason: str):
+        self.req_id = req_id
+        self.admitted = admitted
+        self.reason = reason
+
+    def __repr__(self):
+        state = "admitted" if self.admitted else "SHED"
+        return f"<submit {self.req_id}: {state} ({self.reason})>"
+
+
+class Admission:
+    """Bounded admission: ``pending`` counts requests admitted but not
+    yet retired (queued + in flight) against the cap."""
+
+    def __init__(self, cap: Optional[int] = None,
+                 max_prompt: Optional[int] = None):
+        self.cap = int(cap) if cap is not None else config.serve_queue_cap()
+        self.max_prompt = max_prompt
+        self.pending = 0
+        self.shed = 0
+        self.admitted = 0
+
+    def offer(self, req_id, prompt_len: int) -> Verdict:
+        if self.max_prompt is not None and prompt_len > self.max_prompt:
+            self.shed += 1
+            return Verdict(req_id, False,
+                           f"prompt {prompt_len} exceeds model context "
+                           f"{self.max_prompt}")
+        if self.pending >= self.cap:
+            self.shed += 1
+            return Verdict(req_id, False,
+                           f"queue at capacity ({self.cap}); retry later")
+        self.pending += 1
+        self.admitted += 1
+        return Verdict(req_id, True, f"queued ({self.pending}/{self.cap})")
+
+    def retire(self, n: int = 1) -> None:
+        self.pending = max(0, self.pending - int(n))
+
+
+class SLOController:
+    """The decode-latency feedback loop (see module docstring).
+
+    ``observe(decode_ms)`` feeds one iteration's decode-phase duration;
+    the controller owns the live ``max_batch`` and ``chunk_tokens``
+    values the batch builder reads.  ``slo_ms <= 0`` disables the loop
+    (the knob default): observe() still counts, but never adapts.
+    """
+
+    #: window of iterations the p99 is computed over; also the
+    #: cool-down after an adaptation (the window refills before the
+    #: next verdict) — tests pin adaptation latency to <= 2*WINDOW
+    #: iterations
+    WINDOW = 16
+
+    def __init__(self, *, max_batch: Optional[int] = None,
+                 chunk_tokens: int = 512, slo_ms: Optional[float] = None):
+        self.initial_max_batch = (int(max_batch) if max_batch is not None
+                                  else config.serve_max_batch())
+        self.max_batch = self.initial_max_batch
+        self.chunk_tokens = int(chunk_tokens)
+        self.initial_chunk_tokens = self.chunk_tokens
+        self.slo_ms = (float(slo_ms) if slo_ms is not None
+                       else config.serve_slo_ms())
+        self.adaptations = 0
+        self.retune_requested = False
+        self.iterations = 0
+        self._window = collections.deque(maxlen=self.WINDOW)
+
+    def observe(self, decode_ms: float) -> Optional[str]:
+        """Feed one iteration; returns a human-readable adaptation
+        verdict when one fired, else None."""
+        self.iterations += 1
+        if self.slo_ms <= 0:
+            return None
+        self._window.append(float(decode_ms))
+        if len(self._window) < self.WINDOW:
+            return None
+        p99 = _stats.percentile(self._window, 99)
+        if p99 > self.slo_ms:
+            self._window.clear()
+            if self.max_batch > 1:
+                self.max_batch = max(1, self.max_batch // 2)
+                self.chunk_tokens = max(
+                    32, min(self.chunk_tokens,
+                            self.initial_chunk_tokens) // 2)
+                self.adaptations += 1
+                return (f"decode p99 {p99:.2f}ms > SLO {self.slo_ms}ms: "
+                        f"max_batch -> {self.max_batch}, chunk_tokens -> "
+                        f"{self.chunk_tokens}")
+            # already at the floor: batch size cannot help — ask the
+            # tuner layer for an algorithm re-tune instead
+            if not self.retune_requested:
+                self.retune_requested = True
+                self.adaptations += 1
+                return (f"decode p99 {p99:.2f}ms > SLO {self.slo_ms}ms at "
+                        "max_batch=1: requesting algorithm re-tune")
+            return None
+        if (p99 < self.slo_ms / 2
+                and self.max_batch < self.initial_max_batch):
+            self._window.clear()
+            self.max_batch = min(self.initial_max_batch, self.max_batch * 2)
+            self.chunk_tokens = min(self.initial_chunk_tokens,
+                                    self.chunk_tokens * 2)
+            self.adaptations += 1
+            return (f"decode p99 {p99:.2f}ms well under SLO "
+                    f"{self.slo_ms}ms: max_batch -> {self.max_batch}")
+        return None

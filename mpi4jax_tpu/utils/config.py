@@ -371,6 +371,39 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 so ``jvp``/``jacfwd`` through them raises —
                                 leave the flag off for forward-mode code.
 
+- ``MPI4JAX_TPU_SERVE_MAX_BATCH`` — serving plane: initial per-iteration
+                                decode batch ceiling (positive int,
+                                default 8).  The SLO feedback loop may
+                                move the live value below/above this
+                                within [1, 4x] — the knob sets the
+                                starting point, not a hard bound
+                                (serving/_scheduler.py).
+- ``MPI4JAX_TPU_SERVE_QUEUE_CAP`` — serving plane: bounded admission
+                                queue capacity (positive int, default
+                                256).  A submit over the cap is SHED
+                                with a loud per-request verdict rather
+                                than queued (serving/_scheduler.py).
+- ``MPI4JAX_TPU_SERVE_SLO_MS``  — serving plane: per-token decode p99
+                                SLO target in milliseconds (positive
+                                float; default 0 = SLO loop disabled).
+                                A rolling window over the per-phase
+                                obs percentiles shrinks max-batch when
+                                decode p99 overshoots and regrows it
+                                when comfortably under
+                                (serving/_scheduler.py).
+- ``MPI4JAX_TPU_SERVE_ROLES``  — serving plane: prefill/decode role
+                                assignment — ``auto`` (default:
+                                disaggregate when the topology has >= 2
+                                islands and enough ranks, else
+                                colocate), ``colocated`` (every rank
+                                both prefills and decodes), ``disagg``
+                                (force the split; raises on worlds too
+                                small to hold both roles).  Strict:
+                                anything else aborts loudly — ranks
+                                disagreeing on roles would exchange
+                                mismatched frames
+                                (serving/_roles.py).
+
 There is intentionally no token/notoken routing knob (the reference's
 ``MPI4JAX_PREFER_NOTOKEN``, utils.py:167-169 there): ordered effects ARE
 the core here, and reference-style explicit-token signatures live in
@@ -435,6 +468,10 @@ KNOBS = {
     "MPI4JAX_TPU_CKPT_DIR": "default sharded-checkpoint directory",
     "MPI4JAX_TPU_ANALYZE_TIMEOUT_S": "static verifier wall deadline",
     "MPI4JAX_TPU_NATIVE_LIB": "override path of the native transport .so",
+    "MPI4JAX_TPU_SERVE_MAX_BATCH": "serving: initial decode batch ceiling",
+    "MPI4JAX_TPU_SERVE_QUEUE_CAP": "serving: bounded admission queue size",
+    "MPI4JAX_TPU_SERVE_SLO_MS": "serving: decode p99 SLO target (ms)",
+    "MPI4JAX_TPU_SERVE_ROLES": "serving: auto / colocated / disagg",
 }
 
 _TRUTHY = frozenset(("1", "true", "on", "yes", "y"))
@@ -775,3 +812,67 @@ def plan_bucket_bytes() -> int:
         raise ValueError(
             f"cannot parse MPI4JAX_TPU_PLAN_BUCKET_KB={raw!r} as KB")
     return max(0, v) * 1024
+
+
+def _positive_int_knob(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or not raw.strip():
+        return default
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"cannot parse {name}={raw!r} as an integer")
+    if v <= 0:
+        raise ValueError(f"{name}={raw!r} must be a positive integer")
+    return v
+
+
+def serve_max_batch() -> int:
+    """``MPI4JAX_TPU_SERVE_MAX_BATCH``: the serving scheduler's initial
+    per-iteration decode batch ceiling (strict positive int, default 8).
+    The SLO loop adjusts the live value from this starting point."""
+    return _positive_int_knob("MPI4JAX_TPU_SERVE_MAX_BATCH", 8)
+
+
+def serve_queue_cap() -> int:
+    """``MPI4JAX_TPU_SERVE_QUEUE_CAP``: bounded admission-queue capacity
+    (strict positive int, default 256).  Submits over the cap are shed
+    with a loud verdict, never silently queued."""
+    return _positive_int_knob("MPI4JAX_TPU_SERVE_QUEUE_CAP", 256)
+
+
+def serve_slo_ms() -> float:
+    """``MPI4JAX_TPU_SERVE_SLO_MS``: per-token decode p99 target in
+    milliseconds for the serving SLO feedback loop.  Strict: a
+    non-numeric or negative value aborts loudly (a typo'd SLO silently
+    disabling adaptation would defeat the loop); 0 / unset = loop
+    disabled."""
+    raw = os.environ.get("MPI4JAX_TPU_SERVE_SLO_MS")
+    if raw is None or not raw.strip():
+        return 0.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse MPI4JAX_TPU_SERVE_SLO_MS={raw!r} as ms")
+    if v < 0:
+        raise ValueError(
+            f"MPI4JAX_TPU_SERVE_SLO_MS={raw!r} must be >= 0")
+    return v
+
+
+def serve_roles() -> str:
+    """``MPI4JAX_TPU_SERVE_ROLES`` as "auto" | "colocated" | "disagg" —
+    the serving plane's prefill/decode role-assignment mode.  Strict
+    like the other cross-rank gates: ranks disagreeing on roles would
+    exchange mismatched frames, so a typo aborts loudly instead of
+    silently colocating."""
+    raw = os.environ.get("MPI4JAX_TPU_SERVE_ROLES")
+    if raw is None or not raw.strip():
+        return "auto"
+    v = raw.strip()
+    if v in ("auto", "colocated", "disagg"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_SERVE_ROLES={raw!r} "
+        "(expected auto, colocated, or disagg)")

@@ -23,7 +23,7 @@ def test_diag_cpu_checks():
     assert names == {"native_build", "ffi_fast_path", "coll_algo_engine",
                      "observability", "static_verify", "schedule_plan",
                      "topology", "transport_loopback", "failure_detection",
-                     "elasticity"}
+                     "elasticity", "serving"}
     # the topology probe renders the island map and the live pick
     topo_check = next(r for r in data["results"] if r["check"] == "topology")
     assert "island0[" in topo_check["detail"]
@@ -52,3 +52,10 @@ def test_diag_cpu_checks():
     ob = next(r for r in data["results"] if r["check"] == "observability")
     assert "events recorded" in ob["detail"]
     assert "trace validates" in ob["detail"]
+    # the serving probe proves the disaggregated path (prefill on r1,
+    # KV shipped, decode on r2) with the KV bytes visible in stats and
+    # an over-cap submit shed instead of admitted
+    sv2 = next(r for r in data["results"] if r["check"] == "serving")
+    assert "prefill=r1 decode=r2" in sv2["detail"]
+    assert "kv tier bytes" in sv2["detail"]
+    assert "shed" in sv2["detail"]

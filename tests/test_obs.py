@@ -199,6 +199,42 @@ def test_chrome_trace_export_and_validation():
                           "ts": 1.0, "dur": -4.0}]})
 
 
+def test_serving_phase_label_roundtrips_chrome_to_canonical(tmp_path):
+    """The serving plane's ``phase`` field (prefill/decode/kv_xfer) is
+    additive: labeled spans carry it through stats grouping, the Chrome
+    export, and the chrome->canonical loader; unlabeled events keep the
+    exact pre-serving schema (no phase key anywhere)."""
+    labeled = _ev("serve.decode", 100, dur_us=40, wait_us=0, nbytes=256,
+                  src="ops")
+    labeled["phase"] = "decode"
+    plain = _ev("Allreduce", 200, dur_us=50, wait_us=10, nbytes=4096,
+                algo="rd")
+    # stats: phase splits the group key and lands on the row — only
+    # for labeled spans
+    two_phases = dict(labeled, phase="prefill")
+    stats = obs.summarize([labeled, plain, two_phases])
+    rows = {r.get("phase", "-"): r for r in stats["per_op"]
+            if r["op"] == "serve.decode"}
+    assert set(rows) == {"decode", "prefill"}
+    flat = next(r for r in stats["per_op"] if r["op"] == "Allreduce")
+    assert "phase" not in flat
+    # chrome export carries it in args; the loader restores it
+    trace = obs.merge_parts([{"rank": 0, "size": 1,
+                              "events": [labeled, plain]}])
+    assert obs.validate_chrome_trace(trace) == []
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps(trace))
+    events, _ = obs.load_events(str(path))
+    by_name = {e["name"]: e for e in events}
+    assert by_name["serve.decode"]["phase"] == "decode"
+    assert "phase" not in by_name["Allreduce"]
+    # part-file round trip preserves it too (parts store canonical form)
+    base = str(tmp_path / "part.json")
+    obs.write_part(base, rank=0, size=1, events=[labeled])
+    loaded, _ = obs.load_events(obs.part_paths(base)[0])
+    assert loaded[0]["phase"] == "decode"
+
+
 # ---------------- dump files + profile CLI ----------------
 
 

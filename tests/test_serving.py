@@ -1,0 +1,349 @@
+"""Serving v2 unit surface (mpi4jax_tpu/serving): the paged KV cache,
+the model-adapter contract (prefix consistency, chunked prefill,
+incremental decode), role assignment over topologies, admission
+control, the SLO feedback loop's pinned adaptation latency, and the
+strict SERVE_* knob parsers.
+
+No ranks, no sockets — everything here is the pure-Python half the
+world tests (tests/world/test_elastic.py) and the serving diag check
+compose into the distributed story.  Where the real package is gated
+(old-jax containers) it loads under an ALIAS package name, like
+test_schedule_plan.py does — installing the real name in sys.modules
+would leak into later-collected tests and un-skip their version gates.
+"""
+
+import importlib
+import pathlib
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+try:
+    from mpi4jax_tpu import serving
+    from mpi4jax_tpu.serving import _engine, _roles
+    from mpi4jax_tpu.utils import config
+except ImportError:
+    _ALIAS = "m4j_srv"
+    if _ALIAS not in sys.modules:
+        _pkg = types.ModuleType(_ALIAS)
+        _pkg.__path__ = [str(REPO / "mpi4jax_tpu")]
+        sys.modules[_ALIAS] = _pkg
+    serving = importlib.import_module(_ALIAS + ".serving")
+    _engine = importlib.import_module(_ALIAS + ".serving._engine")
+    _roles = importlib.import_module(_ALIAS + ".serving._roles")
+    config = importlib.import_module(_ALIAS + ".utils.config")
+
+
+# ---------------- KVCache ----------------
+
+
+def test_kv_cache_append_view_roundtrip_across_pages():
+    kv = serving.KVCache((2, 3), np.float32, page=4)
+    entries = np.arange(10 * 6, dtype=np.float32).reshape(10, 2, 3)
+    kv.append(7, entries[:1][0])        # single-entry form
+    kv.append(7, entries[1:])           # batch form
+    assert kv.length(7) == 10
+    assert 7 in kv and 8 not in kv
+    np.testing.assert_array_equal(kv.view(7), entries)
+    # 10 entries over page=4 -> 3 pages, padding not counted as bytes
+    assert kv.live_pages == 3
+    assert kv.nbytes(7) == 10 * 6 * 4
+
+
+def test_kv_cache_load_free_drop_all():
+    kv = serving.KVCache((1,), np.int64, page=2)
+    kv.append(1, np.arange(5, dtype=np.int64)[:, None])
+    wire = kv.view(1)
+    kv2 = serving.KVCache((1,), np.int64, page=64)
+    kv2.load(1, wire)                   # receive side of the KV wire
+    np.testing.assert_array_equal(kv2.view(1), wire)
+    kv2.load(1, wire[:0])               # empty load keeps the request
+    assert 1 in kv2 and kv2.length(1) == 0
+    kv.free(1)
+    assert 1 not in kv and kv.length(1) == 0 and kv.live_pages == 0
+    kv.append(2, np.arange(3, dtype=np.int64)[:, None])
+    kv.drop_all()                       # the elastic-recovery reset
+    assert kv.live_requests == 0 and kv.length(2) == 0
+
+
+def test_kv_cache_rejects_wrong_entry_shape():
+    kv = serving.KVCache((2, 2), np.float32)
+    with pytest.raises(ValueError, match="entry shape"):
+        kv.append(0, np.zeros((3, 3), np.float32))
+
+
+# ---------------- adapters ----------------
+
+
+def _greedy(adapter, prompt, n, chunk=None):
+    """Generate n tokens: chunked prefill (or whole-prompt) + cached
+    decode_step chain — the exact call pattern the engine makes."""
+    toks = list(prompt)
+    past = None
+    if chunk is None:
+        past, logits = adapter.prefill(np.asarray(toks, np.int32))
+    else:
+        for lo in range(0, len(toks), chunk):
+            entries, logits = adapter.prefill(
+                np.asarray(toks[lo:lo + chunk], np.int32), past)
+            past = (entries if past is None
+                    else np.concatenate([past, entries]))
+    out = []
+    for _ in range(n):
+        nxt = int(np.argmax(logits))
+        out.append(nxt)
+        entry, logits = adapter.decode_step(past, nxt)
+        past = np.concatenate([past, entry[None]])
+    return out
+
+
+def test_toy_adapter_exactly_prefix_consistent():
+    a = serving.ToyAdapter()
+    prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+    whole = _greedy(a, prompt, 8)
+    chunked = _greedy(a, prompt, 8, chunk=3)
+    assert whole == chunked
+    # re-prefilling the full transcript reproduces the cache exactly —
+    # the invariant the elastic retry path relies on
+    entries, _ = a.prefill(np.asarray(prompt + whole, np.int32))
+    past = a.prefill(np.asarray(prompt, np.int32))[0]
+    for t in whole:
+        e, _ = a.decode_step(past, t)
+        past = np.concatenate([past, e[None]])
+    np.testing.assert_array_equal(entries, past)
+
+
+def test_numpy_gpt_incremental_decode_matches_full_prefill():
+    a = serving.make_numpy_gpt_adapter(max_seq=64)
+    prompt = [5, 17, 3, 42, 8, 11]
+    # incremental: prefill prompt once, decode_step the continuation
+    past, logits = a.prefill(np.asarray(prompt, np.int32))
+    toks = list(prompt)
+    for _ in range(6):
+        nxt = int(np.argmax(logits))
+        toks.append(nxt)
+        entry, logits = a.decode_step(past, nxt)
+        past = np.concatenate([past, entry[None]])
+    # full recompute of the same transcript agrees to float tolerance
+    full_entries, full_logits = a.prefill(np.asarray(toks, np.int32))
+    np.testing.assert_allclose(full_entries, past, atol=1e-5)
+    np.testing.assert_allclose(full_logits, logits, atol=1e-4)
+    # and chunked prefill is the same function as whole-prompt prefill
+    assert _greedy(a, prompt, 6) == _greedy(a, prompt, 6, chunk=2)
+
+
+def test_gpt_adapter_rejects_context_overflow():
+    a = serving.make_numpy_gpt_adapter(max_seq=8)
+    with pytest.raises(ValueError, match="max_seq"):
+        a.prefill(np.zeros(9, np.int32))
+
+
+# ---------------- role assignment ----------------
+
+
+class _FakeTopo:
+    def __init__(self, island_of):
+        self.island_of = list(island_of)
+        self.multi = len(set(island_of)) > 1
+
+
+def test_roles_auto_flat_world_colocates():
+    plan = serving.assign_roles(4, None, mode="auto")
+    assert plan.mode == "colocated"
+    assert plan.prefill_ranks == plan.decode_ranks == [0, 1, 2, 3]
+    p, d = plan.placement(0)
+    assert p == d  # colocated: prefill rank IS the decode rank
+
+
+def test_roles_auto_multi_island_disaggregates():
+    # frontend r0's island holds r0,r1; the other island decodes
+    plan = serving.assign_roles(4, _FakeTopo([0, 0, 1, 1]), mode="auto")
+    assert plan.mode == "disagg"
+    assert plan.prefill_ranks == [1]
+    assert plan.decode_ranks == [2, 3]
+    assert plan.role_of(0) == "frontend"
+    assert plan.role_of(1) == "prefill"
+    assert plan.role_of(2) == "decode"
+    # round-robin placement over decode ranks, stable per sequence no.
+    assert [plan.placement(i) for i in range(3)] == [
+        (1, 2), (1, 3), (1, 2)]
+
+
+def test_roles_forced_disagg_positional_split_and_too_small():
+    plan = serving.assign_roles(4, None, mode="disagg")
+    assert plan.mode == "disagg"
+    assert plan.prefill_ranks == [1] and plan.decode_ranks == [2, 3]
+    with pytest.raises(ValueError, match=">= 3 ranks"):
+        serving.assign_roles(2, None, mode="disagg")
+    # auto on the same too-small world silently colocates instead
+    assert serving.assign_roles(
+        2, _FakeTopo([0, 1]), mode="auto").mode == "colocated"
+
+
+def test_roles_same_plan_from_every_rank_and_after_shrink():
+    # pure function of (size, topology, mode): every rank derives the
+    # identical plan, and a shrink just re-derives from the new inputs
+    topo = _FakeTopo([0, 0, 1, 1, 1])
+    plans = [serving.assign_roles(5, topo, mode="auto") for _ in range(5)]
+    assert len({(tuple(p.prefill_ranks), tuple(p.decode_ranks))
+                for p in plans}) == 1
+    shrunk = serving.assign_roles(4, _FakeTopo([0, 0, 1, 1]), mode="auto")
+    assert shrunk.mode == "disagg" and shrunk.size == 4
+
+
+def test_recovery_degrades_forced_disagg_on_too_small_world(capsys):
+    # a shrink below 3 survivors must not kill a forced-disagg job:
+    # the recovery-time derivation degrades to colocated, loudly
+    class _TinyComm:
+        def size(self):
+            return 2
+
+    plan = _engine._derive_roles_after_recovery(_TinyComm(), "disagg")
+    assert plan.mode == "colocated" and plan.size == 2
+    err = capsys.readouterr().err
+    assert "NOTICE" in err and "colocated" in err
+    # a world that still fits keeps the forced split
+    class _Comm3(_TinyComm):
+        def size(self):
+            return 3
+
+    assert _engine._derive_roles_after_recovery(
+        _Comm3(), "disagg").mode == "disagg"
+
+
+def test_roles_disagg_island_collapse_falls_back_positional():
+    # every survivor in the frontend's island: no inter-island split
+    # exists, the forced mode still disaggregates positionally
+    plan = _roles.assign_roles(5, _FakeTopo([0, 0, 0, 0, 0]),
+                               mode="disagg")
+    assert plan.mode == "disagg"
+    assert plan.prefill_ranks == [1, 2] and plan.decode_ranks == [3, 4]
+
+
+# ---------------- admission control ----------------
+
+
+def test_admission_cap_sheds_and_retire_frees_slots():
+    adm = serving.Admission(cap=2)
+    assert adm.offer(0, 4).admitted
+    assert adm.offer(1, 4).admitted
+    v = adm.offer(2, 4)
+    assert not v.admitted and "capacity" in v.reason
+    assert "SHED" in repr(v)
+    assert (adm.pending, adm.admitted, adm.shed) == (2, 2, 1)
+    adm.retire()
+    assert adm.offer(3, 4).admitted  # the freed slot is reusable
+    assert adm.pending == 2
+
+
+def test_admission_sheds_overlong_prompt_without_consuming_a_slot():
+    adm = serving.Admission(cap=8, max_prompt=16)
+    v = adm.offer(0, 17)
+    assert not v.admitted and "exceeds model context" in v.reason
+    assert adm.pending == 0 and adm.shed == 1
+
+
+# ---------------- SLO feedback loop ----------------
+
+
+def test_slo_disabled_never_adapts():
+    c = serving.SLOController(max_batch=8, chunk_tokens=64, slo_ms=0)
+    assert all(c.observe(1e6) is None for _ in range(100))
+    assert c.adaptations == 0 and c.max_batch == 8
+
+
+def test_slo_quiescent_run_makes_zero_adaptations():
+    # healthy decode well under the SLO, batch already at the knob:
+    # the loop must not touch anything (the acceptance pin)
+    c = serving.SLOController(max_batch=8, chunk_tokens=64, slo_ms=100)
+    assert all(c.observe(1.0) is None for _ in range(200))
+    assert c.adaptations == 0
+    assert c.max_batch == 8 and c.chunk_tokens == 64
+
+
+def test_slo_adapts_to_sustained_overshoot_within_two_windows():
+    # synthetic slow decode: the FIRST adaptation must land within
+    # 2*WINDOW iterations of the slowdown starting (pinned latency)
+    c = serving.SLOController(max_batch=8, chunk_tokens=256, slo_ms=5)
+    fired_at = None
+    for i in range(2 * serving.SLOController.WINDOW):
+        if c.observe(20.0) is not None:
+            fired_at = i + 1
+            break
+    assert fired_at is not None
+    assert fired_at <= 2 * serving.SLOController.WINDOW
+    assert c.max_batch == 4 and c.chunk_tokens == 128
+    assert c.adaptations == 1 and not c.retune_requested
+
+
+def test_slo_floor_requests_retune_then_stays_quiet():
+    c = serving.SLOController(max_batch=1, chunk_tokens=32, slo_ms=5)
+    verdicts = [c.observe(50.0) for _ in range(5 * c.WINDOW)]
+    fired = [v for v in verdicts if v]
+    assert len(fired) == 1 and "re-tune" in fired[0]
+    assert c.retune_requested and c.max_batch == 1
+
+
+def test_slo_regrows_toward_but_never_beyond_initial():
+    c = serving.SLOController(max_batch=8, chunk_tokens=256, slo_ms=10)
+    while c.max_batch > 2:           # shrink twice under overload
+        c.observe(100.0)
+    assert c.max_batch == 2
+    for _ in range(20 * c.WINDOW):   # then a long healthy stretch
+        c.observe(0.5)
+    assert c.max_batch == 8 and c.chunk_tokens == 256
+    assert c.adaptations == 4        # 2 down + 2 up, then quiet
+
+
+# ---------------- SERVE_* knob parsers ----------------
+
+
+@pytest.mark.parametrize("name,fn,default", [
+    ("MPI4JAX_TPU_SERVE_MAX_BATCH", config.serve_max_batch, 8),
+    ("MPI4JAX_TPU_SERVE_QUEUE_CAP", config.serve_queue_cap, 256),
+])
+def test_serve_int_knobs_strict(monkeypatch, name, fn, default):
+    monkeypatch.delenv(name, raising=False)
+    assert fn() == default
+    monkeypatch.setenv(name, "12")
+    assert fn() == 12
+    for bad in ("0", "-3", "eight", "2.5"):
+        monkeypatch.setenv(name, bad)
+        with pytest.raises(ValueError, match=name):
+            fn()
+
+
+def test_serve_slo_ms_knob_strict(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_SERVE_SLO_MS", raising=False)
+    assert config.serve_slo_ms() == 0.0  # unset = loop disabled
+    monkeypatch.setenv("MPI4JAX_TPU_SERVE_SLO_MS", "2.5")
+    assert config.serve_slo_ms() == 2.5
+    for bad in ("-1", "fast"):
+        monkeypatch.setenv("MPI4JAX_TPU_SERVE_SLO_MS", bad)
+        with pytest.raises(ValueError, match="SERVE_SLO_MS"):
+            config.serve_slo_ms()
+
+
+def test_serve_roles_knob_strict(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_SERVE_ROLES", raising=False)
+    assert config.serve_roles() == "auto"
+    for good in ("auto", "colocated", "disagg"):
+        monkeypatch.setenv("MPI4JAX_TPU_SERVE_ROLES", good)
+        assert config.serve_roles() == good
+    monkeypatch.setenv("MPI4JAX_TPU_SERVE_ROLES", "split")
+    with pytest.raises(ValueError, match="SERVE_ROLES"):
+        config.serve_roles()
+
+
+def test_scheduler_reads_knobs_as_defaults(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_SERVE_MAX_BATCH", "3")
+    monkeypatch.setenv("MPI4JAX_TPU_SERVE_QUEUE_CAP", "5")
+    monkeypatch.setenv("MPI4JAX_TPU_SERVE_SLO_MS", "7.5")
+    c = serving.SLOController()
+    assert c.initial_max_batch == 3 and c.slo_ms == 7.5
+    assert serving.Admission().cap == 5

@@ -227,6 +227,77 @@ def test_serving_survives_rank_death():
     assert "retrying" in fault.stderr  # in-flight requests were retried
 
 
+@pytest.mark.parametrize("plane", ["toy", "v2"])
+def test_serving_frontend_death_releases_survivors(plane):
+    """The rank-0 caveat, made orderly (satellite regression): when the
+    FRONTEND dies, the worker promoted to rank 0 broadcasts STOP before
+    raising its 'became the frontend' error, so the other survivors
+    return from serve_worker instead of hanging in a headless bcast
+    until the transport deadline.  Both serving planes share the
+    contract."""
+    res = _run("serve_frontend_death.py", 3, _port(20 if plane == "toy"
+                                                   else 21),
+               {"MPI4JAX_TPU_FAULT":
+                    "rank=0,point=send,after=12,action=exit",
+                "MPI4JAX_TPU_TIMEOUT_S": "8"},
+               prog_args=(plane,))
+    assert res.returncode == 0, res.stdout + res.stderr[-2000:]
+    # exactly one survivor was promoted (and raised only after the
+    # release); every other survivor exited its loop normally
+    assert res.stdout.count("fd promoted clean") == 1, res.stdout
+    assert res.stdout.count("fd worker done") == 1, res.stdout
+    assert "fault did not fire" not in res.stdout
+
+
+# ---- bridge level: serving v2 (disaggregated, KV cache) ------------
+
+
+def test_serving_v2_commit_point_fault_retry_bit_identical():
+    """The commit-point invariant on the v2 plane: a rank killed
+    between prefill hand-off (the KV ship) and decode commit forces a
+    recovery that drops all rank-local KV and re-prefills every
+    in-flight request — and the completed transcripts are BYTE-
+    IDENTICAL to an uninterrupted run (the toy adapter is exactly
+    prefix-consistent, so a retried iteration cannot drift)."""
+    args = ("--fake-hosts", "r0,r1|r2,r3")
+    clean = _run("serve_v2.py", 4, _port(22), {}, *args,
+                 prog_args=(12, "disagg", "toy"))
+    assert clean.returncode == 0, clean.stderr[-2000:]
+    assert "serve_v2 OK nreq=12 recoveries=0 mode=disagg" in clean.stdout
+    d_clean = _digests(clean.stdout, "serve_v2 digest")
+    assert len(d_clean) == 1, clean.stdout
+
+    # rank 1 is the (sole) prefill rank on this mesh: its sends are the
+    # KV ships to the decode island — the 15th send dies between a
+    # hand-off and the frontend's commit
+    fault = _run("serve_v2.py", 4, _port(23), FAULT_EXIT, *args,
+                 prog_args=(12, "disagg", "toy"))
+    assert fault.returncode == 0, fault.stderr[-2000:]
+    assert "serve_v2 OK nreq=12 recoveries=1" in fault.stdout, fault.stdout
+    assert _digests(fault.stdout, "serve_v2 digest") == d_clean
+    assert "re-prefilling" in fault.stderr  # the KV-drop recovery path
+
+
+@pytest.mark.parametrize("shm", ["0", "1"])
+def test_serving_v2_disagg_bit_consistent_with_colocated(shm):
+    """Disaggregated placement is a pure routing choice: the same
+    prompts produce byte-identical transcripts whether prefill and
+    decode are colocated or split across the 2-island mesh, with the
+    shm arena on or off (the KV wire is exact by default)."""
+    digests = {}
+    for i, mode in enumerate(("colocated", "disagg")):
+        res = _run("serve_v2.py", 4, _port(24 + 2 * i + int(shm)),
+                   {"MPI4JAX_TPU_DISABLE_SHM": shm},
+                   "--fake-hosts", "r0,r1|r2,r3",
+                   prog_args=(12, mode, "gpt"))
+        assert res.returncode == 0, res.stderr[-2000:]
+        assert f"mode={mode}" in res.stdout, res.stdout
+        d = _digests(res.stdout, "serve_v2 digest")
+        assert len(d) == 1, res.stdout
+        digests[mode] = d[0]
+    assert digests["colocated"] == digests["disagg"], digests
+
+
 # ---- obs: recordings carry the world generation --------------------
 
 

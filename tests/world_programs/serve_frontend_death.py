@@ -1,0 +1,91 @@
+"""Frontend-death regression (bridge level, parent-package shim).
+
+Rank 0 — the frontend, which owns the request queue — is killed by
+fault injection mid-serve.  The failure model says its in-flight state
+is unrecoverable, BUT the survivors must find that out in an orderly
+way: the worker promoted to rank 0 by the recovery broadcasts STOP
+(releasing every other survivor from the bcast it re-entered) BEFORE
+raising its "became the frontend" error.  Before that fix the promoted
+worker raised immediately and the other survivors hung in a headless
+bcast until the transport deadline.
+
+Success markers (the test asserts both, and exit code 0):
+    ``fd promoted clean rN``  — the promoted worker raised AFTER release
+    ``fd worker done rN``     — every other survivor returned normally
+
+Usage (under the launcher): serve_frontend_death.py [plane]
+with plane = ``toy`` (elastic/serving.py) or ``v2``
+(mpi4jax_tpu/serving).
+"""
+
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+
+import numpy as np  # noqa: E402
+
+from mpi4jax_tpu import serving as serving_v2  # noqa: E402
+from mpi4jax_tpu.elastic import serving as serving_toy  # noqa: E402
+from mpi4jax_tpu.runtime import transport  # noqa: E402
+
+PLANE = sys.argv[1] if len(sys.argv) > 1 else "toy"
+
+
+def decode_fn(toks, lengths, start, stop):
+    out = np.zeros(stop - start, np.int32)
+    for i in range(start, stop):
+        n = int(lengths[i])
+        row = toks[i, :n].astype(np.int64)
+        out[i - start] = int((row.sum() * 31 + n * 7 + int(row[-1])) % 997)
+    return out
+
+
+def run_frontend(comm):
+    """Rank 0: serve until the injected fault kills this process (the
+    drain should never finish — the fault fires first)."""
+    if PLANE == "toy":
+        server = serving_toy.Server(comm, decode_fn, max_batch=4)
+        for i in range(8):
+            server.submit([i + 1, 2 * i + 1], max_new=6)
+        server.run_until_drained()
+        server.stop()
+    else:
+        server = serving_v2.Server(comm, serving_v2.ToyAdapter(),
+                                   max_batch=4, chunk_tokens=4)
+        for i in range(8):
+            assert server.submit([i + 1, 2 * i + 1], max_new=6).admitted
+        server.run_until_drained()
+        server.stop()
+    print("fd frontend drained (fault did not fire?)", flush=True)
+
+
+def run_worker(comm):
+    try:
+        if PLANE == "toy":
+            serving_toy.serve_worker(comm, decode_fn)
+        else:
+            serving_v2.serve_worker(comm, serving_v2.ToyAdapter())
+        print(f"fd worker done r{comm.rank()}", flush=True)
+    except RuntimeError as e:
+        assert "became the frontend" in str(e), e
+        print(f"fd promoted clean r{comm.rank()}", flush=True)
+
+
+def main():
+    comm = transport.get_world_comm()
+    _ = comm.handle
+    if comm.rank() == 0:
+        run_frontend(comm)
+    else:
+        run_worker(comm)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Bridge-level serving-v2 rank program (no jax — parent-package shim).
+
+Drives :mod:`mpi4jax_tpu.serving` end-to-end under the launcher: rank 0
+is the frontend (continuous batching — half the stream is submitted
+only after decoding started), every other rank runs the v2 worker
+loop.  The transcript digest is a pure function of the request
+prompts and the adapter, so it must be IDENTICAL across world sizes,
+role modes (colocated vs disaggregated), shm on/off, and any number of
+mid-stream recoveries — that is the bit-consistency and commit-point
+contract the world tests pin.
+
+Usage (under the launcher):
+    serve_v2.py [nreq] [roles_mode] [adapter] [max_new]
+
+adapter: ``toy`` (exactly prefix-consistent integer state — the fault
+tests) or ``gpt`` (the numpy GPT — float math, no-fault runs).
+"""
+
+import hashlib
+import os
+import sys
+import types
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+
+from mpi4jax_tpu import serving  # noqa: E402
+from mpi4jax_tpu.runtime import transport  # noqa: E402
+
+NREQ = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+MODE = sys.argv[2] if len(sys.argv) > 2 else "auto"
+ADAPTER = sys.argv[3] if len(sys.argv) > 3 else "toy"
+MAX_NEW = int(sys.argv[4]) if len(sys.argv) > 4 else 4
+
+
+def make_adapter():
+    if ADAPTER == "gpt":
+        return serving.make_numpy_gpt_adapter(max_seq=96)
+    return serving.ToyAdapter()
+
+
+def prompt_for(i, vocab):
+    return [(i * 7 + j * 3 + 1) % vocab for j in range(4 + i % 3)]
+
+
+def main():
+    comm = transport.get_world_comm()
+    _ = comm.handle  # connect the mesh before the first broadcast
+    adapter = make_adapter()
+    if comm.rank() != 0:
+        roles = serving.serve_worker(comm, adapter, roles_mode=MODE)
+        print(f"serve_v2 worker done r{comm.rank()} "
+              f"role={roles.role_of(comm.rank())}", flush=True)
+        return
+
+    server = serving.Server(comm, adapter, max_batch=4, chunk_tokens=3,
+                            roles_mode=MODE)
+    print(f"serve_v2 roles: {server.roles.describe()}", flush=True)
+    vocab = adapter.vocab
+    for i in range(NREQ // 2):
+        assert server.submit(prompt_for(i, vocab),
+                             max_new=MAX_NEW + (i % 3)).admitted
+    iters = 0
+    while server.active or len(server.completed) < NREQ:
+        if iters == 2:
+            # continuous batching: the second half arrives mid-decode
+            for i in range(NREQ // 2, NREQ):
+                assert server.submit(prompt_for(i, vocab),
+                                     max_new=MAX_NEW + (i % 3)).admitted
+        server.step()
+        iters += 1
+        if iters > 2000:
+            raise RuntimeError("serving did not drain")
+    server.stop()
+
+    digest = hashlib.sha256()
+    for r in sorted(server.completed, key=lambda r: r.id):
+        assert r.done and len(r.generated) >= MAX_NEW, (r.id, r.tokens)
+        digest.update(repr((r.id, r.tokens)).encode())
+    print(f"serve_v2 digest {digest.hexdigest()}", flush=True)
+    print(f"serve_v2 OK nreq={len(server.completed)} "
+          f"recoveries={server.recoveries} mode={server.roles.mode}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
