@@ -18,9 +18,18 @@ update-goldens:
 	$(PY) tools/verify_corpus.py --update-goldens
 
 # sanitizer builds of the native transport (tests/test_sanitizers.py:
-# loopback pairs, the progress engine, and the elastic shrink-under-load
-# three-rank scenario all run against these builds — 0 reports required)
+# loopback pairs, the progress engine, the elastic shrink-under-load
+# three-rank scenario, and the self-heal reconnect pairs all run
+# against these builds — 0 reports required)
 tsan asan:
 	$(MAKE) -C native $@
 
-.PHONY: verify-corpus update-goldens tsan asan
+# chaos fault matrix for the self-healing link layer: every cell of
+# {reset,drop,delay,corrupt} x {URING 0/1} x {shm on/off} x
+# {engine on/off} must heal bit-identically or escalate loudly — no
+# hangs, no silent corruption (tools/chaos_matrix.py)
+chaos:
+	$(MAKE) -C native libtpucomm-noffi
+	$(PY) tools/chaos_matrix.py
+
+.PHONY: verify-corpus update-goldens tsan asan chaos

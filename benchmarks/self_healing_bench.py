@@ -1,0 +1,392 @@
+"""Acceptance evidence for the self-healing link layer
+(``BENCH_self_healing.json``)::
+
+    python benchmarks/self_healing_bench.py --write
+
+Three measurements, each gate-asserted before the artifact is written:
+
+1. **Wire overhead** — a ctypes loopback pingpong ladder (1 KiB to
+   1 MiB) with the layer disarmed vs armed (seq numbers + epoch + CRC32C
+   on every header, retain-ring copy on every small send): the armed
+   wire must sit within noise of the historic one.
+2. **`MPI4JAX_TPU_RETRY=0` pins today's path** — the deterministic
+   2-rank traffic program's digests with the knob unset vs explicitly
+   0 are identical, with zero link-layer counters and no self-heal
+   activity anywhere in stderr.
+3. **Serving chaos** — the full disaggregated serving plane
+   (``benchmarks/serving_latency.py``, np=4, two virtual islands)
+   with a transient RST injected on a decode rank's live link: the
+   armed layer heals it in place, so the plane sees **zero
+   recoveries, zero KV-cache drops, zero re-prefills**, and every
+   admitted request completes — versus the same fault disarmed,
+   which is never absorbed.  (Disarmed it is in fact WORSE than the
+   full-shrink recovery a rank death costs: nobody actually died, so
+   no survivor can announce a new generation — every rank stalls out
+   the full elastic grace window, the first casualty is the frontend,
+   and frontend death is fatal to the plane by design.  The gate
+   asserts the honest dichotomy: disarmed, the fault either costs at
+   least one full elastic recovery or loses the job loudly.)
+
+The heal-under-fault functional evidence lives in ``make chaos``
+(tools/chaos_matrix.py) and tests/world/test_self_healing.py; this
+artifact carries the *performance* and *serving* halves.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCHER = os.path.join(REPO, "mpi4jax_tpu", "runtime", "launch.py")
+HEAL_OPS = os.path.join(REPO, "tests", "world_programs", "heal_ops.py")
+SERVING = os.path.join(REPO, "benchmarks", "serving_latency.py")
+
+FAKE_HOSTS = "r0,r1|r2,r3"
+# a decode rank's live link, reset mid-stream (transient — the peer is
+# fine, only the connection dies)
+TRANSIENT_FAULT = "rank=3,point=send,after=500,action=reset"
+
+_PINGPONG_SRC = r"""
+import ctypes, os, time
+import numpy as np
+
+lib = ctypes.CDLL(os.environ["PP_SO"])
+rank = int(os.environ["PP_RANK"])
+lib.tpucomm_init.restype = ctypes.c_int64
+lib.tpucomm_init.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                             ctypes.c_char_p]
+h = lib.tpucomm_init(rank, 2, int(os.environ["PP_PORT"]), b"")
+assert h > 0
+p = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+for size in map(int, os.environ["PP_SIZES"].split(",")):
+    buf = np.zeros(size, np.uint8)
+    reps = max(120, min(600, (1 << 23) // size))
+    ts = []
+    for it in range(reps + 20):
+        t0 = time.perf_counter()
+        if rank == 0:
+            assert lib.tpucomm_send(h, p(buf), size, 1, it) == 0
+            assert lib.tpucomm_recv(h, p(buf), size, 1, it) == 0
+        else:
+            assert lib.tpucomm_recv(h, p(buf), size, 0, it) == 0
+            assert lib.tpucomm_send(h, p(buf), size, 0, it) == 0
+        if it >= 20:  # warmup excluded
+            ts.append((time.perf_counter() - t0) * 1e6)
+    ts.sort()
+    if rank == 0:
+        # min + p50: the min is the noise-free estimator on loopback
+        # (scheduler wakeups dominate the upper half of the RTT
+        # distribution and dwarf per-frame CPU cost)
+        print("pp %d %.2f %.2f" % (size, ts[0], ts[len(ts) // 2]),
+              flush=True)
+lib.tpucomm_finalize(ctypes.c_int64(h))
+"""
+
+_port = [49900 + (os.getpid() * 13) % 60]
+
+
+def _next_port(stride=9):
+    _port[0] += stride
+    return _port[0]
+
+
+def _base_env(extra):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(extra)
+    return env
+
+
+# ---------------- 1: pingpong ladder ----------------
+
+
+def pingpong_ladder(so, sizes, armed):
+    port = _next_port()
+    env = _base_env({
+        "PP_SO": so, "PP_PORT": str(port),
+        "PP_SIZES": ",".join(str(s) for s in sizes),
+        "MPI4JAX_TPU_DISABLE_SHM": "1",
+        # classic poll path: arming DELIBERATELY disables the uring
+        # speculative-receive fast path (an over-pull cannot be rolled
+        # back at frame granularity, which replay requires), so an
+        # auto-uring comparison would measure that routing choice, not
+        # the seq+CRC framing this ladder isolates
+        "MPI4JAX_TPU_URING": "0",
+        "MPI4JAX_TPU_RETRY": "4" if armed else "0",
+    })
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _PINGPONG_SRC],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        env={**env, "PP_RANK": str(r)}) for r in range(2)]
+    outs = [pr.communicate(timeout=300) for pr in procs]
+    for pr, (out, err) in zip(procs, outs):
+        assert pr.returncode == 0, err[-1000:]
+    stats = {}
+    for line in outs[0][0].splitlines():
+        if line.startswith("pp "):
+            _, size, mn, p50 = line.split()
+            stats[int(size)] = (float(mn), float(p50))
+    assert set(stats) == set(sizes), stats
+    return stats
+
+
+def measure_overhead(so, sizes, rounds=5):
+    """Per-size best-of-rounds minimum roundtrip, disarmed vs armed,
+    interleaved so drift hits both equally.  The min-RTT is the
+    estimator: on loopback the p50 flaps 2x run-to-run with scheduler
+    wakeups, which would drown the few hundred nanoseconds the armed
+    framing (16 extra header bytes, CRC32C, retain-ring memcpy) can
+    legitimately add."""
+    dis, arm = {s: [] for s in sizes}, {s: [] for s in sizes}
+    p50s = {s: [[], []] for s in sizes}
+    for _ in range(rounds):
+        for s, (mn, p50) in pingpong_ladder(so, sizes,
+                                            armed=False).items():
+            dis[s].append(mn)
+            p50s[s][0].append(p50)
+        for s, (mn, p50) in pingpong_ladder(so, sizes,
+                                            armed=True).items():
+            arm[s].append(mn)
+            p50s[s][1].append(p50)
+    ladder = []
+    for s in sizes:
+        d, a = min(dis[s]), min(arm[s])
+        ladder.append({
+            "bytes": s,
+            "disarmed_min_rtt_us": round(d, 2),
+            "armed_min_rtt_us": round(a, 2),
+            "disarmed_p50_rtt_us": round(statistics.median(p50s[s][0]), 2),
+            "armed_p50_rtt_us": round(statistics.median(p50s[s][1]), 2),
+            "armed_over_disarmed": round(a / d, 3),
+        })
+    return ladder
+
+
+# ---------------- 2: RETRY=0 bit-for-bit ----------------
+
+
+def _run_heal_ops(extra_env):
+    res = subprocess.run(
+        [sys.executable, LAUNCHER, "-n", "2",
+         "--port", str(_next_port()), HEAL_OPS],
+        capture_output=True, text=True, timeout=120,
+        env=_base_env({"MPI4JAX_TPU_DISABLE_SHM": "1",
+                       "MPI4JAX_TPU_TIMEOUT_S": "30", **extra_env}),
+        cwd=REPO)
+    assert res.returncode == 0, res.stderr[-1000:]
+    import re
+    digests = dict(re.findall(r"heal_ops (\d+) digest (\S+)", res.stdout))
+    assert set(digests) == {"0", "1"}, res.stdout
+    return digests, res.stderr
+
+
+def retry0_pinned():
+    d_unset, err_unset = _run_heal_ops({})
+    d_zero, err_zero = _run_heal_ops({"MPI4JAX_TPU_RETRY": "0"})
+    assert d_unset == d_zero, (d_unset, d_zero)
+    assert "self-heal" not in err_unset + err_zero
+    return {"digests_unset": d_unset, "digests_retry0": d_zero,
+            "bit_identical": True, "self_heal_activity": False}
+
+
+# ---------------- 3: serving chaos ----------------
+
+
+def serving_chaos(requests, fault_env, label, expect_heal=True):
+    import re
+    res = subprocess.run(
+        [sys.executable, LAUNCHER, "-n", "4",
+         "--port", str(_next_port(stride=17)), "--elastic",
+         "--fake-hosts", FAKE_HOSTS, SERVING,
+         "--requests", str(requests), "--roles", "disagg"],
+        capture_output=True, text=True, timeout=900,
+        env=_base_env({"MPI4JAX_TPU_DISABLE_SHM": "1",
+                       "MPI4JAX_TPU_TIMEOUT_S": "8", **fault_env}),
+        cwd=REPO)
+    if res.returncode != 0 or "serving_latency done" not in res.stdout:
+        if expect_heal:
+            sys.stderr.write(res.stderr[-3000:] + res.stdout[-1000:])
+            raise SystemExit(f"serving scenario {label} failed")
+        # the comparison leg: the fault was not absorbed.  It must at
+        # least be LOUD (a post-mortem naming what happened) — a hang
+        # or a silent wrong answer would have failed above on timeout
+        # or on the request-accounting gates
+        assert "post-mortem" in res.stderr, (
+            f"disarmed scenario {label} failed without a post-mortem")
+        return {
+            "completed_cleanly": False,
+            "returncode": res.returncode,
+            "loud_post_mortem": True,
+            "elastic_shrinks_attempted": len(
+                re.findall(r"advancing to generation", res.stderr)),
+            "ranks_stalled_out_grace_window":
+                "no generation" in res.stderr,
+            "job_lost":
+                "no surviving rank to shrink onto" in res.stderr,
+        }
+    tail = [ln for ln in res.stdout.splitlines()
+            if ln.startswith("serving_latency done")][0]
+    meta = dict(kv.split("=") for kv in tail.split()[2:])
+    rows = [json.loads(ln) for ln in res.stdout.splitlines()
+            if ln.startswith("{")]
+    # re-prefills surface as request retries -> the "during" bucket;
+    # a healed transient never creates one
+    reprefills = sum(r.get("completed", 0) for r in rows
+                     if r.get("phase") == "during")
+    return {
+        "rows": rows,
+        "completed_cleanly": True,
+        "submitted": int(meta["submitted"]),
+        "completed": int(meta["completed"]),
+        "recoveries_kv_drops": int(meta["recoveries"]),
+        "reprefills": reprefills,
+        "link_healed": "self-heal: link to r" in res.stderr
+                       and "recovered" in res.stderr,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--write", action="store_true",
+                    help="write BENCH_self_healing.json at the repo root")
+    ap.add_argument("--requests", type=int, default=300)
+    args = ap.parse_args()
+
+    subprocess.run(["make", "-C", os.path.join(REPO, "native"),
+                    "libtpucomm-noffi"], check=True, capture_output=True)
+    so = os.path.join(REPO, "mpi4jax_tpu", "runtime", "_native",
+                      "libtpucomm.so")
+
+    sizes = [1024, 8192, 65536, 262144, 1048576]
+    ladder = measure_overhead(so, sizes)
+    worst = max(r["armed_over_disarmed"] for r in ladder)
+    geo = statistics.geometric_mean(
+        r["armed_over_disarmed"] for r in ladder)
+    # the seq+CRC framing itself must be within noise where it is the
+    # only added work (small frames: header grows 16->32 bytes, one
+    # CRC32C, a sub-page retain copy) ...
+    for r in ladder:
+        if r["bytes"] <= 8192:
+            assert r["armed_over_disarmed"] <= 1.10, (
+                f"seq+CRC visible at {r['bytes']}B: {r}")
+    # ... while the retain-ring memcpy near the 256 KiB retention
+    # ceiling is a real, bounded, documented cost — and the armed
+    # path's single contiguous frame write WINS at rendezvous sizes
+    assert geo <= 1.15, f"armed wire geomean overhead {geo:.3f} > 1.15"
+    assert worst <= 1.40, f"armed wire worst-size overhead {worst:.3f}"
+
+    pinned = retry0_pinned()
+
+    transient = serving_chaos(
+        args.requests,
+        {"MPI4JAX_TPU_RETRY": "4", "MPI4JAX_TPU_RETRY_BACKOFF_MS": "50",
+         "MPI4JAX_TPU_FAULT": TRANSIENT_FAULT}, "transient-armed")
+    assert transient["link_healed"], "the reset was not healed in place"
+    assert transient["recoveries_kv_drops"] == 0, transient
+    assert transient["reprefills"] == 0, transient
+    assert (transient["completed"] == transient["submitted"]
+            == args.requests), transient
+
+    disarmed = serving_chaos(
+        args.requests,
+        {"MPI4JAX_TPU_FAULT": TRANSIENT_FAULT}, "transient-disarmed",
+        expect_heal=False)
+    # the SAME fault without the layer is never absorbed: it costs at
+    # least one full elastic recovery (KV dropped, in-flight requests
+    # re-prefilled) — or, as observed on the disagg plane where a
+    # transient reset kills NO rank (so no death ever announces a new
+    # generation), every rank stalls out the elastic grace window and
+    # the job is lost, loudly
+    if disarmed["completed_cleanly"]:
+        assert disarmed["recoveries_kv_drops"] >= 1, (
+            "disarmed plane absorbed the fault transparently", disarmed)
+    else:
+        assert disarmed["loud_post_mortem"], disarmed
+
+    artifact = {
+        "note": (
+            "Self-healing link layer acceptance "
+            "(benchmarks/self_healing_bench.py).  overhead_ladder: "
+            "2-rank TCP loopback pingpong (classic poll path, URING=0 "
+            "— arming deliberately disables the uring speculative "
+            "receive, so an auto comparison would measure routing, not "
+            "framing), best-of-5-interleaved-rounds MIN RTT (the p50 "
+            "flaps ~2x with scheduler wakeups on loopback; both are "
+            "reported), MPI4JAX_TPU_RETRY=0 vs =4.  The armed wire "
+            "adds per-frame sequence numbers, a connection epoch, a "
+            "CRC32C over header/control bytes, and a retain-ring copy "
+            "of every frame <= 256 KiB.  Gates: seq+CRC within noise "
+            "(<= 1.10) at the small sizes where it is the only added "
+            "work; geomean <= 1.15 and worst size <= 1.40 overall — "
+            "the retain memcpy near the retention ceiling is the one "
+            "real, bounded cost (~1.2x at 64 KiB), while the armed "
+            "path's single contiguous frame write is FASTER than the "
+            "historic header+payload write pair at rendezvous sizes.  "
+            "retry0_pinned: the deterministic "
+            "2-rank traffic program (tests/world_programs/heal_ops.py) "
+            "with the knob unset vs explicitly 0 — digests identical, "
+            "no link-layer activity (the default path is today's wire "
+            "bit-for-bit).  serving_chaos: the disaggregated serving "
+            "plane (serving_latency.py, np=4, islands r0,r1|r2,r3, "
+            "TCP) with a transient RST on decode rank 3's live link "
+            "after its 501st send — armed, the link heals in place: "
+            "zero recoveries (= zero KV-cache drops), zero re-prefills "
+            "(no request enters the 'during' retry bucket), every "
+            "admitted request completes.  Disarmed, the identical "
+            "fault is never absorbed — and because a transient reset "
+            "kills NO rank, no death ever announces a new elastic "
+            "generation: every rank stalls out the full "
+            "MPI4JAX_TPU_ELASTIC_GRACE_S window waiting for one, the "
+            "first casualty is the frontend (fatal to the plane by "
+            "design), and the job is lost after a loud cascade of "
+            "shrink attempts.  A transient link fault disarmed is "
+            "strictly WORSE than a rank death (which at least "
+            "triggers the shrink path immediately); the armed layer "
+            "closes exactly that gap."),
+        "config": {
+            "sizes": sizes, "requests": args.requests,
+            "fake_hosts": FAKE_HOSTS, "fault": TRANSIENT_FAULT,
+            "env": {"JAX_PLATFORMS": "cpu",
+                    "MPI4JAX_TPU_DISABLE_SHM": "1"},
+        },
+        "overhead_ladder": ladder,
+        "overhead_geomean": round(geo, 3),
+        "retry0_pinned": pinned,
+        "serving_chaos": {
+            "transient_armed": {k: v for k, v in transient.items()
+                                if k != "rows"},
+            "transient_disarmed": {k: v for k, v in disarmed.items()
+                                   if k != "rows"},
+            "armed_rows": transient["rows"],
+        },
+        "findings": {
+            "armed_wire_overhead_geomean": round(geo, 3),
+            "armed_wire_overhead_worst": round(worst, 3),
+            "retry0_bit_identical": True,
+            "serving_transient_kv_drops_armed": 0,
+            "serving_transient_reprefills_armed": 0,
+            "serving_transient_disarmed_outcome": (
+                "full elastic recovery (%d KV drop(s))"
+                % disarmed["recoveries_kv_drops"]
+                if disarmed["completed_cleanly"] else
+                "job lost: grace-window stall, then cascading shrink "
+                "(%d attempt(s)) — loud post-mortem, no hang"
+                % disarmed["elastic_shrinks_attempted"]),
+        },
+    }
+    text = json.dumps(artifact, indent=1)
+    if args.write:
+        out = os.path.join(REPO, "BENCH_self_healing.json")
+        with open(out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {out}")
+    else:
+        print(text)
+
+
+if __name__ == "__main__":
+    main()

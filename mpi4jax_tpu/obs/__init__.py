@@ -43,6 +43,7 @@ from ._recorder import (  # noqa: F401
     enabled,
     events,
     generation,
+    link_counters,
     record_span,
     reset,
     start,
@@ -70,7 +71,8 @@ def stats(event_list=None) -> dict:
     if event_list is None:
         event_list = events()
         return summarize(event_list, dropped=dropped(),
-                         rank=_recorder.rank())
+                         rank=_recorder.rank(),
+                         link=_recorder.link_counters())
     return summarize(event_list)
 
 

@@ -50,7 +50,18 @@ class TpuObsEvent(ctypes.Structure):
         # never writes it, which is why drain() gates the field on
         # syscalls_available()
         ("syscalls", ctypes.c_int32),
+        # link-layer recovery events absorbed while the op executed
+        # (self-healing generation: retries + reconnects the op rode
+        # through transparently); widened the struct 72 -> 80 bytes, so
+        # available() requires tpucomm_link_counters as the layout probe
+        ("retries", ctypes.c_int32),
+        ("reserved0", ctypes.c_int32),
     ]
+
+#: process-total link-layer counter names, index-matched to the
+#: ``tpucomm_link_counters`` out-params (native/tpucomm.h)
+LINK_COUNTER_NAMES = ("retries", "reconnects", "dup_dropped",
+                     "crc_errors", "replayed", "heartbeats")
 
 
 #: bytes per ring slot, for sizing the ring from MPI4JAX_TPU_TRACE_BUF_KB
@@ -66,7 +77,9 @@ def available(lib) -> bool:
     ``tier`` field (pre-quantization ones also lack ``wire_bytes``,
     pre-progress-engine ones ``queue_s``), which this module would
     misparse — such a library is treated as unobserved rather than
-    decoded wrong."""
+    decoded wrong.  ``tpucomm_link_counters`` is the probe for the
+    self-healing generation, whose events grew ``retries`` (72 -> 80
+    byte slots — an older library's ring would be misparsed too)."""
     if lib is None or not hasattr(lib, "tpucomm_obs_enable"):
         return False
     if not hasattr(lib, "tpucomm_execute"):
@@ -74,6 +87,8 @@ def available(lib) -> bool:
     if not hasattr(lib, "tpucomm_quant_packed_bytes"):
         return False
     if not hasattr(lib, "tpucomm_set_topology"):
+        return False
+    if not hasattr(lib, "tpucomm_link_counters"):
         return False
     # idempotent signature setup (works for bridge-loaded and
     # standalone-loaded libraries alike)
@@ -156,5 +171,24 @@ def drain(lib, max_events: int = 1 << 20):
             # only a uring-generation library writes the field; a
             # pre-uring .so's slot is stale padding, never a count
             ev["syscalls"] = e.syscalls
+        if e.retries:
+            # link-layer recovery events this op rode through; carried
+            # only when nonzero — fault-free recordings (the vast
+            # majority) stay schema-identical
+            ev["retries"] = e.retries
         out.append(ev)
     return out
+
+
+def link_counters(lib):
+    """Process-total self-healing counters as a dict (see
+    ``LINK_COUNTER_NAMES``), or ``None`` when the loaded library
+    predates the link layer.  All-zero on every fault-free run — and
+    with ``MPI4JAX_TPU_RETRY`` unset the layer never arms, so the
+    counters stay zero by construction."""
+    if lib is None or not hasattr(lib, "tpucomm_link_counters"):
+        return None
+    vals = [ctypes.c_int64(0) for _ in LINK_COUNTER_NAMES]
+    lib.tpucomm_link_counters(*[ctypes.byref(v) for v in vals])
+    return {name: int(v.value)
+            for name, v in zip(LINK_COUNTER_NAMES, vals)}

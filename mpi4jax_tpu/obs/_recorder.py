@@ -101,7 +101,7 @@ def enabled() -> bool:
 
 def default_capacity_events() -> int:
     """Ring capacity from ``MPI4JAX_TPU_TRACE_BUF_KB`` (default 256 KB
-    of 72-byte native slots = 3640 events; same count on the Python
+    of 80-byte native slots = 3276 events; same count on the Python
     side)."""
     raw = config.setting("MPI4JAX_TPU_TRACE_BUF_KB", "256")
     try:
@@ -243,6 +243,12 @@ def _pull_native() -> None:
         # schema-identical, and a fake 0 never masquerades as data
         if "syscalls" in e:
             ev["syscalls"] = e["syscalls"]
+        # link-layer recovery events the op absorbed (self-healing
+        # retries/reconnects it rode through); nonzero only under
+        # MPI4JAX_TPU_RETRY with an actual fault, so fault-free
+        # recordings stay schema-identical
+        if e.get("retries"):
+            ev["retries"] = e["retries"]
         canon.append(ev)
     _state.native_acc.extend(canon)
 
@@ -269,6 +275,17 @@ def dropped() -> dict:
         "native": nat,
         "ops": _state.spans.dropped if _state.spans is not None else 0,
     }
+
+
+def link_counters():
+    """Process-total self-healing link counters (retries, reconnects,
+    dup_dropped, crc_errors, replayed, heartbeats) from the live
+    transport, or ``None`` without one (mesh-tier / pure-span use, or a
+    library predating the link layer).  These are cumulative totals,
+    not ring entries — they survive ring overflow and drains."""
+    if _state.lib is None:
+        return None
+    return _native.link_counters(_state.lib)
 
 
 def rank() -> int:

@@ -82,6 +82,29 @@ def _pump_stderr(pipe, tail):
             pass
 
 
+def _self_heal_outcomes(slot_tails):
+    """Scan every slot's stderr tail for link-layer self-healing lines
+    (native/tpucomm.cc's greppable contract).  Returns
+    ``({slot: recovered_count}, [(slot, peer), ...])`` — slots that
+    healed a transient link fault IN PLACE (they are not dead and must
+    not be reported as deaths), and links the layer declared DEAD after
+    exhausting MPI4JAX_TPU_RETRY (naming the failed connection is the
+    post-mortem's job; the exit code alone cannot)."""
+    import re as _re
+
+    healed = {}
+    dead_links = []
+    for slot, tail in sorted(slot_tails.items()):
+        for line in tail:
+            raw = bytes(line)
+            if _re.search(rb"self-heal: link to r\d+ recovered", raw):
+                healed[slot] = healed.get(slot, 0) + 1
+            m = _re.search(rb"self-heal: link to r(\d+) DEAD", raw)
+            if m:
+                dead_links.append((slot, int(m.group(1))))
+    return healed, dead_links
+
+
 def _last_native_error(tail):
     """The most recent transport diagnostic in a rank's stderr tail."""
     for line in reversed(tail):
@@ -786,6 +809,24 @@ def main(argv=None):
     if args.trace:
         _merge_trace(os.path.abspath(args.trace), args.np)
 
+    # link-layer self-healing outcomes from every slot's stderr tail: a
+    # slot that RECOVERED a transient link fault in place is not a dead
+    # rank, and must never be reported as one; a link the layer declared
+    # DEAD names the failed connection (slot -> peer) for the
+    # post-mortem, since the dying rank's exit code alone cannot
+    healed_slots, dead_links = _self_heal_outcomes(slot_tails)
+    heal_note = ""
+    if healed_slots:
+        total = sum(healed_slots.values())
+        heal_note = (
+            f"; transient link fault(s) healed in-place on rank slot(s) "
+            f"{sorted(healed_slots)} ({total} reconnect(s), not rank "
+            f"deaths)")
+    link_note = ""
+    if dead_links:
+        link_note = "; failed link(s): " + ", ".join(
+            f"rank {s} -> rank {p}" for s, p in dead_links)
+
     if elastic_policy is not None and generation > 0 and exit_code == 0:
         # the recovery outcome, not the first failure: the job SURVIVED
         # — say what it cost and where it resumed (exit code stays 0)
@@ -808,7 +849,7 @@ def main(argv=None):
         print(
             f"launch: post-mortem: elastic job completed after recovery "
             f"(policy {elastic_policy}): reached generation "
-            f"{generation}, {outcome}{resume}",
+            f"{generation}, {outcome}{resume}{link_note}{heal_note}",
             file=sys.stderr, flush=True,
         )
     elif first_fail is not None:
@@ -820,7 +861,17 @@ def main(argv=None):
             if elastic_policy is not None and generation > 0 else "")
         print(
             f"launch: post-mortem: rank {rank} failed first (exit code "
-            f"{rc}){gen_note}" + (f"; last error: {err}" if err else ""),
+            f"{rc}){gen_note}" + (f"; last error: {err}" if err else "")
+            + link_note + heal_note,
+            file=sys.stderr, flush=True,
+        )
+    elif healed_slots:
+        # the job SUCCEEDED and nothing died, but the wire was not
+        # quiet: say what the link layer absorbed, so a flaky fabric is
+        # visible before it degrades into actual rank deaths
+        print(
+            "launch: post-mortem: job completed; no rank failed"
+            + heal_note.replace("; ", " — ", 1),
             file=sys.stderr, flush=True,
         )
     elif watchdog_fired:

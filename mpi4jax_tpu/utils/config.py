@@ -62,10 +62,12 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
 - ``MPI4JAX_TPU_CONNECT_TIMEOUT_S`` — bootstrap deadline (seconds) for
                                 dialing lower ranks (exponential
                                 backoff, last errno reported; default
-                                30, matching the old fixed spin) and —
-                                only when set explicitly — for the
-                                accept side waiting on higher ranks
-                                (read natively).
+                                30, matching the old fixed spin) AND
+                                for the accept side waiting on higher
+                                ranks (bounded by default since the
+                                self-healing PR; 0 = explicitly
+                                unbounded on both sides; read
+                                natively).
 - ``MPI4JAX_TPU_LAUNCH_GRACE_S`` — launcher teardown grace period
                                 (seconds, default 5) between escalation
                                 steps (SIGINT/SIGTERM -> SIGKILL) when
@@ -86,8 +88,60 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 rank R the (N+1)-th op at `point` hangs
                                 forever, exits with code 17 (simulated
                                 crash), or shuts down every mesh socket
-                                (simulated partition).  A malformed
-                                spec aborts the job (read natively).
+                                (simulated partition).  The self-healing
+                                chaos actions: ``action=reset`` closes
+                                the op's link with SO_LINGER(0) (an RST
+                                on the wire, the classic transient),
+                                ``action=drop`` with ``bytes=N`` kills
+                                the link mid-frame after writing N
+                                bytes (default 20: inside the header),
+                                ``action=delay`` with ``ms=T`` stalls
+                                the op T milliseconds (default 100),
+                                and ``action=corrupt`` flips one header
+                                byte on the next frame (detected by the
+                                wire CRC).  All four are one-shot and,
+                                with ``MPI4JAX_TPU_RETRY`` unset,
+                                degrade to a plain link reset.  A
+                                malformed spec aborts the job (read
+                                natively).
+- ``MPI4JAX_TPU_RETRY``       — self-healing link retry budget: the
+                                number of reconnect attempts allowed
+                                per link failure before the link is
+                                declared DEAD and escalates through the
+                                poison -> abort -> elastic ladder.
+                                Default 0 = the self-healing layer is
+                                fully disarmed and every wire byte is
+                                bit-identical to the historic transport
+                                (frames gain a seq/epoch/CRC extended
+                                header only when armed; read natively).
+- ``MPI4JAX_TPU_RETRY_BACKOFF_MS`` — base reconnect backoff in
+                                milliseconds (default 100).  Attempt
+                                k>1 sleeps base * 2^(k-1) with 25 %
+                                deterministic jitter, capped at 5 s;
+                                attempt 1 dials immediately (read
+                                natively).
+- ``MPI4JAX_TPU_HEARTBEAT_S`` — idle-link heartbeat period in seconds
+                                (default 0 = off).  The progress thread
+                                pings links quiet for a full period and
+                                starts recovery on links quiet for
+                                three (half-open peer detection without
+                                traffic; requires the progress thread;
+                                read natively).
+- ``MPI4JAX_TPU_WIRE_CRC``    — CRC32C on frame/control headers:
+                                ``auto`` (default: on exactly when
+                                ``MPI4JAX_TPU_RETRY`` arms the extended
+                                header), ``0`` = off, ``1`` = require
+                                (loud exit when the retry layer is
+                                disarmed, since the unarmed wire has no
+                                CRC slot; read natively).  Payload
+                                bytes are NOT covered — docs/
+                                sharp-bits.md § Self-healing links.
+- ``MPI4JAX_TPU_RETRY_REPLAY_SLACK`` — test-only protocol exerciser:
+                                replay N extra already-delivered frames
+                                on every reconnect so the receiver's
+                                seq dedup provably fires (dup counters
+                                move, digests stay bit-identical;
+                                read natively).
 - ``MPI4JAX_TPU_JOBID``       — unique token for /dev/shm segment names
                                 (the launcher sets a uuid per job; read
                                 natively).
@@ -441,6 +495,11 @@ KNOBS = {
     "MPI4JAX_TPU_LAUNCH_GRACE_S": "launcher teardown grace (seconds)",
     "MPI4JAX_TPU_TEST_TIMEOUT_S": "world-test per-test hard deadline",
     "MPI4JAX_TPU_FAULT": "deterministic native fault injection",
+    "MPI4JAX_TPU_RETRY": "self-healing link retry budget (0 = disarmed)",
+    "MPI4JAX_TPU_RETRY_BACKOFF_MS": "reconnect backoff base (milliseconds)",
+    "MPI4JAX_TPU_HEARTBEAT_S": "idle-link heartbeat period (seconds)",
+    "MPI4JAX_TPU_WIRE_CRC": "header CRC32C: auto/0/1",
+    "MPI4JAX_TPU_RETRY_REPLAY_SLACK": "test-only extra replay frames",
     "MPI4JAX_TPU_JOBID": "unique token for /dev/shm segment names",
     "MPI4JAX_TPU_COLL_ALGO": "force world-tier collective algorithms",
     "MPI4JAX_TPU_COLL_QUANT": "quantized wire formats: allow/deny/force",
@@ -650,6 +709,85 @@ def fault_spec():
     """The raw MPI4JAX_TPU_FAULT spec, or None (parsed/enforced natively)."""
     raw = os.environ.get("MPI4JAX_TPU_FAULT")
     return raw if raw else None
+
+
+def retry_budget() -> int:
+    """Resolved MPI4JAX_TPU_RETRY (reconnect attempts per link failure;
+    default 0 = the self-healing layer is disarmed and the wire is
+    bit-identical to the historic transport).  The knob itself is read
+    natively on every armed path; this mirror serves diag/tooling and
+    must agree with the native parser (strict: the native layer exits
+    on a malformed value, so this must never quietly read it as 0)."""
+    raw = os.environ.get("MPI4JAX_TPU_RETRY")
+    if raw is None or not raw.strip():
+        return 0
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse MPI4JAX_TPU_RETRY={raw!r} as an integer")
+    return max(0, v)
+
+
+def retry_armed() -> bool:
+    """True when the self-healing link layer is armed (retry budget > 0)."""
+    return retry_budget() > 0
+
+
+def retry_backoff_ms() -> float:
+    """Resolved MPI4JAX_TPU_RETRY_BACKOFF_MS (base reconnect backoff,
+    milliseconds; default 100; non-positive restores the default,
+    matching the native parser)."""
+    raw = os.environ.get("MPI4JAX_TPU_RETRY_BACKOFF_MS")
+    if raw is None or not raw.strip():
+        return 100.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse MPI4JAX_TPU_RETRY_BACKOFF_MS={raw!r} as "
+            "milliseconds")
+    return v if v > 0 else 100.0
+
+
+def heartbeat_s() -> float:
+    """Resolved MPI4JAX_TPU_HEARTBEAT_S (idle-link heartbeat period,
+    seconds; default 0.0 = off, matching the native parser)."""
+    return _float_knob("MPI4JAX_TPU_HEARTBEAT_S", 0.0)
+
+
+def wire_crc_mode() -> str:
+    """``MPI4JAX_TPU_WIRE_CRC`` as "auto" | "0" | "1" — the Python
+    mirror of the native parser, byte-for-byte (whitespace-trimmed,
+    loud on anything else).  "auto" resolves to on exactly when
+    :func:`retry_armed`; "1" with the retry layer disarmed makes the
+    native layer exit loudly (the unarmed wire has no CRC slot)."""
+    raw = os.environ.get("MPI4JAX_TPU_WIRE_CRC")
+    if raw is None:
+        return "auto"
+    v = raw.strip()
+    if not v:
+        return "auto"
+    if v in ("auto", "0", "1"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_WIRE_CRC={raw!r} "
+        "(expected auto, 0, or 1)")
+
+
+def retry_replay_slack() -> int:
+    """Resolved MPI4JAX_TPU_RETRY_REPLAY_SLACK (test-only: extra
+    already-delivered frames replayed per reconnect; default 0)."""
+    raw = os.environ.get("MPI4JAX_TPU_RETRY_REPLAY_SLACK")
+    if raw is None or not raw.strip():
+        return 0
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse MPI4JAX_TPU_RETRY_REPLAY_SLACK={raw!r} as "
+            "an integer")
+    return max(0, v)
 
 
 def analyze_timeout_s() -> float:

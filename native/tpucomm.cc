@@ -53,6 +53,7 @@
 #include <cmath>
 #include <condition_variable>
 #include <cstdarg>
+#include <cstddef>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -144,12 +145,98 @@ struct MsgHeader {
   int32_t comm_id;  // communicator the message belongs to (world = 0)
 };
 
+/* ============== self-healing link layer: wire format ==============
+ *
+ * With MPI4JAX_TPU_RETRY > 0 ("armed"), every wire frame header grows
+ * to MsgHeaderX: the plain header as a PREFIX (so MSG_PEEK-based
+ * poison/liveness probes that look at the first 16 bytes keep working
+ * unchanged), then a per-link sequence number, the link's connection
+ * epoch, and a CRC32C over the extended header.  Sequence numbers are
+ * per (link, direction) and count DATA frames only; control frames
+ * (heartbeat ping/pong, poison) carry seq 0 and are out-of-band — they
+ * are never retained, never replayed, and never advance the receiver's
+ * delivery cursor.  All ranks read the same environment, so the wire
+ * format agrees job-wide; MPI4JAX_TPU_RETRY unset/0 keeps the 16-byte
+ * header and the historic byte stream bit-for-bit. */
+struct MsgHeaderX {
+  MsgHeader h;
+  uint32_t seq_lo;  // low/high halves of the 64-bit link sequence
+  uint32_t seq_hi;
+  uint32_t epoch;   // link connection epoch at stamp time
+  uint32_t crc;     // CRC32C of this struct with crc = 0 (when enabled)
+};
+
+/* heartbeat control frames (never visible to user receives) */
+constexpr int32_t kPingTag = -7711;
+constexpr int32_t kPongTag = -7712;
+
+bool retry_armed();          // MPI4JAX_TPU_RETRY > 0
+int64_t wire_hdr_bytes();    // sizeof(MsgHeaderX) when armed, else MsgHeader
+
+/* Reconnect handshake, exchanged raw (not framed) on a fresh socket:
+ * each side identifies itself and reports the last data seq it fully
+ * delivered, so the peer replays exactly the gap.  Always sealed with
+ * CRC32C (control scope — independent of MPI4JAX_TPU_WIRE_CRC). */
+struct ReconnectHello {
+  uint32_t magic;        // kReconnectMagic
+  int32_t rank;          // sender's ROOT (socket-owner) rank
+  int32_t comm_id;       // root comm id, as a cross-job sanity check
+  uint32_t epoch;        // sender's current link epoch
+  uint64_t rx_delivered; // last inbound data seq fully delivered
+  uint32_t crc;
+};
+constexpr uint32_t kReconnectMagic = 0x4d344a52u;  // "M4JR"
+
+struct Comm;
+int link_recover(Comm* c, int peer, int fd_seen, const char* what);
+int link_send_frame(Comm* c, int dest, int tag, const void* p1, int64_t n1,
+                    const void* p2, int64_t n2);
+int link_fd(Comm* c, int peer);
+
+/* One retained (replayable) outbound frame: the complete stamped wire
+ * bytes, header included, so replay is a verbatim rewrite. */
+struct ReplayFrame {
+  uint64_t seq = 0;
+  std::vector<char> bytes;
+};
+
+enum LinkPhase { LINK_UP = 0, LINK_SUSPECT, LINK_RECONNECTING, LINK_DEAD };
+
+/* Per-peer link state, owned by the socket-owning root comm and indexed
+ * by ROOT rank.  `mu` serializes recovery per link (the first thread to
+ * hit a failure reconnects; threads arriving later block on it, then see
+ * the fresh fd and simply retry their frame).  `wmu` serializes whole
+ * FRAMES onto the socket when armed, so a heartbeat pong injected from
+ * the receive path can never interleave with another thread's
+ * header/payload write pair. */
+struct LinkState {
+  std::mutex mu;
+  std::mutex wmu;
+  /* receive-side frame mutex: held across the armed header read and by
+   * a recovery while it rewires the fd, so fd loads on the read side
+   * are synchronized (lock order: mu -> rmu -> wmu) */
+  std::mutex rmu;
+  uint32_t epoch = 1;
+  std::atomic<uint64_t> tx_seq{0};  // last stamped outbound data seq
+  std::atomic<uint64_t> rx_seq{0};  // last fully delivered inbound data seq
+  /* newest outbound data seq with NO retained copy (too large, or
+   * evicted from the ring): a reconnect whose replay gap crosses this
+   * cannot restore the stream and must escalate */
+  std::atomic<uint64_t> hole_seq{0};
+  std::deque<ReplayFrame> ring;  // guarded by wmu (and mu during recovery)
+  int64_t ring_bytes = 0;
+  std::atomic<int> phase{LINK_UP};
+  std::atomic<double> last_rx{0};    // stamp of last inbound bytes seen
+  std::atomic<double> last_ping{0};  // stamp of last heartbeat ping sent
+};
+
 /* One queued outbound message.  The enqueuing op always waits for
  * completion before returning, so `buf` stays valid (zero-copy). */
 struct SendJob {
   int fd = -1;
   int rank = -1;  // enqueuer's rank, for error text
   int dest = -1;
+  Comm* comm = nullptr;  // enqueuing comm (self-healing frame path)
   MsgHeader hdr{};
   const void* buf = nullptr;
   int rc = 0;
@@ -250,6 +337,31 @@ struct Comm {
    * the first queued post; null while every op has run inline. */
   Engine* engine = nullptr;
 
+  /* ---- self-healing link layer (populated only when armed) ---- */
+  /* member rank -> socket-owning root rank, so sub-comms resolve the
+   * one LinkState per physical socket (world: identity; children
+   * compose through the parent's map at split time) */
+  std::vector<int> root_rank;
+  /* per-ROOT-rank link state; lives on the socket owner only */
+  std::vector<std::unique_ptr<LinkState>> links;
+  /* bootstrap listener, kept open for the comm's lifetime when armed so
+   * reconnect dials have somewhere to land (closed at finalize) */
+  int listen_fd = -1;
+  int base_port = 0;
+  std::vector<std::string> real_hosts;  // dialing addresses (not FAKE_HOSTS)
+  /* reconnect dials accepted while the expected acceptor was busy
+   * elsewhere: root rank -> (connected fd, its hello, already read) */
+  std::mutex rcmu;
+  std::map<int, std::pair<int, ReconnectHello>> pending_rc;
+  /* replaced fds parked (shutdown but not closed) until finalize:
+   * closing immediately could hand the fd number to an unrelated open
+   * while another thread is still blocked on it */
+  std::vector<int> dead_fds;
+  /* child comms borrowing these sockets (registered at split, removed
+   * at finalize) so a reconnect can rewire every view of a link */
+  std::mutex kids_mu;
+  std::vector<Comm*> kids;
+
   ~Comm() {
     if (engine) engine_shutdown(engine);  // drains, joins, frees
     if (writer_started) {
@@ -261,6 +373,9 @@ struct Comm {
       writer.join();
     }
     if (arena) arena_destroy(arena);
+    if (listen_fd >= 0) ::close(listen_fd);
+    for (int fd : dead_fds)
+      if (fd >= 0) ::close(fd);
     delete topo;
   }
 };
@@ -347,6 +462,18 @@ int64_t g_obs_total = 0;              // appended since enable (kept + dropped)
 int64_t g_obs_dropped = 0;            // overwritten by overflow
 thread_local double g_obs_wait_acc = 0.0;
 
+/* Self-healing link counters (process totals; see tpucomm_link_counters
+ * in tpucomm.h).  The thread-local accumulator mirrors g_obs_wait_acc:
+ * successful recoveries bump it so ObsScope can stamp the per-op
+ * retries delta on the event that absorbed them. */
+std::atomic<int64_t> g_lc_retries{0};      // recovery events entered
+std::atomic<int64_t> g_lc_reconnects{0};   // successful reconnect handshakes
+std::atomic<int64_t> g_lc_dup_dropped{0};  // duplicate data frames discarded
+std::atomic<int64_t> g_lc_crc_errors{0};   // header/control CRC mismatches
+std::atomic<int64_t> g_lc_replayed{0};     // retained frames retransmitted
+std::atomic<int64_t> g_lc_heartbeats{0};   // idle-link pings sent
+thread_local int64_t g_heal_acc = 0;
+
 /* Transport syscall counter: every socket-moving syscall (write/read/
  * writev/send/recv/poll and io_uring_enter; futex parks excluded — they
  * are scheduling, not wire) bumps it, so events carry a per-op syscall
@@ -381,7 +508,7 @@ void obs_append(const TpuObsEvent& ev) {
 struct ObsScope {
   bool on;
   double t0 = 0, wait0 = 0, post = -1;
-  int64_t sys0 = 0;
+  int64_t sys0 = 0, heal0 = 0;
   TpuObsEvent ev{};
   ObsScope(int op, int peer, int tag, int64_t nbytes, int algo = -1,
            double t_post = -1) {
@@ -394,6 +521,7 @@ struct ObsScope {
     ev.wire_bytes = nbytes;  // exact ops: the wire carries the payload
     ev.algo = algo;
     wait0 = g_obs_wait_acc;
+    heal0 = g_heal_acc;
     sys0 = g_syscalls.load(std::memory_order_relaxed);
     post = t_post;
     t0 = now_s();
@@ -416,6 +544,8 @@ struct ObsScope {
     if (ev.wait_s > ev.dur_s - ev.queue_s) ev.wait_s = ev.dur_s - ev.queue_s;
     int64_t ds = g_syscalls.load(std::memory_order_relaxed) - sys0;
     ev.syscalls = ds > INT32_MAX ? INT32_MAX : (int32_t)(ds < 0 ? 0 : ds);
+    int64_t dh = g_heal_acc - heal0;
+    ev.retries = dh > INT32_MAX ? INT32_MAX : (int32_t)(dh < 0 ? 0 : dh);
     obs_append(ev);
   }
 };
@@ -483,6 +613,138 @@ double connect_timeout_s() {
     return t > 0 ? t : 0.0;
   }();
   return v;
+}
+
+/* ============== self-healing link knobs ==============
+ *
+ * MPI4JAX_TPU_RETRY arms the link layer: wire headers grow to
+ * MsgHeaderX, retained small sends double as a retransmit buffer, and a
+ * failing socket gets up to RETRY reconnect attempts (exponential
+ * backoff from MPI4JAX_TPU_RETRY_BACKOFF_MS, with jitter) before the
+ * failure escalates through the historic poison -> abort -> elastic
+ * path.  0 (the default) keeps today's fail-fast path bit-for-bit.
+ * Strict parsing, same loud contract as every other knob. */
+
+int64_t parse_env_int(const char* name, int64_t dflt) {
+  const char* e = std::getenv(name);
+  if (!e || !e[0]) return dflt;
+  char* end = nullptr;
+  long long v = std::strtoll(e, &end, 10);
+  while (end && (*end == ' ' || *end == '\t')) end++;
+  if (end == e || (end && *end)) {
+    std::fprintf(stderr, "tpucomm: cannot parse %s=%s as an integer\n",
+                 name, e);
+    std::exit(2);
+  }
+  return (int64_t)v;
+}
+
+int64_t retry_budget() {
+  static int64_t v = [] {
+    int64_t n = parse_env_int("MPI4JAX_TPU_RETRY", 0);
+    return n > 0 ? n : 0;
+  }();
+  return v;
+}
+
+bool retry_armed() { return retry_budget() > 0; }
+
+/* Bytes each frame header occupies on the wire under the current arming
+ * (diag/tests cross-check the overhead claim against this). */
+[[maybe_unused]] int64_t wire_hdr_bytes() {
+  return retry_armed() ? (int64_t)sizeof(MsgHeaderX)
+                       : (int64_t)sizeof(MsgHeader);
+}
+
+double retry_backoff_ms() {
+  static double v = [] {
+    double t = parse_env_seconds("MPI4JAX_TPU_RETRY_BACKOFF_MS", 100.0);
+    return t > 0 ? t : 100.0;
+  }();
+  return v;
+}
+
+double heartbeat_s() {
+  static double v = [] {
+    double t = parse_env_seconds("MPI4JAX_TPU_HEARTBEAT_S", 0.0);
+    return t > 0 ? t : 0.0;
+  }();
+  return v;
+}
+
+/* Test-only protocol exerciser: replay N extra already-delivered frames
+ * on every reconnect, so the receiver's dedup layer provably fires
+ * (dup_dropped > 0) while digests stay bit-identical. */
+int64_t replay_slack() {
+  static int64_t v = [] {
+    int64_t n = parse_env_int("MPI4JAX_TPU_RETRY_REPLAY_SLACK", 0);
+    return n > 0 ? n : 0;
+  }();
+  return v;
+}
+
+/* MPI4JAX_TPU_WIRE_CRC = auto (default: on iff the link layer is
+ * armed — the CRC field only exists in the extended header) | 0 | 1.
+ * 1 with RETRY=0 is a spec error: there is no header field to carry
+ * the checksum, so honoring it silently would protect nothing. */
+bool wire_crc_on() {
+  static bool v = [] {
+    const char* e = std::getenv("MPI4JAX_TPU_WIRE_CRC");
+    if (!e || !e[0] || std::strcmp(e, "auto") == 0) return retry_armed();
+    if (std::strcmp(e, "0") == 0) return false;
+    if (std::strcmp(e, "1") == 0) {
+      if (!retry_armed()) {
+        std::fprintf(stderr,
+                     "tpucomm: MPI4JAX_TPU_WIRE_CRC=1 requires "
+                     "MPI4JAX_TPU_RETRY > 0 (the 16-byte legacy header "
+                     "has no checksum field)\n");
+        std::exit(2);
+      }
+      return true;
+    }
+    std::fprintf(stderr,
+                 "tpucomm: cannot parse MPI4JAX_TPU_WIRE_CRC=%s "
+                 "(expected auto|0|1)\n", e);
+    std::exit(2);
+  }();
+  return v;
+}
+
+/* CRC32C (Castagnoli), software table — headers are 32 bytes, so the
+ * table lookup is noise next to the syscall that carries them. */
+uint32_t crc32c(const void* data, size_t n) {
+  static const uint32_t* table = [] {
+    static uint32_t t[256];
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1) ? (0x82f63b78u ^ (c >> 1)) : (c >> 1);
+      t[i] = c;
+    }
+    return t;
+  }();
+  uint32_t c = 0xffffffffu;
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  for (size_t i = 0; i < n; i++) c = table[(c ^ p[i]) & 0xff] ^ (c >> 8);
+  return c ^ 0xffffffffu;
+}
+
+/* Stamp an extended header's CRC field (zeroed during the computation). */
+void hx_seal(MsgHeaderX* hx) {
+  hx->crc = 0;
+  if (wire_crc_on())
+    hx->crc = crc32c(hx, offsetof(MsgHeaderX, crc));
+}
+
+/* Verify a received extended header.  Control payloads are covered by
+ * their own seals; data payloads are NOT covered (documented scope:
+ * large-payload CRC would tax the hot path; header integrity is what
+ * protects stream framing). */
+bool hx_check(const MsgHeaderX* hx) {
+  if (!wire_crc_on()) return true;
+  MsgHeaderX tmp = *hx;
+  tmp.crc = 0;
+  return crc32c(&tmp, offsetof(MsgHeaderX, crc)) == hx->crc;
 }
 
 /* MPI4JAX_TPU_FAKE_HOSTS=r0,r1|r2,r3 — virtual host partition for
@@ -657,7 +919,15 @@ int write_all_dl(int fd, const void* buf, int64_t n) {
  * silently injecting nothing. */
 
 enum FaultPoint { FP_NONE = 0, FP_SEND, FP_RECV, FP_CONNECT };
-enum FaultAction { FA_NONE = 0, FA_HANG, FA_EXIT, FA_CLOSE };
+enum FaultAction {
+  FA_NONE = 0, FA_HANG, FA_EXIT, FA_CLOSE,
+  /* transient link faults (one-shot; the self-healing layer is
+   * expected to absorb them when armed, or the job to abort loudly) */
+  FA_RESET,    // SO_LINGER(0) + close: RST both directions
+  FA_DROP,     // kill the connection mid-frame after `param` bytes
+  FA_DELAY,    // stall the op for `param` milliseconds, then proceed
+  FA_CORRUPT,  // flip a byte in the next wire header after CRC stamping
+};
 
 struct FaultSpec {
   bool armed = false;
@@ -665,9 +935,21 @@ struct FaultSpec {
   int point = FP_NONE;
   long long after = 0;
   int action = FA_NONE;
+  long long param = 0;  // drop: bytes before the RST; delay: milliseconds
   std::atomic<long long> hits{0};
 };
 FaultSpec g_fault;
+
+/* A fired drop/corrupt fault arms this thread-local order for the NEXT
+ * wire frame this thread writes; link_send_frame consumes it.  (The
+ * fire site and the frame writer are the same thread: inline sends,
+ * the writer thread, and the engine drain loop all fire the injector
+ * immediately before building their frame.) */
+struct WireFault {
+  int action = FA_NONE;
+  long long param = 0;
+};
+thread_local WireFault g_wire_fault;
 std::once_flag g_fault_once;
 /* the spec's rank=R is a JOB rank: comm-local ranks diverge on split
  * sub-comms, so injection keys on the rank this process was born with */
@@ -677,7 +959,8 @@ void fault_parse() {
   const char* e = std::getenv("MPI4JAX_TPU_FAULT");
   if (!e || !e[0]) return;
   int rank = -1, point = FP_NONE, action = FA_NONE;
-  long long after = 0;
+  long long after = 0, param = 0;
+  int has_param = 0;
   bool ok = true;
   std::string s(e);
   size_t pos = 0;
@@ -708,6 +991,11 @@ void fault_parse() {
       rank = (int)r;
     } else if (k == "after") {
       parse_ll(v, &after);
+    } else if (k == "bytes" || k == "ms") {
+      /* drop=N bytes before the RST / delay=N milliseconds; validated
+       * against the action below */
+      parse_ll(v, &param);
+      has_param = k == "bytes" ? 1 : 2;
     } else if (k == "point") {
       point = v == "send" ? FP_SEND
               : v == "recv" ? FP_RECV
@@ -718,39 +1006,69 @@ void fault_parse() {
       action = v == "hang" ? FA_HANG
                : v == "exit" ? FA_EXIT
                : v == "close" ? FA_CLOSE
-                              : FA_NONE;
+               : v == "reset" ? FA_RESET
+               : v == "drop" ? FA_DROP
+               : v == "delay" ? FA_DELAY
+               : v == "corrupt" ? FA_CORRUPT
+                                : FA_NONE;
       ok = action != FA_NONE;
     } else {
       ok = false;
     }
   }
+  /* bytes= only modifies drop, ms= only delay (an ignored parameter
+   * would silently test a different fault than the spec says) */
+  if (has_param == 1 && action != FA_DROP) ok = false;
+  if (has_param == 2 && action != FA_DELAY) ok = false;
+  if (has_param && param < 0) ok = false;
   if (!ok || rank < 0 || point == FP_NONE || action == FA_NONE) {
     std::fprintf(stderr,
                  "tpucomm: malformed MPI4JAX_TPU_FAULT spec %s (expected "
                  "rank=R,point=send|recv|connect[,after=N],"
-                 "action=hang|exit|close)\n",
+                 "action=hang|exit|close|reset|drop|delay|corrupt"
+                 "[,bytes=N][,ms=N])\n",
                  e);
     std::exit(2);  // silently injecting nothing would fake a green test
   }
+  if (!has_param) param = action == FA_DROP ? 20 : 100;
   g_fault.rank = rank;
   g_fault.point = point;
   g_fault.after = after;
   g_fault.action = action;
+  g_fault.param = param;
   g_fault.armed = true;
 }
 
 void fault_init() { std::call_once(g_fault_once, fault_parse); }
 
+/* RST the connection: SO_LINGER{on, 0} + close sends a reset instead
+ * of a FIN, so both ends see ECONNRESET — the transient-fault shape
+ * the self-healing layer is built to absorb.  Test-only (the closed fd
+ * number may be reused; real traffic never calls this). */
+void linger_rst(int fd) {
+  struct linger lg{1, 0};
+  ::setsockopt(fd, SOL_SOCKET, SO_LINGER, &lg, sizeof(lg));
+  ::close(fd);
+}
+
 /* Fire the armed fault if (rank, point) match and `after` ops have
- * already passed this point.  `c` may be null at the connect point. */
-void fault_fire(Comm* c, int rank, int point, const char* what) {
+ * already passed this point.  `c` may be null at the connect point.
+ * `fd` is the socket the firing op is about to use (-1 when unknown):
+ * reset kills exactly that connection; drop/corrupt arm a thread-local
+ * order the frame writer consumes. */
+void fault_fire(Comm* c, int rank, int point, const char* what,
+                int fd = -1) {
   if (!g_fault.armed || g_fault.rank != rank || g_fault.point != point)
     return;
   if (g_fault.hits.fetch_add(1, std::memory_order_relaxed) < g_fault.after)
     return;
   const char* action = g_fault.action == FA_HANG ? "hang"
                        : g_fault.action == FA_EXIT ? "exit"
-                                                   : "close";
+                       : g_fault.action == FA_CLOSE ? "close"
+                       : g_fault.action == FA_RESET ? "reset"
+                       : g_fault.action == FA_DROP ? "drop"
+                       : g_fault.action == FA_DELAY ? "delay"
+                                                    : "corrupt";
   std::fprintf(stderr,
                "tpucomm r%d: fault injection: %s at point=%s "
                "(MPI4JAX_TPU_FAULT)\n",
@@ -765,9 +1083,41 @@ void fault_fire(Comm* c, int rank, int point, const char* what) {
       /* shutdown (not close): other threads may hold the fds; all
        * their I/O now fails/EOFs, exactly like a yanked cable */
       if (c)
-        for (int fd : c->lock_root->socks)
-          if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+        for (int fd2 : c->lock_root->socks)
+          if (fd2 >= 0) ::shutdown(fd2, SHUT_RDWR);
       g_fault.armed = false;  // a partition happens once
+      break;
+    case FA_RESET:
+      if (fd >= 0)
+        linger_rst(fd);
+      else if (c)
+        /* no specific socket at this point: reset the whole mesh (the
+         * self-healing layer reconnects each link it touches next) */
+        for (int fd2 : c->lock_root->socks)
+          if (fd2 >= 0) linger_rst(fd2);
+      g_fault.armed = false;  // a transient happens once
+      break;
+    case FA_DROP:
+    case FA_CORRUPT:
+      /* armed for the next frame THIS thread writes; when the link
+       * layer is off there is no frame writer to consume the order, so
+       * degrade to a reset at the fire point — the fault still lands
+       * and the job still fails loudly instead of testing nothing */
+      if (retry_armed()) {
+        g_wire_fault.action = g_fault.action;
+        g_wire_fault.param = g_fault.param;
+      } else if (fd >= 0) {
+        linger_rst(fd);
+      } else if (c) {
+        for (int fd2 : c->lock_root->socks)
+          if (fd2 >= 0) linger_rst(fd2);
+      }
+      g_fault.armed = false;
+      break;
+    case FA_DELAY:
+      std::this_thread::sleep_for(
+          std::chrono::milliseconds(g_fault.param > 0 ? g_fault.param : 100));
+      g_fault.armed = false;
       break;
     default:
       break;
@@ -1489,6 +1839,701 @@ int writev_all_dl(int fd, struct iovec* iov, int iovcnt, int64_t total) {
   return 0;
 }
 
+/* ============== self-healing link layer ==============
+ *
+ * Armed by MPI4JAX_TPU_RETRY > 0.  Every wire frame carries a per-link
+ * sequence number and connection epoch (MsgHeaderX); small frames are
+ * retained in a bounded per-link ring so that when a socket dies
+ * (ECONNRESET / EPIPE / deadline / CRC mismatch) the link reconnects —
+ * the HIGHER root rank dials the LOWER's bootstrap listener, both sides
+ * exchange ReconnectHello{epoch, last_seq_delivered}, the sender
+ * replays exactly the gap, and the receiver drops duplicates by seq —
+ * exactly-once delivery, bit-identical to a fault-free run.  Frames
+ * with no retained copy (rendezvous-large, or evicted) make a replay
+ * infeasible: the link goes DEAD and the failure escalates through the
+ * historic poison -> abort -> elastic path unchanged. */
+
+/* retention caps: a frame above kRetainMaxFrame is never retained
+ * (rendezvous-large: its loss escalates); the per-link ring holds at
+ * most kRetainRing bytes, evicting oldest-first */
+constexpr int64_t kRetainMaxFrame = 256 * 1024;
+constexpr int64_t kRetainRing = 4 * 1024 * 1024;
+
+void link_idle_service(Comm* root);
+
+/* Resolve the LinkState for `peer` of `c` (nullptr when the link layer
+ * is off, for self, or before bootstrap populated the maps).  Sub-comms
+ * resolve through root_rank to the one LinkState per physical socket. */
+LinkState* link_state(Comm* c, int peer, int* out_rp = nullptr) {
+  if (!retry_armed()) return nullptr;
+  if (peer < 0 || peer >= c->size || c->root_rank.empty()) return nullptr;
+  Comm* root = c->lock_root;
+  int rp = c->root_rank[(size_t)peer];
+  if (rp < 0 || rp >= (int)root->links.size() || !root->links[(size_t)rp])
+    return nullptr;
+  if (out_rp) *out_rp = rp;
+  return root->links[(size_t)rp].get();
+}
+
+/* Snapshot the live fd for `peer` (synchronized against a concurrent
+ * reconnect's rewiring via rmu).  -1 while a recovery is mid-flight. */
+int link_fd(Comm* c, int peer) {
+  int rp = -1;
+  LinkState* L = link_state(c, peer, &rp);
+  if (!L) return c->socks[(size_t)peer];
+  std::lock_guard<std::mutex> rl(L->rmu);
+  return c->lock_root->socks[(size_t)rp];
+}
+
+/* Is this I/O failure the transient-link shape a reconnect can absorb?
+ * rc 2 = deadline, 3 = CRC mismatch, 4 = sequence gap (a reconnect
+ * replays from the receiver's cursor, healing the gap or proving it
+ * unhealable), 1 = errno-described socket death. */
+bool io_rc_retryable(int rc) {
+  if (!retry_armed()) return false;
+  if (rc == 2 || rc == 3 || rc == 4) return true;
+  if (rc != 1) return false;
+  switch (errno) {
+    case ECONNRESET:
+    case EPIPE:
+    case ECONNABORTED:
+    case ETIMEDOUT:
+    case EBADF:      // fd parked by a concurrent recovery
+    case ENOTCONN:
+    case EIO:
+      return true;
+    default:
+      return false;
+  }
+}
+
+/* Mark an inbound data frame fully delivered: the dedup cursor the next
+ * ReconnectHello reports.  MUST be called after the payload is entirely
+ * consumed (never before: a replay of a half-read frame would then be
+ * dropped as a duplicate and its bytes lost). */
+void wire_mark_delivered(Comm* c, int source, uint64_t seq) {
+  if (seq == 0) return;
+  LinkState* L = link_state(c, source);
+  if (L) L->rx_seq.store(seq, std::memory_order_relaxed);
+}
+
+/* Write one control frame (ping/pong: seq 0, no payload, no retention).
+ * Bounded at 5 s regardless of the job deadline knob — 32 bytes into a
+ * socket buffer never legitimately blocks longer. */
+int link_send_control(Comm* root, int rp, int tag) {
+  LinkState* L = root->links[(size_t)rp].get();
+  std::lock_guard<std::mutex> wl(L->wmu);
+  int fd = root->socks[(size_t)rp];
+  if (fd < 0) return 1;
+  MsgHeaderX hx{};
+  hx.h = MsgHeader{0, tag, root->comm_id};
+  hx.epoch = L->epoch;
+  hx_seal(&hx);
+  return io_all_deadline<true>(fd, &hx, sizeof(hx), 5.0) == 0 ? 0 : 1;
+}
+
+/* Read one DATA frame header from `source`, transparently servicing
+ * control frames (ping -> pong reply, pong -> liveness stamp) and
+ * dropping replay duplicates (seq <= delivered cursor: payload drained
+ * to scratch, counter bumped).  Legacy (unarmed) callers get the plain
+ * 16-byte read.  Returns 0 with *h / *seq_out / *fd_out filled (payload
+ * reads MUST use *fd_out — the captured fd — not a fresh socks[] load);
+ * 1 errno, 2 deadline, 3 CRC mismatch (errno EBADMSG), 4 sequence gap
+ * (errno EIO).  Poison frames pass through as data (seq 0). */
+int wire_read_hdr(Comm* c, int source, MsgHeader* h, uint64_t* seq_out,
+                  int* fd_out) {
+  int rp = -1;
+  LinkState* L = link_state(c, source, &rp);
+  if (!L) {
+    if (seq_out) *seq_out = 0;
+    if (fd_out) *fd_out = c->socks[(size_t)source];
+    return read_all_dl(c->socks[(size_t)source], h, sizeof(*h));
+  }
+  Comm* root = c->lock_root;
+  thread_local std::vector<char> drain;
+  for (;;) {
+    MsgHeaderX hx{};
+    int fd;
+    int rc;
+    {
+      std::unique_lock<std::mutex> rl(L->rmu);
+      fd = root->socks[(size_t)rp];
+      if (fd < 0) {
+        /* a recovery parked the fd mid-rewire; fail retryably so the
+         * caller joins (blocks on) that recovery and retries */
+        if (fd_out) *fd_out = -1;
+        errno = EBADF;
+        return 1;
+      }
+      rc = read_all_dl(fd, &hx, sizeof(hx));
+      if (rc == 0 && !hx_check(&hx)) {
+        g_lc_crc_errors.fetch_add(1, std::memory_order_relaxed);
+        std::fprintf(stderr,
+                     "tpucomm r%d: self-heal: header CRC mismatch from r%d "
+                     "(wire corruption) — forcing reconnect\n",
+                     root->rank, rp);
+        errno = EBADMSG;
+        rc = 3;
+      }
+      if (rc == 0) {
+        L->last_rx.store(now_s(), std::memory_order_relaxed);
+        if (hx.h.tag == kPingTag && hx.h.nbytes == 0) {
+          rl.unlock();
+          link_send_control(root, rp, kPongTag);  // best-effort
+          continue;
+        }
+        if (hx.h.tag == kPongTag && hx.h.nbytes == 0) continue;
+      }
+    }
+    if (rc != 0) {
+      if (fd_out) *fd_out = fd;
+      return rc;
+    }
+    uint64_t seq = (uint64_t)hx.seq_lo | ((uint64_t)hx.seq_hi << 32);
+    if (seq != 0) {
+      uint64_t rx = L->rx_seq.load(std::memory_order_relaxed);
+      if (seq <= rx) {
+        /* replay overlap: already delivered — drain and drop */
+        g_lc_dup_dropped.fetch_add(1, std::memory_order_relaxed);
+        int64_t left = hx.h.nbytes;
+        if (left > 0 && (int64_t)drain.size() < std::min<int64_t>(left, 1 << 16))
+          drain.resize((size_t)std::min<int64_t>(left, 1 << 16));
+        while (left > 0) {
+          int64_t take = std::min<int64_t>(left, (int64_t)drain.size());
+          int drc = read_all_dl(fd, drain.data(), take);
+          if (drc != 0) {
+            if (fd_out) *fd_out = fd;
+            return drc;
+          }
+          left -= take;
+        }
+        continue;
+      }
+      if (seq != rx + 1) {
+        std::fprintf(stderr,
+                     "tpucomm r%d: self-heal: sequence gap from r%d "
+                     "(expected %llu, got %llu) — forcing reconnect\n",
+                     root->rank, rp, (unsigned long long)(rx + 1),
+                     (unsigned long long)seq);
+        if (fd_out) *fd_out = fd;
+        errno = EIO;
+        return 4;
+      }
+    }
+    *h = hx.h;
+    if (seq_out) *seq_out = seq;
+    if (fd_out) *fd_out = fd;
+    return 0;
+  }
+}
+
+/* Rewire every view of root's link to `rp`: the root's own socks slot
+ * plus each registered child borrowing it.  Called with the link's rmu
+ * AND wmu held (readers/writers load under those), kids_mu taken here. */
+void root_update_fd(Comm* root, int rp, int fd) {
+  root->socks[(size_t)rp] = fd;
+  std::lock_guard<std::mutex> g(root->kids_mu);
+  for (Comm* ch : root->kids) {
+    if (ch->root_rank.empty()) continue;
+    for (int m = 0; m < ch->size; m++)
+      if (m != ch->rank && ch->root_rank[(size_t)m] == rp)
+        ch->socks[(size_t)m] = fd;
+  }
+}
+
+void hello_fill(ReconnectHello* h, Comm* root, LinkState* L) {
+  std::memset(h, 0, sizeof(*h));
+  h->magic = kReconnectMagic;
+  h->rank = root->rank;
+  h->comm_id = root->comm_id;
+  h->epoch = L->epoch;
+  h->rx_delivered = L->rx_seq.load(std::memory_order_relaxed);
+  h->crc = crc32c(h, offsetof(ReconnectHello, crc));
+}
+
+bool hello_ok(const ReconnectHello* h, int expect_rank, int32_t comm_id) {
+  ReconnectHello tmp = *h;
+  tmp.crc = 0;
+  if (crc32c(&tmp, offsetof(ReconnectHello, crc)) != h->crc) return false;
+  if (h->magic != kReconnectMagic || h->comm_id != comm_id) return false;
+  return expect_rank < 0 || h->rank == expect_rank;
+}
+
+/* Nonblocking dial of root rank `rp`'s bootstrap listener with a
+ * deadline; returns a connected blocking-mode fd or -1 (errno set). */
+int link_dial(Comm* root, int rp, double deadline_s) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)(root->base_port + rp));
+  const char* host = root->real_hosts.empty()
+                         ? "127.0.0.1"
+                         : root->real_hosts[(size_t)rp].c_str();
+  ::inet_pton(AF_INET, host, &addr.sin_addr);  // same resolver as bootstrap
+  int fl = ::fcntl(fd, F_GETFL, 0);
+  ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+  int cr = ::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  if (cr != 0 && errno == EINPROGRESS) {
+    pollfd pf{fd, POLLOUT, 0};
+    count_sys();
+    int pr = ::poll(&pf, 1, (int)std::max(deadline_s * 1000.0, 1.0));
+    if (pr > 0) {
+      int soerr = 0;
+      socklen_t sl = sizeof(soerr);
+      ::getsockopt(fd, SOL_SOCKET, SO_ERROR, &soerr, &sl);
+      if (soerr == 0) {
+        cr = 0;
+      } else {
+        errno = soerr;
+        cr = -1;
+      }
+    } else {
+      errno = ETIMEDOUT;
+      cr = -1;
+    }
+  }
+  if (cr != 0) {
+    int e = errno;
+    ::close(fd);
+    errno = e;
+    return -1;
+  }
+  ::fcntl(fd, F_SETFL, fl);  // handshake runs blocking-mode reads/writes
+  return fd;
+}
+
+/* Reconnect the link `c` <-> `peer` after an I/O failure on fd_seen.
+ * Returns 0 when the link is healed (the caller retries its frame: a
+ * retained send was already replayed, a receive restarts at frame
+ * granularity) and 1 when it could not be (link DEAD — the caller
+ * escalates through the historic failure path).  Serialized per link on
+ * L->mu; latecomers seeing a fresher fd than the one they failed on
+ * return healed immediately. */
+int link_recover(Comm* c, int peer, int fd_seen, const char* what) {
+  int rp = -1;
+  LinkState* L = link_state(c, peer, &rp);
+  if (!L) return 1;
+  Comm* root = c->lock_root;
+  std::lock_guard<std::mutex> lk(L->mu);
+  if (L->phase.load(std::memory_order_relaxed) == LINK_DEAD) return 1;
+  {
+    std::lock_guard<std::mutex> rl(L->rmu);
+    int cur = root->socks[(size_t)rp];
+    if (cur >= 0 && cur != fd_seen) {
+      g_heal_acc++;  // healed by the thread that got here first
+      return 0;
+    }
+  }
+  g_lc_retries.fetch_add(1, std::memory_order_relaxed);
+  g_heal_acc++;
+  L->phase.store(LINK_RECONNECTING, std::memory_order_relaxed);
+  const int64_t budget = retry_budget();
+  std::fprintf(stderr,
+               "tpucomm r%d: self-heal: link to r%d failed (%s) — "
+               "reconnecting with replay (epoch %u, budget %lld, "
+               "MPI4JAX_TPU_RETRY)\n",
+               root->rank, rp, what, L->epoch, (long long)budget);
+  std::fflush(stderr);
+  /* retire the old socket: shutdown wakes any thread still blocked on
+   * it; the fd number is parked (closed only at finalize) so a reused
+   * number can never alias a blocked thread's view */
+  {
+    std::lock_guard<std::mutex> rl(L->rmu);
+    std::lock_guard<std::mutex> wl(L->wmu);
+    int old_fd = root->socks[(size_t)rp];
+    if (old_fd >= 0) {
+      ::shutdown(old_fd, SHUT_RDWR);
+      std::lock_guard<std::mutex> g(root->rcmu);
+      root->dead_fds.push_back(old_fd);
+    }
+    root_update_fd(root, rp, -1);
+  }
+  /* hold both frame locks for the whole handshake: in-flight readers
+   * and writers have failed out of them by now (the shutdown above
+   * guarantees progress), and no new frame may touch the wire until
+   * the replay is complete */
+  std::lock_guard<std::mutex> rl(L->rmu);
+  std::lock_guard<std::mutex> wl(L->wmu);
+  const bool dialer = root->rank > rp;  // acceptor = lower rank: it owns
+                                        // the listener (bootstrap topology)
+  /* deterministic per-(rank, link, epoch) jitter: reproducible runs,
+   * decorrelated dial storms */
+  uint32_t jstate =
+      ((uint32_t)root->rank * 2654435761u) ^ ((uint32_t)rp << 16) ^ L->epoch;
+  char reason[160];
+  std::snprintf(reason, sizeof(reason), "budget exhausted");
+  int64_t attempt = 0;
+  for (; attempt < budget; attempt++) {
+    if (attempt > 0) {
+      jstate = jstate * 1664525u + 1013904223u;
+      double base = retry_backoff_ms() * (double)(1 << std::min<int64_t>(attempt - 1, 6));
+      double jit = base * 0.25 * ((jstate >> 8) & 0xff) / 255.0;
+      double ms = std::min(base + jit, 5000.0);
+      std::this_thread::sleep_for(
+          std::chrono::microseconds((long long)(ms * 1000.0)));
+    }
+    const double hs_t =
+        std::min(5.0, std::max(0.25, retry_backoff_ms() / 1000.0 * 4));
+    int nfd = -1;
+    ReconnectHello mine{}, theirs{};
+    hello_fill(&mine, root, L);
+    if (dialer) {
+      nfd = link_dial(root, rp, hs_t);
+      if (nfd < 0) {
+        std::snprintf(reason, sizeof(reason), "dial failed: %s",
+                      std::strerror(errno));
+        continue;
+      }
+      if (io_all_deadline<true>(nfd, &mine, sizeof(mine), hs_t) != 0 ||
+          io_all_deadline<false>(nfd, &theirs, sizeof(theirs), hs_t) != 0 ||
+          !hello_ok(&theirs, rp, root->comm_id)) {
+        std::snprintf(reason, sizeof(reason), "handshake failed");
+        ::close(nfd);
+        nfd = -1;
+        continue;
+      }
+    } else {
+      /* acceptor: a dial may already be stashed by the idle service */
+      {
+        std::lock_guard<std::mutex> g(root->rcmu);
+        auto it = root->pending_rc.find(rp);
+        if (it != root->pending_rc.end()) {
+          nfd = it->second.first;
+          theirs = it->second.second;
+          root->pending_rc.erase(it);
+        }
+      }
+      if (nfd < 0 && root->listen_fd >= 0) {
+        pollfd pf{root->listen_fd, POLLIN, 0};
+        count_sys();
+        int pr = ::poll(&pf, 1, (int)(hs_t * 1000.0));
+        if (pr > 0) {
+          int afd = ::accept(root->listen_fd, nullptr, nullptr);
+          if (afd >= 0) {
+            ReconnectHello hello{};
+            if (io_all_deadline<false>(afd, &hello, sizeof(hello), hs_t) ==
+                    0 &&
+                hello_ok(&hello, -1, root->comm_id) && hello.rank >= 0 &&
+                hello.rank < root->size) {
+              if (hello.rank == rp) {
+                nfd = afd;
+                theirs = hello;
+              } else {
+                /* a DIFFERENT link's dialer: stash for its recovery */
+                std::lock_guard<std::mutex> g(root->rcmu);
+                auto it = root->pending_rc.find(hello.rank);
+                if (it != root->pending_rc.end()) {
+                  ::close(it->second.first);
+                  it->second = {afd, hello};
+                } else {
+                  root->pending_rc[hello.rank] = {afd, hello};
+                }
+              }
+            } else {
+              ::close(afd);
+            }
+          }
+        }
+      }
+      if (nfd < 0) {
+        std::snprintf(reason, sizeof(reason),
+                      "no reconnect dial from peer within the window");
+        continue;
+      }
+      if (io_all_deadline<true>(nfd, &mine, sizeof(mine), hs_t) != 0) {
+        std::snprintf(reason, sizeof(reason), "handshake reply failed");
+        ::close(nfd);
+        nfd = -1;
+        continue;
+      }
+    }
+    /* handshake complete: agree on the epoch, check replay feasibility */
+    uint32_t new_epoch = std::max(L->epoch, theirs.epoch) + 1;
+    uint64_t prx = theirs.rx_delivered;
+    if (L->hole_seq.load(std::memory_order_relaxed) > prx) {
+      std::snprintf(reason, sizeof(reason),
+                    "replay infeasible: peer delivered through seq %llu but "
+                    "the oldest retained frame starts after %llu "
+                    "(rendezvous-large or evicted sends cannot replay)",
+                    (unsigned long long)prx,
+                    (unsigned long long)
+                        L->hole_seq.load(std::memory_order_relaxed));
+      ::close(nfd);
+      break;  // a reconnect cannot fix this: escalate now
+    }
+    /* trim acknowledged frames (keeping replay_slack() extras so the
+     * dedup path is exercisable on demand), then replay the gap */
+    uint64_t from = prx;
+    int64_t slack = replay_slack();
+    while (slack > 0 && from > 0) {
+      from--;
+      slack--;
+    }
+    while (!L->ring.empty() && L->ring.front().seq <= from) {
+      L->ring_bytes -= (int64_t)L->ring.front().bytes.size();
+      L->ring.pop_front();
+    }
+    int64_t replayed = 0;
+    int rrc = 0;
+    for (const ReplayFrame& rf : L->ring) {
+      if (rf.seq <= from) continue;
+      rrc = io_all_deadline<true>(nfd, const_cast<char*>(rf.bytes.data()),
+                                  (int64_t)rf.bytes.size(),
+                                  std::max(hs_t, 5.0));
+      if (rrc != 0) break;
+      replayed++;
+    }
+    if (rrc != 0) {
+      std::snprintf(reason, sizeof(reason), "replay write failed: %s",
+                    std::strerror(errno));
+      ::close(nfd);
+      continue;
+    }
+    /* install: TCP options to match bootstrap, rewire every view */
+    int one = 1;
+    ::setsockopt(nfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    if (transport_timeout_s() > 0 || uring_ready()) {
+      int fl = ::fcntl(nfd, F_GETFL, 0);
+      ::fcntl(nfd, F_SETFL, fl | O_NONBLOCK);
+    }
+    root_update_fd(root, rp, nfd);
+    L->epoch = new_epoch;
+    L->phase.store(LINK_UP, std::memory_order_relaxed);
+    L->last_rx.store(now_s(), std::memory_order_relaxed);
+    L->last_ping.store(0, std::memory_order_relaxed);
+    g_lc_reconnects.fetch_add(1, std::memory_order_relaxed);
+    g_lc_replayed.fetch_add(replayed, std::memory_order_relaxed);
+    std::fprintf(stderr,
+                 "tpucomm r%d: self-heal: link to r%d recovered (epoch %u, "
+                 "replayed %lld frames, %lld dups dropped) [attempt "
+                 "%lld/%lld]\n",
+                 root->rank, rp, new_epoch, (long long)replayed,
+                 (long long)g_lc_dup_dropped.load(std::memory_order_relaxed),
+                 (long long)(attempt + 1), (long long)budget);
+    std::fflush(stderr);
+    return 0;
+  }
+  L->phase.store(LINK_DEAD, std::memory_order_relaxed);
+  std::fprintf(stderr,
+               "tpucomm r%d: self-heal: link to r%d DEAD after %lld "
+               "attempt(s): %s — escalating (poison -> abort -> elastic)\n",
+               root->rank, rp, (long long)std::max<int64_t>(attempt, 1),
+               reason);
+  std::fflush(stderr);
+  return 1;
+}
+
+/* Write one DATA frame (header + up to two payload spans) to `dest`,
+ * stamping seq/epoch/CRC, retaining small frames for replay, consuming
+ * a pending wire-fault order, and healing retryable failures in place.
+ * This is THE armed send path: every frame writer routes here so seq
+ * assignment and socket writes stay atomic per link (wmu).  Unarmed
+ * comms get the historic header+payload writes, byte-identical. */
+int link_send_frame(Comm* c, int dest, int tag, const void* p1, int64_t n1,
+                    const void* p2, int64_t n2) {
+  const int64_t payload = n1 + n2;
+  int rp = -1;
+  LinkState* L = link_state(c, dest, &rp);
+  if (!L) {
+    MsgHeader h{payload, tag, c->comm_id};
+    int fd = c->socks[(size_t)dest];
+    int rc = write_all_dl(fd, &h, sizeof(h));
+    if (rc == 0 && n1 > 0) rc = write_all_dl(fd, p1, n1);
+    if (rc == 0 && n2 > 0) rc = write_all_dl(fd, p2, n2);
+    return rc;
+  }
+  Comm* root = c->lock_root;
+  for (;;) {
+    int rc;
+    int fd;
+    bool retained = false;
+    {
+      std::unique_lock<std::mutex> wl(L->wmu);
+      fd = root->socks[(size_t)rp];
+      if (fd < 0) {
+        /* a recovery is rewiring the link: join it and retry */
+        wl.unlock();
+        if (link_recover(c, dest, -1, "send (link down)") == 0) continue;
+        errno = ECONNRESET;
+        return 1;
+      }
+      MsgHeaderX hx{};
+      hx.h = MsgHeader{payload, tag, c->comm_id};
+      hx.epoch = L->epoch;
+      uint64_t seq = L->tx_seq.load(std::memory_order_relaxed) + 1;
+      L->tx_seq.store(seq, std::memory_order_relaxed);
+      hx.seq_lo = (uint32_t)(seq & 0xffffffffu);
+      hx.seq_hi = (uint32_t)(seq >> 32);
+      hx_seal(&hx);
+      WireFault wf = g_wire_fault;
+      g_wire_fault = WireFault{};
+      const int64_t frame_bytes = (int64_t)sizeof(hx) + payload;
+      if (frame_bytes <= kRetainMaxFrame) {
+        /* retain the GOOD frame (a corrupt order flips only the wire
+         * copy below, so replay restores the true bytes) */
+        ReplayFrame rf;
+        rf.seq = seq;
+        rf.bytes.resize((size_t)frame_bytes);
+        std::memcpy(rf.bytes.data(), &hx, sizeof(hx));
+        if (n1 > 0) std::memcpy(rf.bytes.data() + sizeof(hx), p1, (size_t)n1);
+        if (n2 > 0)
+          std::memcpy(rf.bytes.data() + sizeof(hx) + n1, p2, (size_t)n2);
+        L->ring.push_back(std::move(rf));
+        L->ring_bytes += frame_bytes;
+        while (L->ring_bytes > kRetainRing && !L->ring.empty()) {
+          uint64_t ev = L->ring.front().seq;
+          uint64_t hole = L->hole_seq.load(std::memory_order_relaxed);
+          if (ev > hole) L->hole_seq.store(ev, std::memory_order_relaxed);
+          L->ring_bytes -= (int64_t)L->ring.front().bytes.size();
+          L->ring.pop_front();
+        }
+        retained = true;
+      } else {
+        uint64_t hole = L->hole_seq.load(std::memory_order_relaxed);
+        if (seq > hole) L->hole_seq.store(seq, std::memory_order_relaxed);
+      }
+      if (wf.action == FA_CORRUPT) {
+        /* flip a header byte AFTER sealing: the receiver's CRC check
+         * must catch it (that is the injected failure) */
+        MsgHeaderX bad = hx;
+        reinterpret_cast<char*>(&bad)[5] ^= 0x40;
+        rc = write_all_dl(fd, &bad, sizeof(bad));
+        if (rc == 0 && n1 > 0) rc = write_all_dl(fd, p1, n1);
+        if (rc == 0 && n2 > 0) rc = write_all_dl(fd, p2, n2);
+        /* the bytes landed but the peer will reject them; force our own
+         * side into recovery so both ends converge on a fresh epoch */
+        if (rc == 0) {
+          errno = EBADMSG;
+          rc = 3;
+        }
+      } else if (wf.action == FA_DROP) {
+        int64_t keep = std::min<int64_t>(
+            wf.param, retained ? (int64_t)L->ring.back().bytes.size()
+                               : (int64_t)sizeof(hx));
+        const char* src = retained
+                              ? L->ring.back().bytes.data()
+                              : reinterpret_cast<const char*>(&hx);
+        if (keep > 0) (void)write_all_dl(fd, src, keep);
+        linger_rst(fd);  // mid-frame kill: the heal below replays it
+        errno = ECONNRESET;
+        rc = 1;
+      } else if (retained) {
+        const ReplayFrame& rf = L->ring.back();
+        rc = write_all_dl(fd, rf.bytes.data(), (int64_t)rf.bytes.size());
+      } else {
+        struct iovec iov[3];
+        int cnt = 0;
+        iov[cnt++] = {&hx, sizeof(hx)};
+        if (n1 > 0) iov[cnt++] = {const_cast<void*>(p1), (size_t)n1};
+        if (n2 > 0) iov[cnt++] = {const_cast<void*>(p2), (size_t)n2};
+        rc = writev_all_dl(fd, iov, cnt, (int64_t)sizeof(hx) + payload);
+      }
+    }
+    if (rc == 0) return 0;
+    if (!io_rc_retryable(rc)) return rc;
+    int erc = rc;
+    int esave = errno;
+    if (link_recover(c, dest, fd, "send") == 0) {
+      /* healed.  A retained frame was replayed (or confirmed delivered)
+       * by the handshake; an unretained frame only reaches here when
+       * the peer confirmed full delivery (otherwise the replay gap
+       * crossed its hole and recovery escalated). */
+      return 0;
+    }
+    errno = esave;
+    return erc;
+  }
+}
+
+/* Idle-time service, run from the engine's drain loop when the queue is
+ * empty (~10 Hz): accepts and stashes reconnect dials so a busy
+ * acceptor never strands a dialer, and drives heartbeats over idle
+ * links (MPI4JAX_TPU_HEARTBEAT_S > 0).  All lock acquisition is
+ * try-only — this must never stall the progress thread. */
+void link_idle_service(Comm* root) {
+  if (!retry_armed() || root->links.empty()) return;
+  /* (a) accept + stash reconnect dials (no comm lock needed: only the
+   * rcmu-guarded stash is touched) */
+  if (root->listen_fd >= 0) {
+    for (;;) {
+      pollfd pf{root->listen_fd, POLLIN, 0};
+      if (::poll(&pf, 1, 0) <= 0) break;
+      int afd = ::accept(root->listen_fd, nullptr, nullptr);
+      if (afd < 0) break;
+      ReconnectHello hello{};
+      if (io_all_deadline<false>(afd, &hello, sizeof(hello), 2.0) != 0 ||
+          !hello_ok(&hello, -1, root->comm_id) || hello.rank < 0 ||
+          hello.rank >= root->size) {
+        ::close(afd);
+        continue;
+      }
+      std::lock_guard<std::mutex> g(root->rcmu);
+      auto it = root->pending_rc.find(hello.rank);
+      if (it != root->pending_rc.end()) {
+        ::close(it->second.first);
+        it->second = {afd, hello};
+      } else {
+        root->pending_rc[hello.rank] = {afd, hello};
+      }
+    }
+  }
+  /* (b) heartbeats: ping links idle past the knob, recover links silent
+   * past two windows after a ping */
+  const double hb = heartbeat_s();
+  if (hb <= 0) return;
+  std::unique_lock<std::mutex> cl(root->mu, std::try_to_lock);
+  if (!cl.owns_lock()) return;  // an op is running: the wire is live
+  const double now = now_s();
+  for (int rp = 0; rp < (int)root->links.size(); rp++) {
+    LinkState* L = root->links[(size_t)rp].get();
+    if (!L || L->phase.load(std::memory_order_relaxed) != LINK_UP) continue;
+    int fd;
+    {
+      std::unique_lock<std::mutex> rl(L->rmu, std::try_to_lock);
+      if (!rl.owns_lock()) continue;
+      fd = root->socks[(size_t)rp];
+      if (fd < 0) continue;
+      /* consume control replies queued on the idle socket (peek first:
+       * data frames must stay for the op path) */
+      for (;;) {
+        MsgHeaderX hx{};
+        ssize_t p = ::recv(fd, &hx, sizeof(hx), MSG_PEEK | MSG_DONTWAIT);
+        if (p < (ssize_t)sizeof(hx)) {
+          if (p > 0) L->last_rx.store(now, std::memory_order_relaxed);
+          break;
+        }
+        L->last_rx.store(now, std::memory_order_relaxed);
+        if (!hx_check(&hx)) break;  // op path owns CRC failures
+        if ((hx.h.tag != kPingTag && hx.h.tag != kPongTag) ||
+            hx.h.nbytes != 0)
+          break;  // data frame: leave it for the op path
+        ::recv(fd, &hx, sizeof(hx), MSG_DONTWAIT);  // consume control
+        if (hx.h.tag == kPingTag) {
+          rl.unlock();
+          link_send_control(root, rp, kPongTag);
+          rl.lock();
+        }
+      }
+    }
+    const double last_rx = L->last_rx.load(std::memory_order_relaxed);
+    const double last_ping = L->last_ping.load(std::memory_order_relaxed);
+    if (now - last_rx > hb && now - last_ping > hb) {
+      if (link_send_control(root, rp, kPingTag) == 0) {
+        L->last_ping.store(now, std::memory_order_relaxed);
+        g_lc_heartbeats.fetch_add(1, std::memory_order_relaxed);
+      } else {
+        (void)link_recover(root, rp, fd, "heartbeat send failed");
+        continue;
+      }
+    }
+    if (last_ping > last_rx && now - last_ping > 2 * hb)
+      (void)link_recover(root, rp, fd, "heartbeat timeout (no pong)");
+  }
+}
+
 /* ============== job-wide abort propagation (poison frames) ==============
  *
  * When this process aborts (any FAIL surfacing to the Python bridge),
@@ -1511,7 +2556,10 @@ int poison_fail_pre(Comm* c, int source, const MsgHeader& h,
   if (take > 0) std::memcpy(text, pre, (size_t)take);
   /* best effort: the aborter shuts the socket down right after the
    * frame, so a partial payload ends in EOF, not a hang */
-  if (nb > take) read_all_dl(c->socks[source], text + take, nb - take);
+  if (nb > take) {
+    int pfd = retry_armed() ? link_fd(c, source) : c->socks[source];
+    if (pfd >= 0) read_all_dl(pfd, text + take, nb - take);
+  }
   text[sizeof(text) - 1] = 0;
   FAIL(c, "rank %d aborted the job: %s", source,
        text[0] ? text : "(no detail)");
@@ -1529,6 +2577,16 @@ void self_deliver(Comm* c, int tag, const void* buf, int64_t nbytes) {
 
 int send_msg_tcp(Comm* c, int dest, int tag, const void* buf,
                  int64_t nbytes) {
+  if (retry_armed()) {
+    /* armed path: every frame goes through the link layer (seq/epoch
+     * stamp, retention, heal-in-place).  The uring staged-small fast
+     * path is bypassed — classic writes still ride uring inside
+     * io_all_deadline, but frame assembly must be the link layer's. */
+    fault_fire(c, g_job_rank, FP_SEND, "send", link_fd(c, dest));
+    int arc = link_send_frame(c, dest, tag, buf, nbytes, nullptr, 0);
+    if (arc) FAIL_IO(c, arc, "send to %d", dest);
+    return 0;
+  }
   fault_fire(c, g_job_rank, FP_SEND, "send");
   MsgHeader h{nbytes, tag, c->comm_id};
   int rc;
@@ -1573,10 +2631,17 @@ void writer_loop(Comm* root) {
      * point=send fault must be able to wedge/kill big transfers too
      * (hang here hangs the whole rank: wait_send then never returns,
      * which is exactly the wedged-peer shape the deadlines detect) */
-    fault_fire(nullptr, g_job_rank, FP_SEND, "send");
+    fault_fire(nullptr, g_job_rank, FP_SEND, "send", j->fd);
     int rc = 0;
-    int io = write_all_dl(j->fd, &j->hdr, sizeof(j->hdr));
-    if (!io) io = write_all_dl(j->fd, j->buf, j->hdr.nbytes);
+    int io;
+    if (retry_armed() && j->comm) {
+      /* armed: the link layer stamps, (maybe) retains, and heals */
+      io = link_send_frame(j->comm, j->dest, j->hdr.tag, j->buf,
+                           j->hdr.nbytes, nullptr, 0);
+    } else {
+      io = write_all_dl(j->fd, &j->hdr, sizeof(j->hdr));
+      if (!io) io = write_all_dl(j->fd, j->buf, j->hdr.nbytes);
+    }
     if (io) {
       /* wait_send is an unbounded cv wait — this deadline is what keeps
        * it bounded when the peer stops draining the socket */
@@ -1642,9 +2707,10 @@ int async_send(Comm* c, SendJob* job, int dest, int tag, const void* buf,
     job->done = true;
     return 0;
   }
-  job->fd = c->socks[dest];
+  job->fd = retry_armed() ? link_fd(c, dest) : c->socks[dest];
   job->rank = c->rank;
   job->dest = dest;
+  job->comm = retry_armed() ? c : nullptr;
   job->hdr = MsgHeader{nbytes, tag, c->comm_id};
   job->buf = buf;
   job->rc = 0;
@@ -1707,10 +2773,17 @@ bool header_matches(const Comm* c, const MsgHeader& h, int tag) {
 /* `pre`/`pre_len` hand over container bytes a speculative uring receive
  * already pulled off the socket (consumed before any further socket
  * reads — arrival order is preserved exactly). */
+/* Armed callers pass the captured frame fd (`frame_fd` >= 0): an I/O
+ * failure mid-container then returns the soft sentinel 5 with the real
+ * rc stashed in g_stage_soft_rc, so the caller can roll back the staged
+ * sub-messages and heal the link — the whole container was retained by
+ * the sender and replays verbatim. */
+thread_local int g_stage_soft_rc = 0;
 int stage_coalesced_pre(Comm* c, int source, const MsgHeader& outer, int tag,
                         void* buf, int64_t nbytes, int32_t* out_tag,
                         int64_t* out_count, bool* consumed,
-                        const char* pre, int64_t pre_len) {
+                        const char* pre, int64_t pre_len,
+                        int frame_fd = -1) {
   if (consumed) *consumed = false;
   int64_t pre_off = 0;
   auto rd = [&](void* dst, int64_t n) -> int {
@@ -1722,7 +2795,8 @@ int stage_coalesced_pre(Comm* c, int source, const MsgHeader& outer, int tag,
       d += take;
       n -= take;
     }
-    return n > 0 ? read_all_dl(c->socks[source], d, n) : 0;
+    if (n <= 0) return 0;
+    return read_all_dl(frame_fd >= 0 ? frame_fd : c->socks[source], d, n);
   };
   int64_t remaining = outer.nbytes;
   bool first = true;
@@ -1732,7 +2806,13 @@ int stage_coalesced_pre(Comm* c, int source, const MsgHeader& outer, int tag,
       FAIL(c, "corrupt coalesced frame from rank %d (%lld trailing bytes)",
            source, (long long)remaining);
     int rc = rd(&sh, sizeof(sh));
-    if (rc) FAIL_IO(c, rc, "recv coalesced header from %d", source);
+    if (rc) {
+      if (frame_fd >= 0 && io_rc_retryable(rc)) {
+        g_stage_soft_rc = rc;
+        return 5;
+      }
+      FAIL_IO(c, rc, "recv coalesced header from %d", source);
+    }
     remaining -= sizeof(sh);
     if (sh.comm_id != c->comm_id || sh.nbytes < 0 || sh.nbytes > remaining)
       FAIL(c, "corrupt coalesced sub-message from rank %d (comm %d, %lld "
@@ -1743,7 +2823,13 @@ int stage_coalesced_pre(Comm* c, int source, const MsgHeader& outer, int tag,
       /* pre-posted receive: land the head message straight in the user
        * buffer instead of staging it */
       rc = rd(buf, sh.nbytes);
-      if (rc) FAIL_IO(c, rc, "recv coalesced payload from %d", source);
+      if (rc) {
+        if (frame_fd >= 0 && io_rc_retryable(rc)) {
+          g_stage_soft_rc = rc;
+          return 5;
+        }
+        FAIL_IO(c, rc, "recv coalesced payload from %d", source);
+      }
       if (out_tag) *out_tag = sh.tag;
       if (out_count) *out_count = sh.nbytes;
       *consumed = true;
@@ -1753,7 +2839,13 @@ int stage_coalesced_pre(Comm* c, int source, const MsgHeader& outer, int tag,
       m.data.resize((size_t)sh.nbytes);
       if (sh.nbytes > 0) {
         rc = rd(m.data.data(), sh.nbytes);
-        if (rc) FAIL_IO(c, rc, "recv coalesced payload from %d", source);
+        if (rc) {
+          if (frame_fd >= 0 && io_rc_retryable(rc)) {
+            g_stage_soft_rc = rc;
+            return 5;
+          }
+          FAIL_IO(c, rc, "recv coalesced payload from %d", source);
+        }
       }
       c->pending[source].push_back(std::move(m));
     }
@@ -1817,12 +2909,14 @@ const MsgHeader* pending_head(Comm* c, int source) {
  * whose next frame does NOT match can never satisfy this wildcard (its
  * head cannot be consumed while we hold the comm lock) and is dropped
  * from the candidate set, as are peers that exited cleanly. */
-int poll_any_source(Comm* c, int tag, int* out_source) {
+int poll_any_source_once(Comm* c, int tag, int* out_source) {
+  const bool armed = retry_armed() && !c->root_rank.empty();
   std::vector<pollfd> fds;
   std::vector<int> ranks;
   for (int r = 0; r < c->size; r++) {
-    if (c->socks[r] < 0) continue;
-    fds.push_back({c->socks[r], POLLIN, 0});
+    int fd = armed ? link_fd(c, r) : c->socks[r];
+    if (fd < 0) continue;
+    fds.push_back({fd, POLLIN, 0});
     ranks.push_back(r);
   }
   if (fds.empty()) FAIL(c, "ANY_SOURCE recv with no peers");
@@ -1851,7 +2945,143 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
     bool progress = false;
     std::vector<size_t> dead;
     for (size_t i = 0; i < fds.size(); i++) {
-      if (fds[i].revents & POLLIN) {
+      if (!(fds[i].revents & POLLIN)) {
+        if (fds[i].revents & (POLLHUP | POLLERR)) {
+          if (armed &&
+              link_recover(c, ranks[i], fds[i].fd, "ANY_SOURCE poll") == 0)
+            return -2;  // healed: rebuild the candidate set
+          dead.push_back(i);
+        }
+        continue;
+      }
+      if (armed) {
+        /* armed wire format: peek the 32-byte extended header, service
+         * control frames and replay duplicates in place, and heal a
+         * failing candidate instead of writing it off */
+        MsgHeaderX hx{};
+        count_sys();
+        ssize_t p = ::recv(fds[i].fd, &hx, sizeof(hx),
+                           MSG_PEEK | MSG_DONTWAIT);
+        if (p == (ssize_t)sizeof(hx)) {
+          LinkState* L = link_state(c, ranks[i]);
+          if (L) L->last_rx.store(now_s(), std::memory_order_relaxed);
+          if (!hx_check(&hx)) {
+            g_lc_crc_errors.fetch_add(1, std::memory_order_relaxed);
+            errno = EBADMSG;
+            if (link_recover(c, ranks[i], fds[i].fd,
+                             "ANY_SOURCE header CRC") == 0)
+              return -2;
+            FAIL(c, "header CRC mismatch from rank %d (wire corruption)",
+                 ranks[i]);
+          }
+          uint64_t seq = (uint64_t)hx.seq_lo | ((uint64_t)hx.seq_hi << 32);
+          if ((hx.h.tag == kPingTag || hx.h.tag == kPongTag) &&
+              hx.h.nbytes == 0) {
+            ::recv(fds[i].fd, &hx, sizeof(hx), MSG_DONTWAIT);
+            if (hx.h.tag == kPingTag && L)
+              link_send_control(c->lock_root, c->root_rank[(size_t)ranks[i]],
+                                kPongTag);
+            progress = true;
+            continue;
+          }
+          if (hx.h.tag == kPoisonTag) {
+            ::recv(fds[i].fd, &hx, sizeof(hx), MSG_DONTWAIT);  // consume
+            return poison_fail(c, ranks[i], hx.h);
+          }
+          if (seq != 0 && L &&
+              seq <= L->rx_seq.load(std::memory_order_relaxed)) {
+            /* replay duplicate at the head: consume and drop it */
+            ::recv(fds[i].fd, &hx, sizeof(hx), MSG_DONTWAIT);
+            g_lc_dup_dropped.fetch_add(1, std::memory_order_relaxed);
+            thread_local std::vector<char> drain;
+            int64_t left = hx.h.nbytes;
+            if (left > 0 && (int64_t)drain.size() <
+                                std::min<int64_t>(left, 1 << 16))
+              drain.resize((size_t)std::min<int64_t>(left, 1 << 16));
+            int drc = 0;
+            while (left > 0 && drc == 0) {
+              int64_t take = std::min<int64_t>(left, (int64_t)drain.size());
+              drc = read_all_dl(fds[i].fd, drain.data(), take);
+              left -= take;
+            }
+            if (drc) {
+              if (io_rc_retryable(drc) &&
+                  link_recover(c, ranks[i], fds[i].fd,
+                               "ANY_SOURCE dup drain") == 0)
+                return -2;
+              FAIL_IO(c, drc, "recv payload from %d", ranks[i]);
+            }
+            progress = true;
+            continue;
+          }
+          if (seq != 0 && L &&
+              seq != L->rx_seq.load(std::memory_order_relaxed) + 1) {
+            errno = EIO;
+            if (link_recover(c, ranks[i], fds[i].fd,
+                             "ANY_SOURCE sequence gap") == 0)
+              return -2;
+            FAIL(c, "sequence gap from rank %d", ranks[i]);
+          }
+          if (hx.h.tag == kCoalescedTag && hx.h.comm_id == c->comm_id) {
+            MsgHeaderX outer{};
+            int orc = read_all_dl(fds[i].fd, &outer, sizeof(outer));
+            if (orc) {
+              if (io_rc_retryable(orc) &&
+                  link_recover(c, ranks[i], fds[i].fd,
+                               "ANY_SOURCE coalesced header") == 0)
+                return -2;
+              FAIL(c, "recv coalesced header from %d failed: %s", ranks[i],
+                   std::strerror(errno));
+            }
+            size_t staged0 = 0;
+            {
+              auto it = c->pending.find(ranks[i]);
+              if (it != c->pending.end()) staged0 = it->second.size();
+            }
+            int src = stage_coalesced_pre(c, ranks[i], outer.h, kAnyTag,
+                                          nullptr, 0, nullptr, nullptr,
+                                          nullptr, nullptr, 0, fds[i].fd);
+            if (src == 5) {
+              auto it = c->pending.find(ranks[i]);
+              if (it != c->pending.end()) {
+                while (it->second.size() > staged0) it->second.pop_back();
+                if (it->second.empty()) c->pending.erase(it);
+              }
+              if (link_recover(c, ranks[i], fds[i].fd,
+                               "ANY_SOURCE coalesced") == 0)
+                return -2;
+              FAIL_IO(c, g_stage_soft_rc, "recv coalesced payload from %d",
+                      ranks[i]);
+            }
+            if (src) return 1;
+            wire_mark_delivered(c, ranks[i], seq);
+            const MsgHeader* ph = pending_head(c, ranks[i]);
+            if (ph && (tag == kAnyTag || ph->tag == tag)) {
+              *out_source = ranks[i];
+              return 0;
+            }
+            dead.push_back(i);  // staged head can never match
+            continue;
+          }
+          if (header_matches(c, hx.h, tag)) {
+            *out_source = ranks[i];
+            return 0;
+          }
+          dead.push_back(i);  // head frame can never match this wildcard
+        } else if (p == 0 || (p < 0 && errno != EAGAIN &&
+                              errno != EWOULDBLOCK && errno != EINTR)) {
+          if (p == 0) errno = ECONNRESET;
+          if (io_rc_retryable(1) &&
+              link_recover(c, ranks[i], fds[i].fd, "ANY_SOURCE peek") == 0)
+            return -2;
+          dead.push_back(i);
+        } else if (p > 0 && (int64_t)p > peeked[i]) {
+          peeked[i] = p;
+          progress = true;
+        }
+        continue;
+      }
+      {
         /* POLLIN also fires for EOF; peek the header to tell a real
          * matching frame from a mismatch or a peer that exited */
         MsgHeader h{};
@@ -1894,8 +3124,6 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
           peeked[i] = p;  // header still arriving: real byte progress
           progress = true;
         }
-      } else if (fds[i].revents & (POLLHUP | POLLERR)) {
-        dead.push_back(i);
       }
     }
     if (t > 0) {
@@ -1922,6 +3150,14 @@ int poll_any_source(Comm* c, int tag, int* out_source) {
     if (fds.empty())
       FAIL(c, "ANY_SOURCE recv: no peer can deliver a matching message "
            "(all disconnected, mismatched, or on other communicators)");
+  }
+}
+
+int poll_any_source(Comm* c, int tag, int* out_source) {
+  for (;;) {
+    int rc = poll_any_source_once(c, tag, out_source);
+    if (rc != -2) return rc;  // -2: a link healed mid-poll — restart with
+                              // fresh fds (the candidate set was rewired)
   }
 }
 
@@ -2068,48 +3304,90 @@ int recv_msg_status(Comm* c, int source, int tag, void* buf, int64_t nbytes,
     return shm_recv_status(c, source, tag, buf, nbytes, out_src, out_tag,
                            out_count);
   Uring* u;
-  if (strict_exact && tag != kAnyTag && nbytes > 0 &&
+  if (strict_exact && !retry_armed() && tag != kAnyTag && nbytes > 0 &&
       nbytes <= kUringSmall && (u = uring_acquire()) != nullptr)
     /* strict exact-size receive (recv_msg says so EXPLICITLY — a
      * status caller passing null src/tag still keeps legal
      * short-message semantics): one speculative submission pulls the
-     * whole frame (see uring_recv_frame) */
+     * whole frame (see uring_recv_frame).  Gated off when the link
+     * layer is armed: speculative over-pulls cannot be rolled back at
+     * frame granularity, which replay-after-reconnect requires (classic
+     * reads still ride uring inside io_all_deadline). */
     return uring_recv_frame(c, u, source, tag, buf, nbytes, out_count);
   if (out_src) *out_src = source;
   MsgHeader h{};
+  uint64_t seq = 0;
+  int ffd = -1;
   int rc;
-  {
-    /* header arrival is the wait phase: the sender hasn't reached (or
-     * hasn't finished) the matching send until these bytes appear */
-    ObsWaitTimer wt;
-    rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  for (;;) {
+    {
+      /* header arrival is the wait phase: the sender hasn't reached (or
+       * hasn't finished) the matching send until these bytes appear */
+      ObsWaitTimer wt;
+      rc = wire_read_hdr(c, source, &h, &seq, &ffd);
+    }
+    if (rc) {
+      /* transient link death with the layer armed: reconnect + replay,
+       * then restart this receive at frame granularity (nothing of the
+       * failed frame was delivered — delivery marks only run below) */
+      if (io_rc_retryable(rc) &&
+          link_recover(c, source, ffd, "recv header") == 0)
+        continue;
+      FAIL_IO(c, rc, "recv header from %d", source);
+    }
+    if (h.tag == kPoisonTag) return poison_fail(c, source, h);
+    if (h.comm_id != c->comm_id)
+      FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
+           "is comm %d — ops on sibling communicators must run in a "
+           "consistent order on both endpoints", source, h.comm_id,
+           c->comm_id);
+    if (h.tag == kCoalescedTag) {
+      /* split the container: the first sub-message lands directly in this
+       * posted receive when it matches; the rest stage for later recvs */
+      size_t staged0 = 0;
+      {
+        auto it = c->pending.find(source);
+        if (it != c->pending.end()) staged0 = it->second.size();
+      }
+      bool consumed = false;
+      int src = stage_coalesced_pre(c, source, h, tag, buf, nbytes, out_tag,
+                                    out_count, &consumed, nullptr, 0,
+                                    retry_armed() ? ffd : -1);
+      if (src == 5) {
+        /* mid-container link death: roll the partially staged split
+         * back (the sender retained the whole container — the replay
+         * redelivers it verbatim from its first byte) */
+        auto it = c->pending.find(source);
+        if (it != c->pending.end()) {
+          while (it->second.size() > staged0) it->second.pop_back();
+          if (it->second.empty()) c->pending.erase(it);
+        }
+        if (link_recover(c, source, ffd, "recv coalesced") == 0) continue;
+        FAIL_IO(c, g_stage_soft_rc, "recv coalesced payload from %d",
+                source);
+      }
+      if (src) return 1;
+      wire_mark_delivered(c, source, seq);
+      if (consumed) return 0;
+      return consume_pending(c, source, tag, buf, nbytes, out_src, out_tag,
+                             out_count);
+    }
+    if (tag != kAnyTag && h.tag != tag)
+      FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
+           tag, source, h.tag);
+    if (h.nbytes > nbytes)
+      FAIL(c, "message truncated: rank %d sent %lld bytes into a %lld-byte "
+           "buffer", source, (long long)h.nbytes, (long long)nbytes);
+    rc = read_all_dl(ffd, buf, h.nbytes);
+    if (rc) {
+      if (io_rc_retryable(rc) &&
+          link_recover(c, source, ffd, "recv payload") == 0)
+        continue;  // the replay redelivers this frame from its header
+      FAIL_IO(c, rc, "recv payload from %d", source);
+    }
+    wire_mark_delivered(c, source, seq);
+    break;
   }
-  if (rc) FAIL_IO(c, rc, "recv header from %d", source);
-  if (h.tag == kPoisonTag) return poison_fail(c, source, h);
-  if (h.comm_id != c->comm_id)
-    FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
-         "is comm %d — ops on sibling communicators must run in a "
-         "consistent order on both endpoints", source, h.comm_id,
-         c->comm_id);
-  if (h.tag == kCoalescedTag) {
-    /* split the container: the first sub-message lands directly in this
-     * posted receive when it matches; the rest stage for later recvs */
-    bool consumed = false;
-    if (stage_coalesced(c, source, h, tag, buf, nbytes, out_tag, out_count,
-                        &consumed))
-      return 1;
-    if (consumed) return 0;
-    return consume_pending(c, source, tag, buf, nbytes, out_src, out_tag,
-                           out_count);
-  }
-  if (tag != kAnyTag && h.tag != tag)
-    FAIL(c, "message order violation: expected tag %d from rank %d, got %d",
-         tag, source, h.tag);
-  if (h.nbytes > nbytes)
-    FAIL(c, "message truncated: rank %d sent %lld bytes into a %lld-byte "
-         "buffer", source, (long long)h.nbytes, (long long)nbytes);
-  rc = read_all_dl(c->socks[source], buf, h.nbytes);
-  if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
   if (out_tag) *out_tag = h.tag;
   if (out_count) *out_count = h.nbytes;
   return 0;
@@ -2446,18 +3724,31 @@ int64_t ring_round(int64_t n) { return (n + 15) & ~int64_t(15); }
  * EOF for free when a peer dies; a futex wait on a shared ring does
  * not.  The mesh socket to the peer doubles as a liveness probe (clean
  * exit -> EOF, crash -> RST), checked only on the slow (parked) path.
- * A socket holding undelivered data is alive, not dead. */
-bool peer_socket_dead(const std::vector<int>& socks, int r) {
-  int fd = r >= 0 && r < (int)socks.size() ? socks[r] : -1;
+ * A socket holding undelivered data is alive, not dead.  With the link
+ * layer armed, a dead SOCKET is not a dead PEER until the link state
+ * machine says so (a transient reset heals on the next op): only
+ * LINK_DEAD — budget exhausted or replay infeasible — reports death. */
+bool peer_socket_dead(Comm* c, int r) {
+  const bool armed = retry_armed() && !c->root_rank.empty();
+  int fd = r >= 0 && r < (int)c->socks.size()
+               ? (armed ? link_fd(c, r) : c->socks[r])
+               : -1;
+  if (armed) {
+    LinkState* L = link_state(c, r);
+    if (L && L->phase.load(std::memory_order_relaxed) == LINK_DEAD)
+      return true;
+  }
   if (fd < 0) return false;  // self or never-connected: no evidence
   char b[sizeof(MsgHeader)];
   ssize_t p = ::recv(fd, b, sizeof(b), MSG_PEEK | MSG_DONTWAIT);
-  if (p == 0) return true;
+  if (p == 0) return !armed;
   if (p < 0 && errno != EAGAIN && errno != EWOULDBLOCK && errno != EINTR)
-    return true;
+    return !armed;
   if (p == (ssize_t)sizeof(MsgHeader)) {
     /* a poison control frame means the peer is aborting the job: treat
-     * it as dead so shm waiters tear down within one probe interval */
+     * it as dead so shm waiters tear down within one probe interval.
+     * (The armed 32-byte header embeds MsgHeader as a prefix, so this
+     * 16-byte peek parses the same frame either way.) */
     MsgHeader h{};
     std::memcpy(&h, b, sizeof(h));
     if (h.tag == kPoisonTag) return true;
@@ -2631,7 +3922,7 @@ int shm_barrier(Comm* c) {
     shm_futex_wait(&h->bar_sense, sense, 100);
     if (h->bar_sense.load(std::memory_order_acquire) != sense) break;
     for (int r = 0; r < c->size; r++)
-      if (r != c->rank && peer_socket_dead(c->socks, r)) {
+      if (r != c->rank && peer_socket_dead(c, r)) {
         /* TOCTOU: the last arriver may have flipped the sense and
          * exited between our sense load and the death probe */
         if (h->bar_sense.load(std::memory_order_acquire) != sense) break;
@@ -2694,7 +3985,7 @@ int ring_wait_space(Comm* c, int dest, RingHdr* rh, int64_t ring_bytes,
                      rh->tail.load(std::memory_order_acquire);
     if ((int64_t)(ring_bytes - used2) >= need) return 0;
     shm_futex_wait(&rh->tseq, seq, 50);
-    if (peer_socket_dead(c->socks, dest))
+    if (peer_socket_dead(c, dest))
       FAIL(c, "send to rank %d failed: peer exited with its inbound "
            "ring full", dest);
     if (now_s() > deadline)
@@ -2773,7 +4064,7 @@ int ring_wait_frame(Comm* c, int src, RingFrame* out) {
     if (rh->head.load(std::memory_order_acquire) !=
         rh->tail.load(std::memory_order_relaxed))
       continue;  // drain whatever arrived, even from a now-dead peer
-    if (peer_socket_dead(c->socks, src)) {
+    if (peer_socket_dead(c, src)) {
       /* TOCTOU: the peer's last act may have been push-then-exit
        * between our emptiness load and the death probe — recheck */
       if (rh->head.load(std::memory_order_acquire) !=
@@ -2839,7 +4130,7 @@ int ring_poll_any(Comm* c, int tag, int* out_source) {
       RingHdr* rh = c->arena->ring_hdr(cands[i], c->rank);
       bool empty = rh->head.load(std::memory_order_acquire) ==
                    rh->tail.load(std::memory_order_relaxed);
-      if (empty && peer_socket_dead(c->socks, cands[i]) &&
+      if (empty && peer_socket_dead(c, cands[i]) &&
           /* TOCTOU: push-then-exit between the loads — recheck */
           rh->head.load(std::memory_order_acquire) ==
               rh->tail.load(std::memory_order_relaxed))
@@ -2857,8 +4148,13 @@ int shm_try_send(Comm* c, int dest, int tag, const void* buf,
                  int64_t nbytes, bool* inlined) {
   /* a send that rides the shm rings never reaches send_msg_tcp, so the
    * injector needs its own hook here (point=send counts transmissions:
-   * a stub-degraded send also pays the TCP-payload count) */
-  fault_fire(c, g_job_rank, FP_SEND, "send");
+   * a stub-degraded send also pays the TCP-payload count).  When the
+   * link layer is armed, target the peer's TCP link precisely — shm
+   * traffic itself cannot be reset, so the fault lands on the idle
+   * socket underneath and heartbeats (or the next stub payload) find
+   * it */
+  fault_fire(c, g_job_rank, FP_SEND, "send",
+             retry_armed() ? link_fd(c, dest) : -1);
   ShmArena* a = c->arena;
   RingHdr* rh = a->ring_hdr(c->rank, dest);
   int64_t need = (int64_t)sizeof(RingFrame) + ring_round(nbytes);
@@ -2891,21 +4187,37 @@ int shm_recv_status(Comm* c, int source, int tag, void* buf,
   if (f.flags & kRingStub) {
     /* payload is the next TCP frame from this peer; the usual header
        checks keep cross-communicator socket order honest */
-    MsgHeader h{};
-    int rc = read_all_dl(c->socks[source], &h, sizeof(h));
-    if (rc) FAIL_IO(c, rc, "recv header from %d", source);
-    if (h.tag == kPoisonTag) return poison_fail(c, source, h);
-    if (h.comm_id != c->comm_id)
-      FAIL(c, "communicator mismatch: rank %d's message is for comm %d, "
-           "this is comm %d — ops on sibling communicators must run in a "
-           "consistent order on both endpoints", source, h.comm_id,
-           c->comm_id);
-    if (h.tag != f.tag || h.nbytes != f.nbytes)
-      FAIL(c, "shm stub/TCP frame mismatch from rank %d (tag %d/%d, "
-           "bytes %lld/%lld)", source, f.tag, h.tag, (long long)f.nbytes,
-           (long long)h.nbytes);
-    rc = read_all_dl(c->socks[source], buf, h.nbytes);
-    if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
+    for (;;) {
+      MsgHeader h{};
+      uint64_t seq = 0;
+      int ffd = -1;
+      int rc = wire_read_hdr(c, source, &h, &seq, &ffd);
+      if (rc) {
+        if (io_rc_retryable(rc) &&
+            link_recover(c, source, ffd, "recv stub payload header") == 0)
+          continue;
+        FAIL_IO(c, rc, "recv header from %d", source);
+      }
+      if (h.tag == kPoisonTag) return poison_fail(c, source, h);
+      if (h.comm_id != c->comm_id)
+        FAIL(c, "communicator mismatch: rank %d's message is for comm %d, "
+             "this is comm %d — ops on sibling communicators must run in a "
+             "consistent order on both endpoints", source, h.comm_id,
+             c->comm_id);
+      if (h.tag != f.tag || h.nbytes != f.nbytes)
+        FAIL(c, "shm stub/TCP frame mismatch from rank %d (tag %d/%d, "
+             "bytes %lld/%lld)", source, f.tag, h.tag, (long long)f.nbytes,
+             (long long)h.nbytes);
+      rc = read_all_dl(ffd, buf, h.nbytes);
+      if (rc) {
+        if (io_rc_retryable(rc) &&
+            link_recover(c, source, ffd, "recv stub payload") == 0)
+          continue;
+        FAIL_IO(c, rc, "recv payload from %d", source);
+      }
+      wire_mark_delivered(c, source, seq);
+      break;
+    }
   } else {
     RingHdr* rh = a->ring_hdr(source, c->rank);
     uint64_t tail = rh->tail.load(std::memory_order_relaxed);
@@ -3533,12 +4845,24 @@ int recv_combine_msg(Comm* c, int source, char* dst, std::vector<char>& tmp,
   const int64_t esize = dtype_size(dtype);
   const int64_t nbytes = count * esize;
   MsgHeader h{};
+  uint64_t seq = 0;
+  int ffd = -1;
   int rc;
-  {
-    ObsWaitTimer wt;  // header arrival = wait phase (see recv_msg_status)
-    rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  for (;;) {
+    {
+      ObsWaitTimer wt;  // header arrival = wait phase (see recv_msg_status)
+      rc = wire_read_hdr(c, source, &h, &seq, &ffd);
+    }
+    if (rc == 0) break;
+    /* heal-at-header only: the header wait is where a transient reset
+     * lands in practice.  Once blocks start folding into dst the frame
+     * is partially combined and cannot replay — a mid-payload failure
+     * below escalates (documented scope: sharp-bits "Self-healing"). */
+    if (io_rc_retryable(rc) &&
+        link_recover(c, source, ffd, "recv collective header") == 0)
+      continue;
+    FAIL_IO(c, rc, "recv header from %d", source);
   }
-  if (rc) FAIL_IO(c, rc, "recv header from %d", source);
   if (h.tag == kPoisonTag) return poison_fail(c, source, h);
   if (h.comm_id != c->comm_id)
     FAIL(c, "communicator mismatch: rank %d's message is for comm %d, this "
@@ -3553,10 +4877,11 @@ int recv_combine_msg(Comm* c, int source, char* dst, std::vector<char>& tmp,
          source, (long long)nbytes, (long long)h.nbytes);
   for (int64_t off = 0; off < nbytes; off += kCombineBlockBytes) {
     int64_t nb = std::min(nbytes - off, kCombineBlockBytes);
-    rc = read_all_dl(c->socks[source], tmp.data(), nb);
+    rc = read_all_dl(ffd, tmp.data(), nb);
     if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
     if (combine(dst + off, tmp.data(), nb / esize, dtype, op, c)) return 1;
   }
+  wire_mark_delivered(c, source, seq);
   return 0;
 }
 
@@ -4107,11 +5432,23 @@ int recv_quant_msg(Comm* c, int source, int64_t count, float* dst,
          pending_head(c, source)->tag);
   const int64_t nbytes = quant_packed_bytes(count);
   MsgHeader h{};
+  uint64_t seq = 0;
+  int ffd = -1;
   int rc;
-  {
-    ObsWaitTimer wt;  // header arrival = wait phase (see recv_msg_status)
-    rc = read_all_dl(c->socks[source], &h, sizeof(h));
+  /* Heal-at-header only (same scope as recv_combine_msg): once codes
+   * start folding into dst the frame is partially dequantized and
+   * cannot replay — a mid-payload failure escalates. */
+  for (;;) {
+    {
+      ObsWaitTimer wt;  // header arrival = wait phase (see recv_msg_status)
+      rc = wire_read_hdr(c, source, &h, &seq, &ffd);
+    }
+    if (rc && io_rc_retryable(rc) &&
+        link_recover(c, source, ffd, "recv collective header") == 0)
+      continue;
+    break;
   }
+  if (ffd < 0) ffd = c->socks[source];
   if (rc) FAIL_IO(c, rc, "recv header from %d", source);
   if (h.tag == kPoisonTag) return poison_fail(c, source, h);
   if (h.comm_id != c->comm_id)
@@ -4125,10 +5462,13 @@ int recv_quant_msg(Comm* c, int source, int64_t count, float* dst,
   if (h.nbytes != nbytes)
     FAIL(c, "size mismatch from rank %d: expected %lld bytes, got %lld",
          source, (long long)nbytes, (long long)h.nbytes);
-  if (count <= 0) return 0;
+  if (count <= 0) {
+    wire_mark_delivered(c, source, seq);
+    return 0;
+  }
   const int64_t nb = quant_blocks(count);
   std::vector<char>& scales = quant_tls_buf(2, 4 * nb);
-  rc = read_all_dl(c->socks[source], scales.data(), 4 * nb);
+  rc = read_all_dl(ffd, scales.data(), 4 * nb);
   if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
   /* codes in runs of whole blocks (kCombineBlockBytes is a multiple of
    * kQuantBlock, so every run starts on a block boundary) */
@@ -4138,12 +5478,13 @@ int recv_quant_msg(Comm* c, int source, int64_t count, float* dst,
       quant_tls_buf(3, std::min<int64_t>(count, kCombineBlockBytes));
   for (int64_t e0 = 0; e0 < count; e0 += kCombineBlockBytes) {
     const int64_t e1 = std::min(count, e0 + kCombineBlockBytes);
-    rc = read_all_dl(c->socks[source], run.data(), e1 - e0);
+    rc = read_all_dl(ffd, run.data(), e1 - e0);
     if (rc) FAIL_IO(c, rc, "recv payload from %d", source);
     quant_dq_run(scales.data() + 4 * (e0 / kQuantBlock),
                  reinterpret_cast<const int8_t*>(run.data()), e1 - e0,
                  dst + e0, add);
   }
+  wire_mark_delivered(c, source, seq);
   return 0;
 }
 
@@ -5469,12 +6810,22 @@ int engine_run_body(EngineOp* o) {
   }
 }
 
+/* Socket-liveness check for the drain-loop merge predicates.  Armed
+ * links must snapshot through link_fd (the recovery thread rewires
+ * socks under the link locks); an fd of -1 mid-recovery just demotes
+ * the op to the single-descriptor path, whose link_send_frame joins
+ * the recovery instead of racing it. */
+static inline int engine_peer_fd(const EngineOp* o) {
+  return retry_armed() ? link_fd(o->comm, o->peer)
+                       : o->comm->socks[o->peer];
+}
+
 /* True when this descriptor may merge into a coalesced frame. */
 bool coalescible(const EngineOp* o) {
   return o->kind == TPU_OBS_SEND && o->detached && coalesce_bytes() > 0 &&
          o->snb <= coalesce_bytes() && o->peer != o->comm->rank &&
          o->peer >= 0 && o->peer < o->comm->size &&
-         !ring_p2p_on(o->comm) && o->comm->socks[o->peer] >= 0;
+         !ring_p2p_on(o->comm) && engine_peer_fd(o) >= 0;
 }
 
 /* One obs event per logical send of a batched drain-loop write (the
@@ -5513,18 +6864,25 @@ void engine_obs_burst(EngineOp** ops, int n, int dest, double tw0,
 int engine_write_coalesced(Engine* e, EngineOp** ops, int n) {
   Comm* c = ops[0]->comm;
   const int dest = ops[0]->peer;
+  const bool armed = retry_armed();
   int64_t total = 0;
   for (int i = 0; i < n; i++) total += (int64_t)sizeof(MsgHeader) + ops[i]->snb;
-  e->scratch.resize((size_t)(total + (int64_t)sizeof(MsgHeader)));
+  /* armed: the outer header is stamped (seq + epoch + CRC) inside
+   * link_send_frame, so only the sub-frames are assembled here; the
+   * whole container is then one retained, replayable wire frame */
+  e->scratch.resize((size_t)(total + (armed ? 0 : (int64_t)sizeof(MsgHeader))));
   char* p = e->scratch.data();
-  MsgHeader outer{total, kCoalescedTag, c->comm_id};
-  std::memcpy(p, &outer, sizeof(outer));
-  p += sizeof(outer);
+  if (!armed) {
+    MsgHeader outer{total, kCoalescedTag, c->comm_id};
+    std::memcpy(p, &outer, sizeof(outer));
+    p += sizeof(outer);
+  }
   for (int i = 0; i < n; i++) {
     /* one injector hit per LOGICAL send: MPI4JAX_TPU_FAULT's after=N
      * counts user sends, not wire frames, so a fault lands at the same
      * op index with coalescing on or off */
-    fault_fire(c, g_job_rank, FP_SEND, "send");
+    fault_fire(c, g_job_rank, FP_SEND, "send",
+               armed ? link_fd(c, dest) : -1);
     MsgHeader sh{ops[i]->snb, ops[i]->tag, c->comm_id};
     std::memcpy(p, &sh, sizeof(sh));
     p += sizeof(sh);
@@ -5538,8 +6896,11 @@ int engine_write_coalesced(Engine* e, EngineOp** ops, int n) {
   g_dl_post_anchor = ops[0]->t_post;
   double tw0 = now_s();
   int64_t sys0 = g_syscalls.load(std::memory_order_relaxed);
-  int io = write_all_dl(c->socks[dest], e->scratch.data(),
-                        total + (int64_t)sizeof(MsgHeader));
+  int io = armed
+               ? link_send_frame(c, dest, kCoalescedTag, e->scratch.data(),
+                                 total, nullptr, 0)
+               : write_all_dl(c->socks[dest], e->scratch.data(),
+                              total + (int64_t)sizeof(MsgHeader));
   g_dl_post_anchor = 0;
   int rc = 0;
   if (io) {
@@ -5569,7 +6930,7 @@ bool batchable(const EngineOp* o) {
   return o->kind == TPU_OBS_SEND && o->detached &&
          o->peer != o->comm->rank && o->peer >= 0 &&
          o->peer < o->comm->size && !ring_p2p_on(o->comm) &&
-         o->comm->socks[o->peer] >= 0;
+         engine_peer_fd(o) >= 0;
 }
 
 /* Write a run of adjacent detached sends that are NOT coalescible
@@ -5583,11 +6944,12 @@ int engine_write_batch(Engine* e, EngineOp** ops, int n) {
   (void)e;
   Comm* c = ops[0]->comm;
   const int dest = ops[0]->peer;
+  const bool armed = retry_armed();
   std::vector<MsgHeader> hdrs((size_t)n);
   std::vector<struct iovec> iov((size_t)n * 2);
   int64_t total = 0;
   for (int i = 0; i < n; i++) {
-    fault_fire(c, g_job_rank, FP_SEND, "send");
+    if (!armed) fault_fire(c, g_job_rank, FP_SEND, "send");
     hdrs[(size_t)i] = MsgHeader{ops[i]->snb, ops[i]->tag, c->comm_id};
     iov[(size_t)(2 * i)] = {&hdrs[(size_t)i], sizeof(MsgHeader)};
     iov[(size_t)(2 * i + 1)] = {const_cast<void*>(ops[i]->sbuf),
@@ -5601,7 +6963,20 @@ int engine_write_batch(Engine* e, EngineOp** ops, int n) {
   g_dl_post_anchor = ops[0]->t_post;
   double tw0 = now_s();
   int64_t sys0 = g_syscalls.load(std::memory_order_relaxed);
-  int io = writev_all_dl(c->socks[dest], iov.data(), 2 * n, total);
+  int io = 0;
+  if (armed) {
+    /* armed: each frame needs its own seq stamp + retained copy, so
+     * the run leaves as N sequential link_send_frame writes instead of
+     * one shared writev (the merge still saves per-descriptor queue
+     * round-trips; only the vectored-syscall saving is conceded) */
+    for (int i = 0; i < n && !io; i++) {
+      fault_fire(c, g_job_rank, FP_SEND, "send", link_fd(c, dest));
+      io = link_send_frame(c, dest, ops[i]->tag, ops[i]->sbuf,
+                           ops[i]->snb, nullptr, 0);
+    }
+  } else {
+    io = writev_all_dl(c->socks[dest], iov.data(), 2 * n, total);
+  }
   g_dl_post_anchor = 0;
   int rc = 0;
   if (io) {
@@ -5631,6 +7006,10 @@ void engine_loop(Comm* root) {
     uint64_t h = e->head.load(std::memory_order_acquire);
     if (h == t) {
       if (e->stop.load(std::memory_order_acquire)) return;
+      /* idle tick: heartbeat quiet links + drain stray reconnect dials
+       * (the ISSUE's uring-timeout-slot role — the 100 ms futex park
+       * below already bounds the tick period) */
+      if (retry_armed()) link_idle_service(root);
       int32_t seq = e->hseq.load(std::memory_order_acquire);
       if (e->head.load(std::memory_order_acquire) != t) continue;
       shm_futex_wait(&e->hseq, seq, 100);
@@ -5698,8 +7077,11 @@ void engine_loop(Comm* root) {
   }
 }
 
-/* Post under the comm lock; the queue itself is lock-free SPSC. */
-void engine_post(Comm* root, EngineOp* op) {
+/* Lazy engine creation, factored out of engine_post so an armed
+ * bootstrap can spin the progress thread up eagerly: heartbeats must
+ * tick on a link that never posts an op.  Callers serialize (comm lock
+ * or single-threaded bootstrap). */
+Engine* engine_ensure(Comm* root) {
   Engine* e = root->engine;
   if (e == nullptr) {
     e = new Engine;
@@ -5708,6 +7090,12 @@ void engine_post(Comm* root, EngineOp* op) {
     root->engine = e;  // published before the thread starts
     e->thr = std::thread(engine_loop, root);
   }
+  return e;
+}
+
+/* Post under the comm lock; the queue itself is lock-free SPSC. */
+void engine_post(Comm* root, EngineOp* op) {
+  Engine* e = engine_ensure(root);
   uint64_t h = e->head.load(std::memory_order_relaxed);
   while (h - e->tail.load(std::memory_order_acquire) >= e->cap) {
     /* bounded queue: park for space (backpressure, not allocation) */
@@ -5937,15 +7325,15 @@ static int64_t comm_bootstrap(int rank, int size, int base_port,
     c->socks[peer] = fd;
   }
 
-  /* accept every higher rank.  Bounded by the connect deadline only
-   * when MPI4JAX_TPU_CONNECT_TIMEOUT_S is set explicitly: the historic
-   * default blocks forever (ranks may start far apart), but an operator
-   * who bounded the dial side wants the listen side bounded too — a
-   * missing higher rank hangs accept exactly like a missing lower rank
-   * hangs connect. */
-  const char* connect_env = std::getenv("MPI4JAX_TPU_CONNECT_TIMEOUT_S");
-  const bool bounded_accept = connect_env && connect_env[0] &&
-                              connect_dl > 0;
+  /* accept every higher rank, bounded by the connect deadline BY
+   * DEFAULT: the dial side has been deadline-bounded since the knob
+   * landed, but accept used to block forever unless the operator set
+   * MPI4JAX_TPU_CONNECT_TIMEOUT_S explicitly — an accept-side hang
+   * (one higher rank never scheduled) outlived every other deadline in
+   * the stack.  A missing higher rank now hangs accept exactly as long
+   * as a missing lower rank hangs connect; 0 opts back into unbounded
+   * waits on both sides. */
+  const bool bounded_accept = connect_dl > 0;
   for (int expected = rank + 1; expected < size; expected++) {
     if (bounded_accept) {
       double deadline = now_s() + connect_dl;
@@ -5996,7 +7384,14 @@ static int64_t comm_bootstrap(int rank, int size, int base_port,
     }
     c->socks[peer_rank] = fd;
   }
-  if (listen_fd >= 0) ::close(listen_fd);
+  if (listen_fd >= 0) {
+    if (retry_armed())
+      /* self-healing: reconnect dials from higher ranks land on the
+       * bootstrap listener, so it stays open for the comm's lifetime */
+      c->listen_fd = listen_fd;
+    else
+      ::close(listen_fd);
+  }
 
   /* With a transport deadline armed, the mesh runs on non-blocking fds:
    * the deadline paths poll() before every transfer and handle EAGAIN,
@@ -6015,6 +7410,24 @@ static int64_t comm_bootstrap(int rank, int size, int base_port,
         int fl = ::fcntl(fd, F_GETFL, 0);
         if (fl >= 0) ::fcntl(fd, F_SETFL, fl | O_NONBLOCK);
       }
+  }
+
+  /* self-healing link layer: one LinkState per peer socket, the
+   * identity root_rank map (sub-comms compose through it at split),
+   * and the REAL dialing addresses — reconnect must dial the wire
+   * host even when FAKE_HOSTS virtually partitions locality */
+  if (retry_armed()) {
+    c->base_port = base_port;
+    c->real_hosts = host_list;
+    c->root_rank.resize((size_t)size);
+    c->links.resize((size_t)size);
+    const double t0 = now_s();
+    for (int r = 0; r < size; r++) {
+      c->root_rank[(size_t)r] = r;
+      if (r == rank) continue;
+      c->links[(size_t)r].reset(new LinkState);
+      c->links[(size_t)r]->last_rx.store(t0, std::memory_order_relaxed);
+    }
   }
 
   /* same-host groups get the shared-memory collective arena */
@@ -6043,6 +7456,11 @@ static int64_t comm_bootstrap(int rank, int size, int base_port,
   for (int i = 1; i < size; i++)
     if (eff_hosts[i] != eff_hosts[0]) same_host = false;
   if (same_host) arena_init(c);
+
+  /* armed + engine on: start the progress thread eagerly — heartbeats
+   * must tick on a link that never posts an op (half-open detection on
+   * idle links is the point) */
+  if (retry_armed() && progress_thread_on()) engine_ensure(c);
 
   std::lock_guard<std::mutex> lock(g_comms_mu);
   int64_t h = g_next_handle++;
@@ -6111,6 +7529,18 @@ void tpucomm_finalize(int64_t h) {
       w->topo = nullptr;
     }
   }
+  if (c->lock_root != c) {
+    /* unregister from the socket owner's reconnect-rewire list — but
+     * only while the owner is still registered (it may legally have
+     * been finalized first; g_comms_mu makes the check race-free) */
+    for (const auto& kv : g_comms)
+      if (kv.second == c->lock_root) {
+        std::lock_guard<std::mutex> kl(c->lock_root->kids_mu);
+        auto& ks = c->lock_root->kids;
+        ks.erase(std::remove(ks.begin(), ks.end(), c), ks.end());
+        break;
+      }
+  }
   if (c->owns_socks)
     for (int fd : c->socks)
       if (fd >= 0) ::close(fd);
@@ -6161,6 +7591,18 @@ int64_t tpucomm_split(int64_t h, int color, int key) {
       nc->rank = nr;
     else
       nc->socks[nr] = c->socks[old];
+  }
+  if (retry_armed() && !c->root_rank.empty()) {
+    /* compose the root_rank map through the parent so this child
+     * resolves the same per-socket LinkState, and register it with the
+     * socket owner so a reconnect rewires this comm's socks view too */
+    nc->root_rank.resize((size_t)nc->size);
+    for (int nr = 0; nr < nc->size; nr++)
+      nc->root_rank[(size_t)nr] =
+          c->root_rank[(size_t)members[(size_t)nr].second];
+    Comm* rt = nc->lock_root;
+    std::lock_guard<std::mutex> kl(rt->kids_mu);
+    rt->kids.push_back(nc);
   }
   /* FNV mix of (parent id, call seq, color): identical on every member,
    * distinct across sibling groups and successive splits */
@@ -6383,14 +7825,26 @@ void tpucomm_abort_all(void) {
     for (int r = 0; r < c->size; r++) {
       int fd = c->socks[r];
       if (fd < 0) continue;
-      MsgHeader h{len, kPoisonTag, c->comm_id};
-      ssize_t w = ::send(fd, &h, sizeof(h), MSG_NOSIGNAL | MSG_DONTWAIT);
+      ssize_t w;
+      if (retry_armed()) {
+        /* armed peers parse MsgHeaderX frames: send a sealed extended
+         * header (seq 0 = control, never dedup'd or replayed) so the
+         * poison isn't rejected as a CRC mismatch */
+        MsgHeaderX hx{};
+        hx.h = MsgHeader{len, kPoisonTag, c->comm_id};
+        hx_seal(&hx);
+        w = ::send(fd, &hx, sizeof(hx), MSG_NOSIGNAL | MSG_DONTWAIT);
+        w = (w == (ssize_t)sizeof(hx)) ? (ssize_t)sizeof(MsgHeader) : -1;
+      } else {
+        MsgHeader h{len, kPoisonTag, c->comm_id};
+        w = ::send(fd, &h, sizeof(h), MSG_NOSIGNAL | MSG_DONTWAIT);
+      }
       /* payload only behind a COMPLETE header: a partial header send
        * (nearly-full buffer — the typical abort scenario) followed by
        * text bytes would be parsed as a garbage frame header on the
        * peer; partial header + EOF degrades to the historic dead-socket
        * diagnostic instead */
-      if (w == (ssize_t)sizeof(h) && len > 0)
+      if (w == (ssize_t)sizeof(MsgHeader) && len > 0)
         ::send(fd, text, (size_t)len, MSG_NOSIGNAL | MSG_DONTWAIT);
       ::shutdown(fd, SHUT_RDWR);
     }
@@ -6688,6 +8142,24 @@ int64_t tpucomm_obs_drain(TpuObsEvent* out, int64_t max_n) {
 }
 
 double tpucomm_obs_clock(void) { return now_s(); }
+
+void tpucomm_link_counters(int64_t* retries, int64_t* reconnects,
+                           int64_t* dup_dropped, int64_t* crc_errors,
+                           int64_t* replayed, int64_t* heartbeats) {
+  /* process totals, monotone since load; all zero unless armed (the
+   * counters only increment on armed paths).  The symbol itself doubles
+   * as the bridge's layout probe for the 80-byte TpuObsEvent. */
+  if (retries) *retries = g_lc_retries.load(std::memory_order_relaxed);
+  if (reconnects)
+    *reconnects = g_lc_reconnects.load(std::memory_order_relaxed);
+  if (dup_dropped)
+    *dup_dropped = g_lc_dup_dropped.load(std::memory_order_relaxed);
+  if (crc_errors)
+    *crc_errors = g_lc_crc_errors.load(std::memory_order_relaxed);
+  if (replayed) *replayed = g_lc_replayed.load(std::memory_order_relaxed);
+  if (heartbeats)
+    *heartbeats = g_lc_heartbeats.load(std::memory_order_relaxed);
+}
 
 int tpucomm_reduce(int64_t h, const void* sendbuf, void* recvbuf,
                    int64_t count, int dtype, int op, int root) {

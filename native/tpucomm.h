@@ -186,6 +186,26 @@ const char* tpucomm_uring_status(void);
  * syscalls-per-message denominator reads deltas of this. */
 int64_t tpucomm_syscall_count(void);
 
+/* Process-total self-healing link counters since load (all zero unless
+ * MPI4JAX_TPU_RETRY > 0 armed the link layer):
+ *   retries      recovery events entered (a failing I/O that attempted
+ *                a reconnect, successful or not)
+ *   reconnects   successful reconnect handshakes (link healed in-place)
+ *   dup_dropped  duplicate data frames discarded by the receiver's
+ *                sequence dedup (replay overlap — proof the
+ *                exactly-once layer did work)
+ *   crc_errors   header/control CRC32C mismatches detected (each one
+ *                is treated as a link failure and healed or escalated)
+ *   replayed     retained frames retransmitted during reconnects
+ *   heartbeats   progress-thread pings sent on idle links
+ * Null out-pointers are skipped.  This symbol doubles as the layout
+ * probe for the self-healing generation: a library exporting it writes
+ * TpuObsEvent.retries (80-byte slots); one without it never does
+ * (72-byte slots) — the Python side keys the struct layout on this. */
+void tpucomm_link_counters(int64_t* retries, int64_t* reconnects,
+                           int64_t* dup_dropped, int64_t* crc_errors,
+                           int64_t* replayed, int64_t* heartbeats);
+
 /* Job-wide abort propagation: best-effort write one poison control
  * frame (carrying tpucomm_last_error's text) to every peer of every
  * socket-owning communicator and shut the sockets down.  Peers blocked
@@ -201,7 +221,21 @@ int64_t tpucomm_syscall_count(void);
  *   MPI4JAX_TPU_CONNECT_TIMEOUT_S  bootstrap dial/accept deadline
  *   MPI4JAX_TPU_FAULT              deterministic fault injection:
  *                                  rank=R,point=send|recv|connect,
- *                                  after=N,action=hang|exit|close */
+ *                                  after=N,action=hang|exit|close|
+ *                                  reset|drop|delay|corrupt
+ *                                  (+ bytes=N for drop, ms=N for
+ *                                  delay; the four new actions are
+ *                                  one-shot transients the self-healing
+ *                                  link layer is expected to absorb)
+ *   MPI4JAX_TPU_RETRY              reconnect attempts per link failure
+ *                                  (0 = self-healing off, the default:
+ *                                  today's fail-fast path bit-for-bit)
+ *   MPI4JAX_TPU_RETRY_BACKOFF_MS   first reconnect backoff window
+ *                                  (exponential + jitter, default 100)
+ *   MPI4JAX_TPU_HEARTBEAT_S        progress-thread idle-link ping
+ *                                  period (0 = off, the default)
+ *   MPI4JAX_TPU_WIRE_CRC           CRC32C on wire headers/control
+ *                                  frames: auto (on iff RETRY>0)|0|1 */
 void tpucomm_abort_all(void);
 
 /* Point-to-point.  dest/source == own rank is legal (MPI-style
@@ -364,6 +398,13 @@ struct TpuObsEvent {
                     * slot (layout unchanged, still 72-byte slots);
                     * probe tpucomm_uring_status to tell a library that
                     * writes it from one whose slot is always 0. */
+  int32_t retries; /* link self-heal events (successful reconnect +
+                    * replay cycles) absorbed while this op executed —
+                    * nonzero marks an op whose latency includes a
+                    * transparent recovery.  Grows the slot to 80
+                    * bytes; probe tpucomm_link_counters to tell an
+                    * 80-byte library from a 72-byte one. */
+  int32_t reserved0; /* keeps the slot 8-byte aligned; always 0 */
 };
 
 /* Arm (enabled=1) or disarm (0) recording.  `capacity` is the ring size

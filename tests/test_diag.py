@@ -23,7 +23,7 @@ def test_diag_cpu_checks():
     assert names == {"native_build", "ffi_fast_path", "coll_algo_engine",
                      "observability", "static_verify", "schedule_plan",
                      "topology", "transport_loopback", "failure_detection",
-                     "elasticity", "serving"}
+                     "self_healing", "elasticity", "serving"}
     # the topology probe renders the island map and the live pick
     topo_check = next(r for r in data["results"] if r["check"] == "topology")
     assert "island0[" in topo_check["detail"]
@@ -59,3 +59,10 @@ def test_diag_cpu_checks():
     assert "prefill=r1 decode=r2" in sv2["detail"]
     assert "kv tier bytes" in sv2["detail"]
     assert "shed" in sv2["detail"]
+    # the self-healing probe proves an injected link reset healed on
+    # the first reconnect attempt with the counters visible in stats
+    sh = next(r for r in data["results"] if r["check"] == "self_healing")
+    assert "healed on attempt 1" in sh["detail"]
+    assert "digests bit-identical" in sh["detail"]
+    assert "dup_dropped=" in sh["detail"]
+    assert "obs.stats()" in sh["detail"]

@@ -553,6 +553,125 @@ def test_tsan_shrink_under_load_three_ranks(shm):
     )
 
 
+# ---- self-healing reconnect (TSan + ASan) --------------------------
+#
+# The reconnect path is a fourth lifecycle the threads cross: an
+# injected RST mid-stream, the victim thread parking the fd while a
+# fresh dial races the peer's accept, the hello exchange, gap replay
+# from the retain ring, and seq dedup on the receiver — all while the
+# progress thread (engine legs) keeps polling the same link set.  A
+# 2-rank armed pair heals an injected reset and finishes the SAME
+# deterministic load, 0 reports required.  The shm-on leg resets the
+# idle TCP link under the arena and recovers it via heartbeats.
+
+_HEAL_RANK_SRC = r"""
+import ctypes, os, sys, time
+import numpy as np
+
+so = os.environ["SAN_SO"]
+rank = int(os.environ["SAN_RANK"])
+size = 2
+port = int(os.environ["SAN_PORT"])
+
+lib = ctypes.CDLL(so)
+lib.tpucomm_init.restype = ctypes.c_int64
+lib.tpucomm_init.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                             ctypes.c_char_p]
+h = lib.tpucomm_init(rank, size, port, b"")
+assert h > 0, "tpucomm_init failed"
+
+F32, SUM = 11, 0  # wire codes (tpucomm.h)
+n = 1024
+buf = np.arange(n, dtype=np.float32) + rank
+out = np.zeros_like(buf)
+p = lambda a: a.ctypes.data_as(ctypes.c_void_p)
+
+# phase 1: p2p pingpong — the injected reset lands here (point=send
+# counts transmissions) and the armed layer must heal it in place
+for it in range(12):
+    if rank == 0:
+        lib.tpucomm_send(h, p(buf), buf.nbytes, 1, it)
+        rc = lib.tpucomm_recv(h, p(out), out.nbytes, 1, it)
+    else:
+        rc = lib.tpucomm_recv(h, p(out), out.nbytes, 0, it)
+        lib.tpucomm_send(h, p(buf), buf.nbytes, 0, it)
+    assert rc == 0, f"recv failed at iter {it}"
+    assert out[3] == 3.0 + (1 - rank), out[3]
+
+# shm-on leg: park the wire so the heartbeat (not an op) finds the
+# reset link and heals it before phase 2
+sleep_s = float(os.environ.get("SAN_SLEEP_S", "0"))
+if sleep_s > 0:
+    time.sleep(sleep_s)
+
+# phase 2: collectives over the healed link
+for it in range(8):
+    rc = lib.tpucomm_allreduce(h, p(buf), p(out), n, F32, SUM)
+    assert rc == 0, f"allreduce failed at iter {it}"
+    assert out[1] == 3.0, out[1]
+    assert lib.tpucomm_barrier(h) == 0
+
+cnt = (ctypes.c_int64 * 6)()
+lib.tpucomm_link_counters(*[ctypes.byref(cnt, 8 * i) for i in range(6)])
+assert cnt[1] >= 1, f"no reconnect recorded (counters {list(cnt)})"
+lib.tpucomm_finalize(ctypes.c_int64(h))
+print("san-rank-ok", rank, flush=True)
+"""
+
+
+def _heal_env(shm, uring, so, preload, san, tag):
+    extra = {
+        "MPI4JAX_TPU_JOBID": f"{tag}{shm}{uring}{os.getpid()}",
+        "MPI4JAX_TPU_RETRY": "4",
+        "MPI4JAX_TPU_RETRY_BACKOFF_MS": "50",
+        "MPI4JAX_TPU_TIMEOUT_S": "60",
+        "MPI4JAX_TPU_FAULT": "rank=0,point=send,after=5,action=reset",
+        **_uring_env(uring, so, preload, san),
+    }
+    if shm == "off":
+        extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    else:
+        # shm traffic can't be reset, so the fault lands on the idle
+        # TCP link underneath — only heartbeats can find it
+        extra["MPI4JAX_TPU_PROGRESS_THREAD"] = "1"
+        extra["MPI4JAX_TPU_HEARTBEAT_S"] = "0.2"
+        extra["SAN_SLEEP_S"] = "2.0"
+    return extra
+
+
+@pytest.mark.parametrize("uring", ["0", "1"])
+@pytest.mark.parametrize("shm", ["on", "off"])
+def test_tsan_self_heal_reconnect(shm, uring):
+    _build("tsan")
+    preload = _preload_path("libtsan.so")
+    so = os.path.join(SO_DIR, "libtpucomm_tsan.so")
+    san = {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"}
+    _run_group(
+        _HEAL_RANK_SRC, 2, so, preload, san,
+        48700 + (os.getpid() + (23 if shm == "on" else 0)
+                 + (41 if uring == "1" else 0)) % 400,
+        _heal_env(shm, uring, so, preload, san, "tsanheal"),
+    )
+
+
+@pytest.mark.parametrize("uring", ["0", "1"])
+@pytest.mark.parametrize("shm", ["on", "off"])
+def test_asan_self_heal_reconnect(shm, uring):
+    _build("asan")
+    preload = _preload_path("libasan.so")
+    so = os.path.join(SO_DIR, "libtpucomm_asan.so")
+    san = {
+        "ASAN_OPTIONS": "exitcode=66 detect_leaks=0 halt_on_error=1",
+        "UBSAN_OPTIONS": "halt_on_error=1 print_stacktrace=1",
+    }
+    _run_group(
+        _HEAL_RANK_SRC, 2, so, preload, san,
+        49100 + (os.getpid() + (23 if shm == "on" else 0)
+                 + (41 if uring == "1" else 0)) % 400,
+        _heal_env(shm, uring, so, preload, san, "asanheal"),
+    )
+
+
 @pytest.mark.parametrize("uring", ["0", "1"])
 @pytest.mark.parametrize("shm", ["on", "off"])
 def test_asan_loopback_pair(shm, uring):

@@ -1,0 +1,137 @@
+"""Strict-parser tests for the self-healing link knobs' Python mirrors
+(``MPI4JAX_TPU_RETRY`` / ``RETRY_BACKOFF_MS`` / ``HEARTBEAT_S`` /
+``WIRE_CRC`` / ``RETRY_REPLAY_SLACK``).
+
+The native parsers exit the process on malformed values; these mirrors
+must match that strictness — a mirror that quietly reads a typo'd knob
+as its default would report a DIFFERENT configuration than the one the
+transport is actually running.  Stdlib-only (config.py is loaded
+standalone, the test_config_lint pattern), so this runs even where jax
+cannot import.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_config():
+    spec = importlib.util.spec_from_file_location(
+        "m4j_config_heal", os.path.join(REPO, "mpi4jax_tpu", "utils",
+                                        "config.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+config = _load_config()
+
+
+def test_knobs_registered():
+    # satellite contract: the self-healing knobs live in the registry
+    # (the lint cross-checks reads; this pins the rows themselves)
+    for knob in ("MPI4JAX_TPU_RETRY", "MPI4JAX_TPU_RETRY_BACKOFF_MS",
+                 "MPI4JAX_TPU_HEARTBEAT_S", "MPI4JAX_TPU_WIRE_CRC",
+                 "MPI4JAX_TPU_RETRY_REPLAY_SLACK",
+                 "MPI4JAX_TPU_CONNECT_TIMEOUT_S"):
+        assert knob in config.KNOBS, knob
+
+
+def test_retry_budget_default_disarmed(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_RETRY", raising=False)
+    assert config.retry_budget() == 0
+    assert config.retry_armed() is False
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY", "  ")
+    assert config.retry_budget() == 0
+
+
+def test_retry_budget_parses_and_clamps(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY", "4")
+    assert config.retry_budget() == 4
+    assert config.retry_armed() is True
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY", "0")
+    assert config.retry_armed() is False
+    # negatives clamp to disarmed rather than arming a nonsense budget
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY", "-3")
+    assert config.retry_budget() == 0
+
+
+def test_retry_budget_loud_on_garbage(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY", "many")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_RETRY"):
+        config.retry_budget()
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY", "2.5")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_RETRY"):
+        config.retry_budget()
+
+
+def test_retry_backoff_default_and_floor(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_RETRY_BACKOFF_MS", raising=False)
+    assert config.retry_backoff_ms() == 100.0
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY_BACKOFF_MS", "50")
+    assert config.retry_backoff_ms() == 50.0
+    # non-positive restores the default (a 0ms backoff would busy-dial)
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY_BACKOFF_MS", "0")
+    assert config.retry_backoff_ms() == 100.0
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY_BACKOFF_MS", "-1")
+    assert config.retry_backoff_ms() == 100.0
+
+
+def test_retry_backoff_loud_on_garbage(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY_BACKOFF_MS", "fast")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_RETRY_BACKOFF_MS"):
+        config.retry_backoff_ms()
+
+
+def test_heartbeat_default_off(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_HEARTBEAT_S", raising=False)
+    assert config.heartbeat_s() == 0.0
+    monkeypatch.setenv("MPI4JAX_TPU_HEARTBEAT_S", "0.2")
+    assert config.heartbeat_s() == 0.2
+
+
+def test_heartbeat_loud_on_garbage(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_HEARTBEAT_S", "often")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_HEARTBEAT_S"):
+        config.heartbeat_s()
+
+
+def test_wire_crc_modes(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_WIRE_CRC", raising=False)
+    assert config.wire_crc_mode() == "auto"
+    for v in ("auto", "0", "1", " 1 "):
+        monkeypatch.setenv("MPI4JAX_TPU_WIRE_CRC", v)
+        assert config.wire_crc_mode() == v.strip()
+    monkeypatch.setenv("MPI4JAX_TPU_WIRE_CRC", "")
+    assert config.wire_crc_mode() == "auto"
+
+
+def test_wire_crc_loud_on_garbage(monkeypatch):
+    for v in ("yes", "on", "2", "true"):
+        monkeypatch.setenv("MPI4JAX_TPU_WIRE_CRC", v)
+        with pytest.raises(ValueError, match="MPI4JAX_TPU_WIRE_CRC"):
+            config.wire_crc_mode()
+
+
+def test_replay_slack_default_and_strict(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_RETRY_REPLAY_SLACK", raising=False)
+    assert config.retry_replay_slack() == 0
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY_REPLAY_SLACK", "2")
+    assert config.retry_replay_slack() == 2
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY_REPLAY_SLACK", "-1")
+    assert config.retry_replay_slack() == 0
+    monkeypatch.setenv("MPI4JAX_TPU_RETRY_REPLAY_SLACK", "lots")
+    with pytest.raises(ValueError,
+                       match="MPI4JAX_TPU_RETRY_REPLAY_SLACK"):
+        config.retry_replay_slack()
+
+
+def test_connect_timeout_bounded_by_default(monkeypatch):
+    # the bootstrap accept side is bounded unless explicitly unbounded
+    monkeypatch.delenv("MPI4JAX_TPU_CONNECT_TIMEOUT_S", raising=False)
+    assert config.connect_timeout_s() == 30.0
+    monkeypatch.setenv("MPI4JAX_TPU_CONNECT_TIMEOUT_S", "0")
+    assert config.connect_timeout_s() == 0.0  # 0 = explicitly unbounded

@@ -1,0 +1,94 @@
+"""Deterministic traffic for the self-healing link tests.
+
+Bridge-level rank program (no jax import) driven by
+``tests/world/test_self_healing.py``: phases of point-to-point
+pingpong — where an injected transient fault (``MPI4JAX_TPU_FAULT``)
+lands deterministically and the armed link layer must heal in place —
+followed by allreduce rounds proving the healed wire still carries
+collectives, a digest over everything received, and the process-total
+self-healing counters from ``obs.stats()``.
+
+Unlike ``fault_ops.py`` this program loads the package through the
+parent-package shim (the pattern ``runtime/diag.py`` established), so
+the self-healing tests run even where the package's jax version gate
+blocks the full import — the paths under test live entirely in the
+native transport and the stdlib-importable obs package.
+
+Env:
+    HEAL_OPS_N        payload element count (float64; default 256)
+    HEAL_OPS_ROUNDS   pingpong rounds, then the same number of
+                      allreduce rounds (default 12)
+    HEAL_OPS_SLEEP_S  idle window between the phases (default 0) —
+                      the heartbeat test parks the wire here so the
+                      progress thread, not an op, finds the dead link
+"""
+
+import os
+import sys
+import types
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+
+import numpy as np  # noqa: E402
+
+from mpi4jax_tpu import obs  # noqa: E402
+from mpi4jax_tpu.runtime import bridge, transport  # noqa: E402
+
+
+def main():
+    comm = transport.get_world_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size == 2, "run under the launcher with -n 2"
+    h = comm.handle
+    obs.start(lib=bridge.get_lib(), rank=rank, size=size)
+
+    n = int(os.environ.get("HEAL_OPS_N", "256"))
+    rounds = int(os.environ.get("HEAL_OPS_ROUNDS", "12"))
+    peer = 1 - rank
+    x = np.arange(n, dtype=np.float64) + rank
+    digest = 0.0
+
+    # phase 1: pingpong — the injected fault lands here (point=send
+    # counts transmissions); a mid-frame reset on this traffic is
+    # always healable (sent frames <= the retain ceiling are replayed
+    # whole, the receiver dedups by seq)
+    for it in range(rounds):
+        if rank == 0:
+            bridge.send(h, x + it, peer, it)
+            got = bridge.recv(h, x.shape, x.dtype, peer, it)
+        else:
+            got = bridge.recv(h, x.shape, x.dtype, peer, it)
+            bridge.send(h, x + it, peer, it)
+        np.testing.assert_allclose(got, np.arange(n) + peer + it)
+        digest += float(got.sum())
+
+    sleep_s = float(os.environ.get("HEAL_OPS_SLEEP_S", "0"))
+    if sleep_s > 0:
+        import time
+
+        time.sleep(sleep_s)
+
+    # phase 2: collectives over the healed wire (the one-shot fault
+    # has fired by now; these must run exactly as on a fresh link)
+    for it in range(rounds):
+        out = bridge.allreduce(h, x + it, 0)  # 0 = SUM (tpucomm.h wire code)
+        np.testing.assert_allclose(out, (np.arange(n) * 2) + 1 + 2 * it)
+        digest += float(out.sum())
+
+    sh = obs.stats().get("self_healing", {})
+    # one write() so the two ranks' report lines can't interleave in
+    # the launcher's multiplexed stdout
+    sys.stdout.write(
+        "heal_ops %d digest %r reconnects %d dup_dropped %d "
+        "crc_errors %d replayed %d\n"
+        % (rank, digest, sh.get("reconnects", 0), sh.get("dup_dropped", 0),
+           sh.get("crc_errors", 0), sh.get("replayed", 0)))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

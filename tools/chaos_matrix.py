@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Chaos fault matrix for the self-healing transport (``make chaos``).
+
+Runs the deterministic 2-rank traffic program
+(``tests/world_programs/heal_ops.py``) under every cell of
+
+    {reset, drop, delay, corrupt} x {URING 0/1} x {shm on/off}
+                                  x {engine on/off}
+
+with the retry layer armed, and holds each cell to the chaos contract:
+
+* **HEALED** — the job completes and both ranks' digests are
+  bit-identical to the fault-free baseline (reconnect counters show
+  the link layer actually worked);
+* **CLEAN** — the job completes bit-identical without a reconnect
+  (the fault had no wire surface in this cell — e.g. a delay below
+  the deadline, or a byte-level fault armed on a thread that never
+  writes TCP when the shm arena carries the traffic);
+* **ESCALATED** — the job fails LOUDLY: the DEAD-link escalation line
+  or a launcher post-mortem is in stderr (mid-collective resets on
+  large frames are allowed to escalate — what is never allowed is a
+  hang or a silent wrong answer);
+* anything else — a hang (cell timeout), a silent failure, or a digest
+  mismatch — **fails the matrix**.
+
+``corrupt`` cells additionally require, on the TCP data path (shm
+off), that the CRC actually caught the flipped byte: crc_errors >= 1
+or the reconnect-forcing "header CRC mismatch" line.  (On shm cells
+the corrupted header may land on a heartbeat instead; the digest
+check still rules out silent corruption.)
+
+uring=1 columns are skipped (visibly) when the kernel lacks io_uring.
+
+Exit status: 0 iff every non-skipped cell lands in its contract.
+"""
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCHER = os.path.join(REPO, "mpi4jax_tpu", "runtime", "launch.py")
+PROGRAM = os.path.join(REPO, "tests", "world_programs", "heal_ops.py")
+
+FAULTS = {
+    "reset": "action=reset",
+    "drop": "action=drop,bytes=20",
+    "delay": "action=delay,ms=200",
+    "corrupt": "action=corrupt",
+}
+
+_port = [49500 + (os.getpid() * 11) % 300]
+
+_LINE_RE = re.compile(
+    r"heal_ops (\d+) digest (\S+) reconnects (\d+) dup_dropped (\d+) "
+    r"crc_errors (\d+) replayed (\d+)")
+
+
+def run_cell(env_extra, timeout):
+    _port[0] += 9
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MPI4JAX_TPU_TIMEOUT_S"] = "30"
+    env.update(env_extra)
+    try:
+        res = subprocess.run(
+            [sys.executable, LAUNCHER, "-n", "2",
+             "--port", str(_port[0]), PROGRAM],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired as e:
+        return None, (e.stdout or b"").decode("utf-8", "replace"), \
+            (e.stderr or b"").decode("utf-8", "replace")
+    return res.returncode, res.stdout, res.stderr
+
+
+def heal_lines(stdout):
+    out = {}
+    for m in _LINE_RE.finditer(stdout):
+        out[int(m.group(1))] = (m.group(2),) + tuple(
+            int(m.group(i)) for i in range(3, 7))
+    return out
+
+
+def cell_env(fault, uring, shm, engine):
+    env = {
+        "MPI4JAX_TPU_RETRY": "4",
+        "MPI4JAX_TPU_RETRY_BACKOFF_MS": "50",
+        "MPI4JAX_TPU_URING": uring,
+        "MPI4JAX_TPU_DISABLE_SHM": "0" if shm == "on" else "1",
+    }
+    if engine == "on":
+        env["MPI4JAX_TPU_PROGRESS_THREAD"] = "1"
+        if shm == "on":
+            # shm traffic can't be reset: the fault lands on the idle
+            # TCP link underneath, and only the progress thread's
+            # heartbeats can find it — give them an idle window
+            env["MPI4JAX_TPU_HEARTBEAT_S"] = "0.2"
+            env["HEAL_OPS_SLEEP_S"] = "1.5"
+    else:
+        env["MPI4JAX_TPU_PROGRESS_THREAD"] = "0"
+    if fault is not None:
+        env["MPI4JAX_TPU_FAULT"] = (
+            "rank=0,point=send,after=5," + FAULTS[fault])
+    return env
+
+
+def uring_available():
+    code = (
+        "import sys, types, os; sys.path.insert(0, %r)\n"
+        "pkg = types.ModuleType('mpi4jax_tpu')\n"
+        "pkg.__path__ = [os.path.join(%r, 'mpi4jax_tpu')]\n"
+        "sys.modules['mpi4jax_tpu'] = pkg\n"
+        "from mpi4jax_tpu.runtime import bridge\n"
+        "print('status=' + str(bridge.uring_status()))\n" % (REPO, REPO))
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300, env={**os.environ, "MPI4JAX_TPU_URING": "auto"},
+        cwd=REPO)
+    return any(line == "status=on" or line.startswith("status=on")
+               for line in res.stdout.splitlines())
+
+
+def classify(fault, shm, rc, stdout, stderr, baseline):
+    """(verdict, pass?, note) for one cell run."""
+    if rc is None:
+        return "HANG", False, "cell timed out"
+    lines = heal_lines(stdout)
+    if rc == 0:
+        if set(lines) != {0, 1}:
+            return "NO-REPORT", False, "rank report lines missing"
+        got = (lines[0][0], lines[1][0])
+        if got != baseline:
+            return "CORRUPTED", False, (
+                f"digests {got} != fault-free {baseline}")
+        healed = any(v[1] >= 1 for v in lines.values())
+        if fault == "corrupt" and shm == "off":
+            crc_seen = (any(v[3] >= 1 for v in lines.values())
+                        or "header CRC mismatch" in stderr)
+            if not crc_seen:
+                return "UNDETECTED", False, (
+                    "corrupt cell completed without a CRC detection")
+        counters = "reconnects=%d+%d replayed=%d+%d" % (
+            lines[0][1], lines[1][1], lines[0][4], lines[1][4])
+        return ("HEALED" if healed else "CLEAN"), True, counters
+    loud = ("escalating (poison -> abort -> elastic)" in stderr
+            or "post-mortem" in stderr)
+    if loud:
+        return "ESCALATED", True, "loud failure (no hang, no corruption)"
+    return "SILENT-FAIL", False, f"rc={rc} with no escalation evidence"
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--cell-timeout", type=float, default=120.0)
+    ap.add_argument("--fault", choices=sorted(FAULTS), action="append",
+                    help="restrict to specific fault(s)")
+    args = ap.parse_args()
+    faults = args.fault or ["reset", "drop", "delay", "corrupt"]
+
+    urings = ["0"]
+    if uring_available():
+        urings.append("1")
+    else:
+        print("chaos: io_uring unavailable on this kernel — "
+              "URING=1 column SKIPPED (poll column still runs)")
+
+    # one fault-free baseline pins the bit-identical contract; the
+    # digests are knob-independent (heal_ops asserts every payload)
+    rc, stdout, stderr = run_cell(cell_env(None, "0", "off", "off"),
+                                  args.cell_timeout)
+    lines = heal_lines(stdout)
+    if rc != 0 or set(lines) != {0, 1}:
+        print("chaos: fault-free baseline failed:\n" + stderr[-2000:])
+        return 2
+    baseline = (lines[0][0], lines[1][0])
+    print(f"chaos: baseline digests r0={baseline[0]} r1={baseline[1]}")
+
+    failures = 0
+    for fault in faults:
+        for uring in urings:
+            for shm in ("off", "on"):
+                for engine in ("off", "on"):
+                    rc, stdout, stderr = run_cell(
+                        cell_env(fault, uring, shm, engine),
+                        args.cell_timeout)
+                    verdict, ok, note = classify(
+                        fault, shm, rc, stdout, stderr, baseline)
+                    tag = "ok  " if ok else "FAIL"
+                    print(f"chaos: [{tag}] fault={fault:<7} "
+                          f"uring={uring} shm={shm:<3} engine={engine:<3}"
+                          f" -> {verdict:<10} {note}")
+                    if not ok:
+                        failures += 1
+                        sys.stdout.write(stderr[-1500:] + "\n")
+    if failures:
+        print(f"chaos: {failures} cell(s) violated the heal-or-escalate "
+              "contract")
+        return 1
+    print("chaos: matrix green — every cell healed bit-identically or "
+          "escalated loudly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
