@@ -17,6 +17,18 @@ verify-corpus:
 update-goldens:
 	$(PY) tools/verify_corpus.py --update-goldens
 
+# Large-np verification gate (tools/scale_harness.py): the committed
+# golden plans are re-verified as np-parametric schedule families on
+# the 8→512 rank ladder — symbolic quotient vs concrete matcher
+# differential, every plan PROVED at 512 via the class-rotation
+# prover, simulator oracles and joint-tuner sanity at 512 — and the
+# evidence lands in BENCH_verifier_scale.json (review + commit after
+# an intentional analyzer/prover change).  Import-light: runs on any
+# host, jax or not.  Wired as a tier-1 test
+# (tests/test_verify_scale.py, --quick ladder under a wall budget).
+verify-scale:
+	$(PY) tools/scale_harness.py
+
 # sanitizer builds of the native transport (tests/test_sanitizers.py:
 # loopback pairs, the progress engine, the elastic shrink-under-load
 # three-rank scenario, and the self-heal reconnect pairs all run
@@ -32,4 +44,4 @@ chaos:
 	$(MAKE) -C native libtpucomm-noffi
 	$(PY) tools/chaos_matrix.py
 
-.PHONY: verify-corpus update-goldens tsan asan chaos
+.PHONY: verify-corpus update-goldens verify-scale tsan asan chaos

@@ -50,6 +50,27 @@ from ._plan import (  # noqa: F401
 )
 from ._schedule import trace_rank_schedule  # noqa: F401
 from ._sim import SimAbort, VirtualWorld  # noqa: F401
+from ._symbolic import (  # noqa: F401
+    SYMBOLIC_MIN_NP,
+    FallbackNeeded,
+    SymmetryPartition,
+    Uncanonicalizable,
+    match_schedules_symbolic,
+    partition_schedules,
+    symbolic_mode,
+    verify_schedules,
+)
+
+
+def _canonical_finding_key(f):
+    """Total content order over findings: severity, kind, ranks, comm,
+    message, sites.  Fully content-determined, so the final report
+    order is independent of the *discovery* order — the property that
+    lets the symbolic (rank-symmetry) path reproduce concrete reports
+    byte-for-byte, and keeps big-np ``analyze --json`` output stable
+    across analyzer-internal reorderings."""
+    return (0 if f.severity == "error" else 1, f.kind,
+            tuple(f.ranks), str(f.comm), f.message, tuple(f.sites))
 
 
 def _dedupe(findings):
@@ -60,7 +81,7 @@ def _dedupe(findings):
             continue
         seen.add(key)
         out.append(f)
-    out.sort(key=lambda f: (0 if f.severity == "error" else 1, f.kind))
+    out.sort(key=_canonical_finding_key)
     return out
 
 
@@ -95,7 +116,8 @@ def check(fn, *args, world_size: int = 2, **kwargs) -> Report:
         value_deps[rank] = vdeps
         findings.extend(fnds)
     comms = {(0,): tuple(range(world_size))}
-    findings.extend(match_schedules(schedules, comms))
+    match_findings, symmetry = verify_schedules(schedules, comms)
+    findings.extend(match_findings)
     report = Report(
         world_size=world_size,
         target=getattr(fn, "__name__", repr(fn)),
@@ -107,6 +129,7 @@ def check(fn, *args, world_size: int = 2, **kwargs) -> Report:
         cache_key=schedule_cache_key(schedules, world_size),
     )
     report.value_deps = value_deps
+    report.symmetry = symmetry
     return report
 
 
@@ -119,7 +142,25 @@ def check_program(path: str, world_size: int, timeout_s=None,
     world = VirtualWorld(world_size, path, timeout_s=timeout_s, argv=argv)
     report = world.run()
     report.findings = _dedupe(report.findings)
+    report.symmetry = _maybe_partition(report.events, report.comms)
     return report
+
+
+def _maybe_partition(events_by_rank, comms):
+    """The rank-symmetry partition of an extracted schedule set, when
+    the knob allows, the world is big enough for the symbolic path to
+    matter, and the program canonicalizes — else None.  Gated at
+    ``SYMBOLIC_MIN_NP`` so small-world reports (and every golden) stay
+    bit-for-bit what they always were."""
+    from . import _symbolic
+
+    if _symbolic.symbolic_mode() != "auto" \
+            or len(events_by_rank) < SYMBOLIC_MIN_NP:
+        return None
+    try:
+        return partition_schedules(events_by_rank, comms)
+    except Uncanonicalizable:
+        return None
 
 
 def plan_report(report: Report, **kwargs) -> ExecutionPlan:
@@ -130,6 +171,7 @@ def plan_report(report: Report, **kwargs) -> ExecutionPlan:
     marks, and the equivalence prover replays both schedules through the
     match simulator before the plan may execute.  Attaches the plan to
     ``report.plan`` and returns it."""
+    kwargs.setdefault("symmetry", getattr(report, "symmetry", None))
     plan = compile_schedules(
         report.events,
         report.comms or {(0,): tuple(range(report.world_size))},

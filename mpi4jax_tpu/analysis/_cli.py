@@ -51,6 +51,13 @@ def main(argv=None) -> int:
     ap.add_argument("--show-output", action="store_true",
                     help="echo the analyzed program's captured "
                          "stdout/stderr")
+    ap.add_argument("--symbolic", choices=("auto", "off"), default=None,
+                    help="rank-symbolic analysis: auto (default; the "
+                         "symbolic path engages on canonicalizable "
+                         "schedules at large world sizes, with sound "
+                         "concrete fallback) or off (pin the concrete "
+                         "path bit-for-bit).  Overrides "
+                         "MPI4JAX_TPU_ANALYZE_SYMBOLIC for this run")
     ap.add_argument("--errors-only", action="store_true",
                     help="exit 3 only on error-severity findings; "
                          "warnings are still printed (the launch "
@@ -81,6 +88,10 @@ def main(argv=None) -> int:
     if args.np_ < 1:
         print("--np must be >= 1", file=sys.stderr)
         return EXIT_ERROR
+    if args.symbolic is not None:
+        import os
+
+        os.environ["MPI4JAX_TPU_ANALYZE_SYMBOLIC"] = args.symbolic
 
     from . import check_program
 

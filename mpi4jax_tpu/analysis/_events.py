@@ -243,6 +243,42 @@ class Finding:
         }
 
 
+def collapse_findings(findings, class_of) -> list:
+    """Symmetry-collapsed view of a finding list: findings whose rank
+    tuples land in the same equivalence classes (same kind, same comm)
+    merge into one entry — the lowest-rank representative finding plus
+    the instance count and the affected-rank total.  Big-np reports
+    stay readable and byte-stable: 510 identical ring findings become
+    one representative + ``count: 510``.
+
+    ``class_of`` maps rank -> class index (``SymmetryPartition.
+    class_of``); ranks outside it (defensive) collapse as themselves.
+    """
+    groups: dict = {}
+    order = []
+    n = len(class_of)
+    for f in findings:
+        key = (f.kind,
+               tuple(class_of[r] if 0 <= r < n else ("r", r)
+                     for r in f.ranks),
+               tuple(f.comm))
+        g = groups.get(key)
+        if g is None:
+            g = groups[key] = {"rep": f, "count": 0, "ranks": set()}
+            order.append(key)
+        g["count"] += 1
+        g["ranks"].update(f.ranks)
+    return [
+        {
+            "kind": key[0],
+            "representative": groups[key]["rep"].to_json(),
+            "count": groups[key]["count"],
+            "affected_ranks": len(groups[key]["ranks"]),
+        }
+        for key in order
+    ]
+
+
 @dataclass
 class Report:
     """Verdict of one verification run."""
@@ -265,6 +301,10 @@ class Report:
     #: attached by the schedule compiler (analysis._plan) when --optimize
     #: runs: a PlanResult, or None
     plan: object = field(default=None, repr=False)
+    #: rank-symmetry partition (analysis._symbolic.SymmetryPartition)
+    #: when the world canonicalized, else None — drives the symmetry-
+    #: collapsed findings view in to_json and the quotient prover
+    symmetry: object = field(default=None, repr=False)
 
     @property
     def ok(self) -> bool:
@@ -310,4 +350,9 @@ class Report:
         }
         if self.plan is not None:
             out["plan"] = self.plan.to_json()
+        if self.symmetry is not None:
+            sym = self.symmetry.to_json()
+            sym["findings_collapsed"] = collapse_findings(
+                self.findings, self.symmetry.class_of)
+            out["symmetry"] = sym
         return out

@@ -82,6 +82,23 @@ def default_detach_threshold() -> int:
     return max(ENGINE_DETACH_FLOOR, default_coalesce_bytes())
 
 
+def send_part_event(ev: CommEvent, dest: int) -> CommEvent:
+    """The buffered-send half of ``ev`` toward ``dest``, exactly as the
+    matcher pushes it onto the channel: a plain ``send`` is itself; the
+    combined ops (``sendrecv``, ``shift2``) synthesize a send event
+    carrying the op's send tag and payload signature.  The symbolic
+    (rank-symmetry) layer re-synthesizes concrete findings through this
+    same constructor, so its lifted findings are byte-identical to the
+    concrete simulation's."""
+    if ev.kind == "send":
+        return ev
+    tag = ev.sendtag if ev.kind == "sendrecv" else ev.tag
+    return CommEvent(
+        rank=ev.rank, idx=ev.idx, kind="send", comm=ev.comm,
+        dest=dest, tag=tag, dtype=ev.dtype, shape=ev.shape, site=ev.site,
+    )
+
+
 def _site_pair(a: CommEvent, b: CommEvent) -> Tuple[str, ...]:
     return tuple(
         f"rank {e.rank}: {e.describe()}" for e in (a, b) if e is not None
@@ -380,6 +397,7 @@ def match_schedules(
     comms: Dict[Tuple, Tuple[int, ...]],
     deliveries: Optional[dict] = None,
     service_order: Optional[Sequence[int]] = None,
+    stats: Optional[dict] = None,
 ) -> List[Finding]:
     """Simulate matching of all rank schedules; return the findings.
 
@@ -399,6 +417,11 @@ def match_schedules(
     (default: ascending) — the prover varies it to expose matches that
     depend on which rank the simulator happens to serve first
     (ANY_SOURCE races).
+
+    ``stats``, when a dict is passed, receives ``{"steps": N}`` — the
+    number of successful event completions the simulation performed
+    (the scale harness charts this against the symbolic path's class-
+    level step count).
     """
     findings: List[Finding] = []
     pcs = {r: 0 for r in schedules}
@@ -456,23 +479,16 @@ def match_schedules(
             return True
         if ev.kind == "sendrecv":
             if not ev._sent:
-                send_part = CommEvent(
-                    rank=r, idx=ev.idx, kind="send", comm=ev.comm,
-                    dest=ev.dest, tag=ev.sendtag, dtype=ev.dtype,
-                    shape=ev.shape, site=ev.site,
-                )
-                chans.push(ev.comm, me, ev.dest, send_part)
+                chans.push(ev.comm, me, ev.dest,
+                           send_part_event(ev, ev.dest))
                 ev._sent = True
             return _complete_recv(r, ev, me, ev.source, ev.recvtag)
         if ev.kind == "shift2":
             if not ev._sent:
                 for peer in (ev.lo, ev.hi):
                     if peer is not None and peer >= 0:
-                        chans.push(ev.comm, me, peer, CommEvent(
-                            rank=r, idx=ev.idx, kind="send", comm=ev.comm,
-                            dest=peer, tag=ev.tag, dtype=ev.dtype,
-                            shape=ev.shape, site=ev.site,
-                        ))
+                        chans.push(ev.comm, me, peer,
+                                   send_part_event(ev, peer))
                 ev._sent = True
             needed = [p for p in (ev.lo, ev.hi) if p is not None and p >= 0]
             if any(chans.head(ev.comm, p, me) is None for p in needed):
@@ -525,11 +541,15 @@ def match_schedules(
 
     service = (list(service_order) if service_order is not None
                else sorted(schedules))
+    steps = 0
     for _ in range(2 * total + 2):
         progressed = False
         for r in service:
             while try_advance(r):
                 progressed = True
+                steps += 1
+                if stats is not None:
+                    stats["steps"] = steps
                 if len(findings) > MAX_FINDINGS:
                     findings.append(Finding(
                         "analysis_timeout",

@@ -709,6 +709,7 @@ def prove_plan(
     comms: Dict[Tuple, Tuple[int, ...]],
     plan: ExecutionPlan,
     max_interleavings: int = MAX_INTERLEAVINGS,
+    symmetry=None,
 ) -> bool:
     """Replay original and planned schedules through the match simulator.
 
@@ -729,7 +730,27 @@ def prove_plan(
     values, since payload content rides sends unchanged.  A replay that
     stalls shows up as (a): deadlock/unmatched kinds.  Sets
     ``plan.proved`` and ``plan.proof``.
+
+    ``symmetry`` (a ``_symbolic.SymmetryPartition``) quotients the
+    proof: one replay per class-level configuration, with rank-service
+    rotations collapsed to class-service rotations — the step that
+    keeps the budget independent of np (512 concrete rotations exceed
+    ``MAX_INTERLEAVINGS``; the quotient needs one per class).  A plan
+    outside the symbolic model silently falls back to the concrete
+    proof below, which stays sound at any size (at worst: budget
+    exceeded, plan rejected unproven).
     """
+    if symmetry is not None:
+        from . import _symbolic
+
+        try:
+            verdict = _symbolic.prove_plan_symbolic(
+                events_by_rank, comms, plan, symmetry,
+                max_interleavings=max_interleavings)
+        except (_symbolic.Uncanonicalizable, _symbolic.FallbackNeeded):
+            verdict = None
+        if verdict is not None:
+            return verdict
     ranks = sorted(events_by_rank)
     base_orders = {r: list(range(len(v)))
                    for r, v in events_by_rank.items()}
@@ -805,11 +826,15 @@ def compile_schedules(
     bucket_bytes: Optional[int] = None,
     max_interleavings: int = MAX_INTERLEAVINGS,
     cost_model=None,
+    symmetry=None,
 ) -> ExecutionPlan:
     """Build the most aggressive provable plan: try hoisting + grouping,
     fall back to no-hoist, then to the trivial (unrewritten) plan.  The
     returned plan always carries ``proved`` and the downgrade reasons —
-    an unsafe rewrite is *demonstrably* rejected, never silently run."""
+    an unsafe rewrite is *demonstrably* rejected, never silently run.
+
+    ``symmetry`` (a ``_symbolic.SymmetryPartition``) is forwarded to
+    the equivalence prover; see :func:`prove_plan`."""
     if cost_model is None:
         # resolve the env-named model once for all three attempts
         cost_model = env_cost_model()
@@ -820,7 +845,8 @@ def compile_schedules(
         bucket_bytes=bucket_bytes, cost_model=cost_model,
     )
     plan = build_plan(events_by_rank, comms, aggressive=True, **kw)
-    if prove_plan(events_by_rank, comms, plan, max_interleavings):
+    if prove_plan(events_by_rank, comms, plan, max_interleavings,
+                  symmetry=symmetry):
         return plan
     rejected_reasons = list(plan.reasons)
 
@@ -829,7 +855,8 @@ def compile_schedules(
         "hoisted plan rejected by the equivalence prover; "
         "retrying without recv hoisting"
     ]
-    if prove_plan(events_by_rank, comms, fallback, max_interleavings):
+    if prove_plan(events_by_rank, comms, fallback, max_interleavings,
+                  symmetry=symmetry):
         fallback.reasons = [r for r in fallback.reasons
                             if not r.startswith("interleaving ")]
         return fallback
@@ -840,7 +867,8 @@ def compile_schedules(
         "grouped plan rejected by the equivalence prover; "
         "schedule left unrewritten"
     ]
-    prove_plan(events_by_rank, comms, trivial, max_interleavings)
+    prove_plan(events_by_rank, comms, trivial, max_interleavings,
+               symmetry=symmetry)
     return trivial
 
 

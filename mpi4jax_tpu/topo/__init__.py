@@ -42,7 +42,7 @@ import hashlib
 import json
 import os
 import socket
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 FINGERPRINT_VERSION = 1
 
@@ -99,6 +99,28 @@ def parse_fake_hosts(spec: Optional[str], size: int) -> Optional[List[Optional[s
                 seen.add(r)
                 labels[r] = f"fake-host-{group_idx}"
     return labels
+
+
+def synthetic_islands(world_size: int, n_islands: int
+                      ) -> Tuple[List[List[int]], str]:
+    """A contiguous equal-split island map for virtual-scale testing:
+    ``(islands, fake_hosts_spec)`` where ``islands`` is the member-rank
+    lists in island order (the shape ``Topology.islands`` and the
+    ``simulate_h*`` oracles take) and ``fake_hosts_spec`` is the
+    ``MPI4JAX_TPU_FAKE_HOSTS`` string that produces exactly that
+    partition under :func:`parse_fake_hosts`.  ``world_size`` must
+    split evenly — a synthetic shape that silently dropped ranks
+    would test the wrong world."""
+    if n_islands < 1 or world_size % n_islands:
+        raise ValueError(
+            f"cannot split {world_size} ranks into {n_islands} equal "
+            "islands")
+    per = world_size // n_islands
+    islands = [list(range(b, b + per))
+               for b in range(0, world_size, per)]
+    spec = "|".join(",".join(f"r{r}" for r in members)
+                    for members in islands)
+    return islands, spec
 
 
 def _boot_id() -> str:

@@ -155,6 +155,31 @@ def eligible_combos(op: str, *, multi_island: bool, quant_mode: str,
     return out
 
 
+def synthetic_measure(ranks: int) -> Callable[[str, int, str],
+                                              Optional[float]]:
+    """A deterministic alpha-beta cost table shaped like a real
+    multi-island deployment, for driving :func:`joint_search` without
+    live communication (the verify-scale harness and tuner unit tests
+    at virtual world sizes): hierarchical schedules amortize the
+    inter-island latency term, quantized wire formats cut the
+    bandwidth term, the ICI leg shaves intra-island latency.  Same
+    (op, nbytes, combo) → same seconds, every call, every host — the
+    point is search-machinery sanity at scale, not real timings."""
+    def measure(op: str, nbytes: int, combo: str) -> Optional[float]:
+        algo, legs = _combo_parts(combo)
+        alpha = 40e-6 if algo.startswith("h") else 120e-6
+        beta = 2.0e-9
+        if algo in ("qring", "qrd", "qalltoall", "hqalltoall") \
+                or "q" in legs:
+            beta *= 0.55
+        if "ici" in legs:
+            alpha *= 0.8
+        steps = 2.0 if op == "allreduce" else 1.0
+        return alpha * steps + beta * float(nbytes) \
+            + 1e-9 * max(0, ranks - 1)
+    return measure
+
+
 def _anchor_sizes(sizes: Sequence[int], n_anchors: int = 3) -> List[int]:
     """The sizes every combo is measured at to seed the model: the
     extremes plus the middle of the ladder (log-wise) — enough to pin

@@ -202,6 +202,22 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 ``launch --verify``); a program that
                                 spins past it fails analysis with an
                                 ``analysis_timeout`` finding.
+- ``MPI4JAX_TPU_ANALYZE_SYMBOLIC`` — rank-symbolic schedule analysis
+                                (analysis/_symbolic.py): ``auto``
+                                (default — canonicalizable schedules at
+                                large world sizes verify once per rank-
+                                equivalence class, with sound fallback
+                                to the concrete path) or ``off`` (pin
+                                the historic concrete path bit-for-
+                                bit).  Strict parse: anything else
+                                aborts loudly — a typo'd mode must not
+                                silently change which verification
+                                path produced a verdict.  Verdicts are
+                                byte-identical either way (the
+                                differential gate in
+                                tests/test_symbolic.py enforces it);
+                                the knob exists for pinning and for
+                                bisection.
 - ``MPI4JAX_TPU_NATIVE_LIB``  — absolute path of the native transport
                                 library to load instead of the built
                                 ``runtime/_native/libtpucomm.so``
@@ -526,6 +542,7 @@ KNOBS = {
     "MPI4JAX_TPU_SLOT": "launcher-slot identity of a respawned rank",
     "MPI4JAX_TPU_CKPT_DIR": "default sharded-checkpoint directory",
     "MPI4JAX_TPU_ANALYZE_TIMEOUT_S": "static verifier wall deadline",
+    "MPI4JAX_TPU_ANALYZE_SYMBOLIC": "rank-symbolic analysis: auto/off",
     "MPI4JAX_TPU_NATIVE_LIB": "override path of the native transport .so",
     "MPI4JAX_TPU_SERVE_MAX_BATCH": "serving: initial decode batch ceiling",
     "MPI4JAX_TPU_SERVE_QUEUE_CAP": "serving: bounded admission queue size",
@@ -788,6 +805,24 @@ def retry_replay_slack() -> int:
             f"cannot parse MPI4JAX_TPU_RETRY_REPLAY_SLACK={raw!r} as "
             "an integer")
     return max(0, v)
+
+
+def analyze_symbolic_mode() -> str:
+    """``MPI4JAX_TPU_ANALYZE_SYMBOLIC`` as "auto" | "off" (strict like
+    topo_mode: a typo'd mode aborts loudly rather than silently
+    changing which verification path produced a verdict).  Mirrors
+    ``analysis._symbolic.symbolic_mode`` byte-for-byte — the analysis
+    package reads the environment directly to stay standalone-loadable,
+    and the two parsers must never drift apart."""
+    raw = os.environ.get("MPI4JAX_TPU_ANALYZE_SYMBOLIC")
+    if raw is None or not raw.strip():
+        return "auto"
+    v = raw.strip()
+    if v in ("auto", "off"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_ANALYZE_SYMBOLIC={raw!r} "
+        "(expected auto or off)")
 
 
 def analyze_timeout_s() -> float:

@@ -1,0 +1,483 @@
+"""Unit + differential tests for rank-symbolic analysis
+(analysis/_symbolic.py).
+
+Loaded standalone (no package import, no jax), like
+test_analysis_match.py: the symbolic layer is pure Python by design, so
+the differential gate — symbolic verdicts byte-identical to concrete —
+stays pinned even on hosts whose jax predates the package minimum.
+The corpus-program half of the gate lives in test_symbolic_corpus.py
+(skipped where ``import mpi4jax_tpu`` is unavailable).
+"""
+
+import importlib.util
+import os
+import sys
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "mpi4jax_tpu", "analysis")
+
+
+def _load():
+    """Load the analysis stack standalone under a private package."""
+    if "m4j_sy._symbolic" in sys.modules:
+        return {n: sys.modules[f"m4j_sy.{n}"]
+                for n in ("_events", "_match", "_deps", "_plan",
+                          "_symbolic")}
+    pkg = types.ModuleType("m4j_sy")
+    pkg.__path__ = [PKG]
+    sys.modules["m4j_sy"] = pkg
+    mods = {}
+    for name in ("_events", "_match", "_deps", "_plan", "_symbolic"):
+        spec = importlib.util.spec_from_file_location(
+            f"m4j_sy.{name}", os.path.join(PKG, f"{name}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[f"m4j_sy.{name}"] = mod
+        spec.loader.exec_module(mod)
+        mods[name] = mod
+    return mods
+
+
+M = _load()
+EV, MT, PL, SY = M["_events"], M["_match"], M["_plan"], M["_symbolic"]
+
+
+# -- the report pipeline's canonical ordering, mirrored from
+#    analysis/__init__._canonical_finding_key (package import needs jax)
+def _key(f):
+    return (0 if f.severity == "error" else 1, f.kind,
+            tuple(f.ranks), str(f.comm), f.message, tuple(f.sites))
+
+
+def _dedupe(findings):
+    out, seen = [], set()
+    for f in findings:
+        key = (f.kind, f.ranks, f.comm, f.message, f.sites)
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(f)
+    out.sort(key=_key)
+    return out
+
+
+def world(n):
+    return {(0,): tuple(range(n))}
+
+
+def ev(r, i, kind, **kw):
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("shape", (4,))
+    kw.setdefault("site", f"prog.py:{10 + i}")
+    return EV.CommEvent(r, i, kind, **kw)
+
+
+# ---------------------------------------------------------------------------
+# schedule families (np-parametric, mirroring the verify-corpus
+# communication patterns: rings, halos, pairs, collectives, mixes)
+
+
+def ring(n, tag=0):
+    return {r: [ev(r, 0, "sendrecv", dest=(r + 1) % n,
+                   source=(r - 1) % n, sendtag=tag, recvtag=tag)]
+            for r in range(n)}
+
+
+def halo_walls(n):
+    """Non-periodic shift2 halo: rank 0 and rank n-1 see walls, so
+    refinement must keep separating boundary roles — every rank its own
+    class (distance to each wall differs)."""
+    return {r: [ev(r, 0, "shift2", lo=r - 1,
+                   hi=r + 1 if r + 1 < n else -1, tag=3)]
+            for r in range(n)}
+
+
+def colls(n):
+    return {r: [ev(r, 0, "allreduce", reduce_op="SUM"),
+                ev(r, 1, "bcast", root=0),
+                ev(r, 2, "barrier", shape=(), dtype="none")]
+            for r in range(n)}
+
+
+def coll_mismatch(n):
+    s = colls(n)
+    s[n - 1][0] = ev(n - 1, 0, "allreduce", reduce_op="MAX")
+    return s
+
+
+def pairs(n):
+    return {r: [ev(r, 0, "sendrecv",
+                   dest=r + 1 if r % 2 == 0 else r - 1,
+                   source=r + 1 if r % 2 == 0 else r - 1,
+                   sendtag=1, recvtag=1)]
+            for r in range(n)}
+
+
+def tag_mismatch(n):
+    s = {}
+    for r in range(n):
+        p = r + 1 if r % 2 == 0 else r - 1
+        s[r] = [ev(r, 0, "send", dest=p, tag=1 if r % 2 == 0 else 2),
+                ev(r, 1, "recv", source=p, tag=1)]
+    return s
+
+
+def deadlock_cycle(n):
+    return {r: [ev(r, 0, "recv", source=(r - 1) % n, tag=0),
+                ev(r, 1, "send", dest=(r + 1) % n, tag=0)]
+            for r in range(n)}
+
+
+def unmatched_send(n):
+    return {r: [ev(r, 0, "send", dest=(r + 1) % n, tag=5)]
+            for r in range(n)}
+
+
+def unmatched_recv(n):
+    return {r: [ev(r, 0, "recv", source=(r - 1) % n, tag=5)]
+            for r in range(n)}
+
+
+def shape_mismatch(n):
+    return {r: [ev(r, 0, "sendrecv",
+                   dest=r + 1 if r % 2 == 0 else r - 1,
+                   source=r + 1 if r % 2 == 0 else r - 1,
+                   sendtag=0, recvtag=0,
+                   shape=(4,) if r % 2 == 0 else (8,))]
+            for r in range(n)}
+
+
+def block_ring(n, a=4):
+    """Island-local rings (islands of ``a``): the block peer pattern
+    the hierarchical tiers produce."""
+    s = {}
+    for r in range(n):
+        base = (r // a) * a
+        s[r] = [ev(r, 0, "sendrecv", dest=base + (r - base + 1) % a,
+                   source=base + (r - base - 1) % a, sendtag=0,
+                   recvtag=0)]
+    return s
+
+
+def uneven_blocks(n):
+    """Uneven partition: one island of 3 then islands of 2 — pair
+    exchange inside each island, the odd island doing a 3-ring.  The
+    refinement has to keep the tail-island roles apart."""
+    s = {}
+    isl = [list(range(0, 3))] + [list(range(b, min(b + 2, n)))
+                                 for b in range(3, n, 2)]
+    for members in isl:
+        k = len(members)
+        for j, r in enumerate(members):
+            s[r] = [ev(r, 0, "sendrecv",
+                       dest=members[(j + 1) % k],
+                       source=members[(j - 1) % k],
+                       sendtag=2, recvtag=2)]
+    return s
+
+
+def mixed(n):
+    return {r: [ev(r, 0, "sendrecv", dest=(r + 1) % n,
+                   source=(r - 1) % n, sendtag=0, recvtag=0),
+                ev(r, 1, "allreduce", reduce_op="SUM"),
+                ev(r, 2, "sendrecv", dest=(r - 1) % n,
+                   source=(r + 1) % n, sendtag=9, recvtag=9)]
+            for r in range(n)}
+
+
+FAMILIES = {
+    "ring": ring,
+    "halo_walls": halo_walls,
+    "colls": colls,
+    "coll_mismatch": coll_mismatch,
+    "pairs": pairs,
+    "tag_mismatch": tag_mismatch,
+    "deadlock_cycle": deadlock_cycle,
+    "unmatched_send": unmatched_send,
+    "unmatched_recv": unmatched_recv,
+    "shape_mismatch": shape_mismatch,
+    "block_ring": block_ring,
+    "uneven_blocks": uneven_blocks,
+    "mixed": mixed,
+}
+
+# even-np-only families (pair structure) and island-size constraints
+_NPS = {"pairs": (2, 4, 6, 8, 12), "tag_mismatch": (2, 4, 6, 8, 12),
+        "shape_mismatch": (2, 4, 6, 8, 12), "block_ring": (4, 8, 12),
+        "uneven_blocks": (5, 7, 9, 11)}
+_DEFAULT_NPS = (2, 3, 4, 5, 8, 12)
+
+
+def _cases():
+    for name, fam in sorted(FAMILIES.items()):
+        for n in _NPS.get(name, _DEFAULT_NPS):
+            yield name, fam, n
+
+
+# ---------------------------------------------------------------------------
+# the differential gate: symbolic verdicts byte-identical to concrete
+
+
+@pytest.mark.parametrize("name,fam,n",
+                         [pytest.param(*c, id=f"{c[0]}-np{c[2]}")
+                          for c in _cases()])
+def test_differential_findings(name, fam, n):
+    sch = fam(n)
+    conc = _dedupe(MT.match_schedules(sch, world(n)))
+    part = SY.partition_schedules(sch, world(n))
+    sym = _dedupe(SY.match_schedules_symbolic(sch, world(n), part))
+    assert [f.to_json() for f in sym] == [f.to_json() for f in conc]
+
+
+@pytest.mark.parametrize("name,fam,n",
+                         [pytest.param(*c, id=f"{c[0]}-np{c[2]}")
+                          for c in _cases()])
+def test_differential_plans(name, fam, n):
+    """compile_schedules with the symmetry partition must produce the
+    same plan, the same proved verdict, and the same reasons as the
+    concrete prover."""
+    sch = fam(n)
+    part = SY.partition_schedules(sch, world(n))
+    pc = PL.compile_schedules(sch, world(n), world_size=n)
+    ps = PL.compile_schedules(sch, world(n), world_size=n,
+                              symmetry=part)
+    assert ps.proved == pc.proved
+    assert ps.reasons == pc.reasons
+    assert not PL.diff_plans(pc, ps)
+    assert ps.cache_key == pc.cache_key
+
+
+def test_symbolic_prover_engages():
+    """On a provable schedule the symmetry-aware compile records the
+    class count in the proof blob — evidence the quotient prover (not
+    the concrete one) produced the verdict."""
+    n = 12
+    sch = ring(n)
+    part = SY.partition_schedules(sch, world(n))
+    ps = PL.compile_schedules(sch, world(n), world_size=n,
+                              symmetry=part)
+    assert ps.proved
+    assert ps.proof["symmetry_classes"] == part.n_classes == 1
+    # budget independent of np: identity + planned + (classes-1)
+    # rotations, NOT np rotations
+    assert ps.proof["interleavings"] < n
+
+
+def test_symbolic_prover_beats_concrete_budget():
+    """The tentpole's reason to exist: at np past MAX_INTERLEAVINGS the
+    concrete prover must reject the plan unproven (budget), while the
+    class-rotation quotient proves it."""
+    n = PL.MAX_INTERLEAVINGS + 44  # 300 with the default budget of 256
+    sch = ring(n)
+    pc = PL.compile_schedules(sch, world(n), world_size=n)
+    assert not pc.proved
+    assert any("interleaving budget exceeded" in r for r in pc.reasons)
+    part = SY.partition_schedules(sch, world(n))
+    ps = PL.compile_schedules(sch, world(n), world_size=n,
+                              symmetry=part)
+    assert ps.proved
+    assert ps.proof["symmetry_classes"] == 1
+
+
+# ---------------------------------------------------------------------------
+# dispatcher + knob
+
+
+def test_verify_schedules_small_np_stays_concrete(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE_SYMBOLIC", raising=False)
+    n = SY.SYMBOLIC_MIN_NP - 1
+    stats = {}
+    findings, part = SY.verify_schedules(ring(n), world(n), stats=stats)
+    assert stats["mode"] == "concrete"
+    assert part is None
+    assert findings == []
+
+
+def test_verify_schedules_large_np_goes_symbolic(monkeypatch):
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE_SYMBOLIC", raising=False)
+    n = SY.SYMBOLIC_MIN_NP
+    stats = {}
+    findings, part = SY.verify_schedules(ring(n), world(n), stats=stats)
+    assert stats["mode"] == "symbolic"
+    assert part is not None and part.n_classes == 1
+    assert findings == []
+
+
+def test_knob_off_pins_concrete(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_SYMBOLIC", "off")
+    n = 12
+    sch = tag_mismatch(n)
+    stats = {}
+    findings, part = SY.verify_schedules(sch, world(n), stats=stats)
+    assert stats["mode"] == "concrete"
+    assert part is None
+    ref = _dedupe(MT.match_schedules(sch, world(n)))
+    assert ([f.to_json() for f in _dedupe(findings)]
+            == [f.to_json() for f in ref])
+
+
+def test_knob_strict_parser(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_SYMBOLIC", "fast")
+    with pytest.raises(ValueError, match="ANALYZE_SYMBOLIC"):
+        SY.symbolic_mode()
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_SYMBOLIC", " auto ")
+    assert SY.symbolic_mode() == "auto"
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE_SYMBOLIC")
+    assert SY.symbolic_mode() == "auto"
+
+
+def test_wildcard_falls_back_to_concrete(monkeypatch):
+    """ANY_SOURCE receives are outside the symbolic model: the
+    dispatcher must fall back and reproduce concrete findings."""
+    monkeypatch.delenv("MPI4JAX_TPU_ANALYZE_SYMBOLIC", raising=False)
+    n = 12
+    sch = {r: ([ev(r, 0, "send", dest=(r + 1) % n, tag=0)]
+               if r % 2 else
+               [ev(r, 0, "send", dest=(r + 1) % n, tag=0),
+                ev(r, 1, "recv", source=EV.ANY_SOURCE, tag=0)])
+           for r in range(n)}
+    with pytest.raises(SY.Uncanonicalizable):
+        SY.partition_schedules(sch, world(n))
+    stats = {}
+    findings, part = SY.verify_schedules(sch, world(n), stats=stats)
+    assert stats["mode"] == "concrete"
+    assert part is None
+    ref = MT.match_schedules(sch, world(n))
+    assert ([f.to_json() for f in _dedupe(findings)]
+            == [f.to_json() for f in _dedupe(ref)])
+
+
+# ---------------------------------------------------------------------------
+# canonicalization edge cases
+
+
+def test_noncontiguous_ranks_uncanonicalizable():
+    sch = ring(4)
+    del sch[2]
+    with pytest.raises(SY.Uncanonicalizable, match="non-contiguous"):
+        SY.partition_schedules(sch, None)
+
+
+def test_subcomm_uncanonicalizable():
+    n = 12
+    comms = {(0,): tuple(range(n)), (1, 0): (0, 1, 2)}
+    with pytest.raises(SY.Uncanonicalizable, match="sub-comm"):
+        SY.partition_schedules(ring(n), comms)
+
+
+def test_peer_outside_world_uncanonicalizable():
+    n = 4
+    sch = ring(n)
+    sch[1] = [ev(1, 0, "sendrecv", dest=99, source=0, sendtag=0,
+                 recvtag=0)]
+    with pytest.raises(SY.Uncanonicalizable, match="outside the world"):
+        SY.partition_schedules(sch, world(n))
+
+
+def test_partition_halo_separates_boundary_roles():
+    """Non-periodic halo: refinement must keep every rank in its own
+    class (distance-to-wall differs), not collapse the interior."""
+    n = 8
+    part = SY.partition_schedules(halo_walls(n), world(n))
+    assert part.n_classes == n
+
+
+def test_partition_uneven_islands():
+    """Uneven partition (one 3-island + 2-islands): the 3-ring ranks
+    must separate from the pair ranks, and pair ranks must all share
+    one class despite living in different (non-contiguous) islands."""
+    n = 9
+    part = SY.partition_schedules(uneven_blocks(n), world(n))
+    # ranks 0..2 (3-ring) are one class: same descriptor, peers in the
+    # same class.  Pair ranks split by sendrecv alias order (lower vs
+    # upper member), giving 1 + 2 classes.
+    c3 = {part.class_of[r] for r in range(3)}
+    cp = {part.class_of[r] for r in range(3, n)}
+    assert c3.isdisjoint(cp)
+    assert len(c3) == 1
+    assert part.to_json()["world_size"] == n
+    assert sum(c["size"] for c in part.to_json()["classes"]) == n
+
+
+def test_partition_ring_single_class():
+    for n in (2, 3, 8, 64):
+        part = SY.partition_schedules(ring(n), world(n))
+        assert part.n_classes == 1
+        assert part.classes[0] == tuple(range(n))
+        assert part.reps == [0]
+
+
+def test_collapse_findings_symmetry():
+    n = 12
+    sch = tag_mismatch(n)
+    part = SY.partition_schedules(sch, world(n))
+    findings = _dedupe(MT.match_schedules(sch, world(n)))
+    collapsed = EV.collapse_findings(findings, part.class_of)
+    assert len(collapsed) < len(findings)
+    assert sum(c["count"] for c in collapsed) == len(findings)
+    for c in collapsed:
+        assert c["kind"] in EV.FINDING_KINDS
+        assert c["affected_ranks"] >= 1
+        assert c["representative"]["kind"] == c["kind"]
+
+
+# ---------------------------------------------------------------------------
+# np-rescaling peer forms (the scale harness's cross-size layer)
+
+
+def test_fit_peer_form_ring():
+    obs = [(r, n, (r + 1) % n) for n in (6, 8) for r in range(n)]
+    form = SY.fit_peer_form(obs)
+    assert form == ("shift", 1)
+    assert SY.instantiate_peer(form, 511, 512) == 0
+
+
+def test_fit_peer_form_const_vs_shift_needs_two_sizes():
+    """At one world size rank-0's peer 1 is ambiguous (const 1 vs
+    shift +1); a second size disambiguates."""
+    one = [(0, 4, 1)]
+    assert SY.fit_peer_form(one) == ("const", 1)
+    both = [(0, 4, 1), (1, 4, 2), (0, 6, 1), (1, 6, 2), (5, 6, 0)]
+    assert SY.fit_peer_form(both) == ("shift", 1)
+
+
+def test_fit_peer_form_hiconst():
+    obs = [(r, n, n - 1) for n in (4, 8) for r in range(n)]
+    form = SY.fit_peer_form(obs)
+    assert form == ("hiconst", 0)
+    assert SY.instantiate_peer(form, 3, 512) == 511
+
+
+def test_fit_peer_form_walls():
+    # non-periodic +1 shift: wall at the top rank
+    obs = []
+    for n in (4, 6):
+        for r in range(n):
+            obs.append((r, n, r + 1 if r + 1 < n else -1))
+    form = SY.fit_peer_form(obs)
+    assert form == ("shiftwall", 1)
+    assert SY.instantiate_peer(form, 511, 512) == -1
+    assert SY.instantiate_peer(form, 510, 512) == 511
+    # all-wall column
+    assert SY.fit_peer_form([(r, 4, None) for r in range(4)]) \
+        == ("wall",)
+
+
+def test_fit_peer_form_block():
+    obs = [(r, n, (r // 4) * 4) for n in (8, 12) for r in range(n)]
+    form = SY.fit_peer_form(obs, block=4)
+    assert form == ("block", 4, 0)
+    assert SY.instantiate_peer(form, 510, 512) == 508
+
+
+def test_fit_peer_form_non_affine_is_none():
+    # bit-reversal-ish pattern: not affine in rank
+    obs = [(0, 4, 0), (1, 4, 2), (2, 4, 1), (3, 4, 3)]
+    assert SY.fit_peer_form(obs) is None
+
+
+def test_instantiate_unknown_form_raises():
+    with pytest.raises(ValueError):
+        SY.instantiate_peer(("spiral", 3), 0, 8)

@@ -1,0 +1,118 @@
+"""Corpus differential gate for rank-symbolic analysis.
+
+For every program in the verify-corpus manifest, extract the real
+schedules through :func:`check_program` (virtual world, no processes)
+at every world size in 2..8 the program runs at, then pin the symbolic
+verdict byte-identical to the concrete one: finding JSON, cache keys,
+and compiled plans (proved verdict, reasons, plan diff).  Programs the
+symbolic model does not cover (sub-communicators, wildcards) must
+raise :class:`Uncanonicalizable` — the sound-fallback half of the
+contract.
+
+Skipped where ``import mpi4jax_tpu`` is unavailable (old-jax
+containers); the jax-free half of the gate — synthetic families plus
+the golden-plan replay in test_verify_scale.py — runs everywhere.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+try:
+    import mpi4jax_tpu  # noqa: F401  (jax version gate)
+except Exception as err:  # pragma: no cover - old-jax containers
+    pytest.skip(f"mpi4jax_tpu not importable here: {err}",
+                allow_module_level=True)
+
+from mpi4jax_tpu import analysis
+from mpi4jax_tpu.analysis import _match, _plan, _symbolic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROGS = os.path.join(REPO, "tests", "world_programs")
+MANIFEST = os.path.join(PROGS, "golden_plans", "manifest.json")
+
+with open(MANIFEST) as fh:
+    _MANIFEST = json.load(fh)
+
+
+def _entries():
+    for entry in _MANIFEST["programs"]:
+        yield pytest.param(entry, id=f"{entry['program']}-np{entry['np']}")
+
+
+def _normalize_env(monkeypatch):
+    # mirror tools/verify_corpus.py: plan-shaping knobs cleared so the
+    # comparison runs under the documented defaults
+    for knob in ("MPI4JAX_TPU_PROGRESS_THREAD",
+                 "MPI4JAX_TPU_COALESCE_BYTES",
+                 "MPI4JAX_TPU_PLAN_BUCKET_KB", "MPI4JAX_TPU_PLAN",
+                 "MPI4JAX_TPU_FAULT", "MPI4JAX_TPU_ANALYZE_SYMBOLIC"):
+        monkeypatch.delenv(knob, raising=False)
+
+
+@pytest.mark.parametrize("entry", list(_entries()))
+def test_corpus_symbolic_matches_concrete(entry, monkeypatch):
+    """The differential gate, on real extracted schedules: every np in
+    2..8 where the program itself runs clean under the virtual world."""
+    _normalize_env(monkeypatch)
+    path = os.path.join(PROGS, entry["program"])
+    base_np = int(entry["np"])
+    tried = 0
+    for np_ in range(base_np, 9):
+        if np_ != base_np and np_ % base_np:
+            continue  # corpus programs assume their np's divisors hold
+        try:
+            report = analysis.check_program(path, np_)
+        except Exception:
+            continue  # program does not support this world size
+        if np_ != base_np and any(f.kind == "analysis_timeout"
+                                  for f in report.findings):
+            continue
+        tried += 1
+        sch, comms = report.events, report.comms
+        conc = analysis._dedupe(_match.match_schedules(sch, comms))
+        try:
+            part = _symbolic.partition_schedules(sch, comms)
+        except _symbolic.Uncanonicalizable:
+            # sound fallback: the dispatcher must agree it is concrete
+            stats = {}
+            findings, part = _symbolic.verify_schedules(sch, comms,
+                                                        stats=stats)
+            assert part is None or stats["mode"] == "concrete"
+            assert ([f.to_json() for f in analysis._dedupe(findings)]
+                    == [f.to_json() for f in conc])
+            continue
+        try:
+            sym = analysis._dedupe(_symbolic.match_schedules_symbolic(
+                sch, comms, part))
+        except _symbolic.FallbackNeeded:
+            continue  # honest fallback; concrete path owns the verdict
+        assert ([f.to_json() for f in sym]
+                == [f.to_json() for f in conc]), \
+            f"symbolic/concrete drift at np={np_}"
+        ws = len(sch)
+        pc = _plan.compile_schedules(sch, comms, world_size=ws,
+                                     findings=conc)
+        ps = _plan.compile_schedules(sch, comms, world_size=ws,
+                                     findings=conc, symmetry=part)
+        assert ps.proved == pc.proved, f"proved drift at np={np_}"
+        assert ps.reasons == pc.reasons
+        assert ps.cache_key == pc.cache_key
+        assert not _plan.diff_plans(pc, ps), f"plan drift at np={np_}"
+    assert tried >= 1, "program never ran — gate lost its teeth"
+
+
+def test_corpus_symbolic_off_is_bitforbit(monkeypatch):
+    """MPI4JAX_TPU_ANALYZE_SYMBOLIC=off pins the concrete report JSON
+    bit-for-bit on a representative golden program."""
+    _normalize_env(monkeypatch)
+    entry = next(e for e in _MANIFEST["programs"]
+                 if e.get("golden"))
+    path = os.path.join(PROGS, entry["program"])
+    ref = analysis.check_program(path, int(entry["np"])).to_json()
+    monkeypatch.setenv("MPI4JAX_TPU_ANALYZE_SYMBOLIC", "off")
+    off = analysis.check_program(path, int(entry["np"])).to_json()
+    assert json.dumps(off, sort_keys=True) \
+        == json.dumps(ref, sort_keys=True)

@@ -1,0 +1,155 @@
+"""Tier-1 wiring of the verification scale gate (make verify-scale).
+
+Two halves:
+
+- a live ``--quick`` harness run (np ladder to 64, concrete
+  differential to 32) under a wall-clock budget — catches symbolic /
+  concrete drift, calibration drift against the committed goldens,
+  and prover regressions on every CI run, jax or no jax;
+- schema + structural checks on the committed
+  ``BENCH_verifier_scale.json``: the full 8→512 ladder must show the
+  sub-quadratic story (symbolic match steps bounded by classes, not
+  np; every plan proved at 512 where the concrete prover's
+  interleaving budget cannot reach) and a clean failure list.
+"""
+
+import json
+import os
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "BENCH_verifier_scale.json")
+
+# the quick ladder does ~100x less matching work than the committed
+# run's 60s budget covers; 120s keeps slow CI hosts honest without
+# flaking
+QUICK_BUDGET_S = 120.0
+
+
+def _harness():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import scale_harness
+
+    return scale_harness
+
+
+def test_quick_harness_green_under_budget(capsys):
+    sh = _harness()
+    t0 = time.perf_counter()
+    rc = sh.main(["--quick", "--out", "-",
+                  "--budget-s", str(QUICK_BUDGET_S)])
+    wall = time.perf_counter() - t0
+    out = capsys.readouterr().out
+    assert rc == 0, f"scale harness failures:\n{out}"
+    assert wall < QUICK_BUDGET_S, f"quick ladder took {wall:.1f}s"
+    # the gate has teeth: all six corpus families calibrated and ran
+    assert out.count("proved=True") == len(sh.FAMILIES)
+
+
+def test_bench_file_committed_and_well_formed():
+    assert os.path.exists(BENCH), \
+        "BENCH_verifier_scale.json missing: run make verify-scale " \
+        "and commit the result"
+    with open(BENCH) as fh:
+        bench = json.load(fh)
+    assert bench["schema"] == "verifier-scale/1"
+    assert bench["failures"] == []
+    assert not bench["quick"], "committed bench must be the full ladder"
+    assert bench["np_ladder"][-1] == 512
+    assert bench["wall_s"] < bench["budget_s"] == 60.0
+    assert bench["peak_rss_kb"] > 0
+
+    sh = _harness()
+    families = bench["families"]
+    assert set(families) == set(sh.FAMILIES)
+    for name, cal in families.items():
+        assert cal["events_match_golden"], name
+        assert cal["cache_key_match"], name
+        assert cal["peer_forms_rescale"], name
+
+    rows = bench["rows"]
+    assert {r["family"] for r in rows} == set(sh.FAMILIES)
+    by_fam = {}
+    for r in rows:
+        by_fam.setdefault(r["family"], {})[r["np"]] = r
+    for name, by_np in by_fam.items():
+        assert set(by_np) == set(bench["np_ladder"]), name
+        for n, row in by_np.items():
+            assert row["findings"] == 0, (name, n)
+            assert row["plan"]["proved"], (name, n)
+            if n <= bench["concrete_cap"]:
+                assert row["concrete"] is not None
+                assert row["findings_equal"], (name, n)
+            else:
+                assert row["concrete"] is None
+        # the sub-quadratic claim, structurally: the quotient's match
+        # work is bounded by the class count, not the world size —
+        # with a constant class count the step count must not grow
+        # with np at all, while the concrete matcher's grows at least
+        # linearly for p2p families
+        first, last = min(by_np), max(by_np)
+        if by_np[first]["symbolic"]["classes"] \
+                == by_np[last]["symbolic"]["classes"]:
+            assert by_np[first]["symbolic"]["steps"] \
+                == by_np[last]["symbolic"]["steps"], name
+        # prover budget independence: at np=512 the concrete prover
+        # cannot prove (512 service rotations > its 256-interleaving
+        # budget); the recorded proof must be the quotient's
+        top = by_np[max(by_np)]
+        assert top["plan"]["symmetry_classes"] is not None
+        assert top["plan"]["interleavings"] \
+            <= top["symbolic"]["classes"] + 1
+
+    # oracle + tuner sections ran at the top rung
+    assert bench["oracles"]["np"] == 512
+    assert bench["oracles"]["simulate_halltoall_exact"] is True
+    assert bench["tuner"]["ranks"] == 512
+    assert bench["tuner"]["winners"]
+
+
+def test_synthetic_islands_and_measure_helpers():
+    """The harness's topo/tune inputs are real package API: the island
+    map round-trips the FAKE_HOSTS parser and the synthetic cost
+    table is deterministic with the documented shape."""
+    sh = _harness()
+    topo = sh._load_file("t_scale_topo", "mpi4jax_tpu", "topo",
+                         "__init__.py")
+    islands, spec = topo.synthetic_islands(512, 8)
+    assert len(islands) == 8
+    assert all(len(m) == 64 for m in islands)
+    labels = topo.parse_fake_hosts(spec, 512)
+    assert labels is not None and None not in labels
+    with pytest.raises(ValueError):
+        topo.synthetic_islands(10, 3)
+    jt = sh._load_file("t_scale_jt", "mpi4jax_tpu", "tune",
+                       "_joint.py")
+    m = jt.synthetic_measure(512)
+    big = 1 << 20
+    assert m("allreduce", big, "hring+q") < m("allreduce", big, "ring")
+    assert m("allreduce", big, "hring") == m("allreduce", big, "hring")
+    assert m("alltoall", big, "hqalltoall") \
+        < m("alltoall", big, "ring")
+
+
+def test_concrete_steps_grow_with_np_symbolic_do_not():
+    """The scaling evidence in the committed bench, cross-family: for
+    every p2p family the concrete matcher's steps grow ~linearly on
+    the measured range while the symbolic steps stay flat."""
+    if not os.path.exists(BENCH):
+        pytest.skip("bench not committed yet")
+    with open(BENCH) as fh:
+        bench = json.load(fh)
+    p2p = ("halo_exchange", "false_serialization", "independent_pair")
+    for name in p2p:
+        rows = sorted((r for r in bench["rows"]
+                       if r["family"] == name and r["concrete"]),
+                      key=lambda r: r["np"])
+        assert len(rows) >= 2
+        lo, hi = rows[0], rows[-1]
+        ratio_np = hi["np"] / lo["np"]
+        ratio_conc = hi["concrete"]["steps"] / lo["concrete"]["steps"]
+        assert ratio_conc >= ratio_np * 0.9, name
+        assert hi["symbolic"]["steps"] == lo["symbolic"]["steps"], name

@@ -32,7 +32,13 @@ REPO = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 PROGRAMS = os.path.join(REPO, "tests", "world_programs")
 
-_port = [46600]
+# pid-mixed base (the test_sanitizers.py idiom): concurrent pytest
+# processes land in disjoint port windows instead of all racing for
+# one fixed base — the forced-ICI-leg launch was flaking on exactly
+# that collision.  Per-launch strides below keep launches within one
+# process apart; the 43200–44000 window is unused by the other world
+# suites.
+_port = [43200 + (os.getpid() * 41) % 600]
 
 
 def _launch(program, np_, fake_hosts, expect_islands, *, timeout=300,
