@@ -1,0 +1,358 @@
+"""Acceptance evidence for live re-tuning (``BENCH_live_retune.json``)::
+
+    python benchmarks/live_retune_bench.py --write
+
+A bandwidth-burning sidecar fleet (memory-copy loops — on loopback TCP
+the "wire" IS memory bandwidth) genuinely flips the 16 MiB allreduce
+winner on this host: quiescent, the quantized wire (``qrd``, 4x fewer
+bytes) beats full-precision ``ring``; contended, the codec's own
+memory passes become the bottleneck and ``ring`` wins.  Four gates,
+all asserted in-driver before the artifact is written:
+
+1. **The flip is real** — a pinned-algorithm ladder measures
+   ``qrd`` < ``ring`` at 16 MiB quiescent AND ``ring`` < ``qrd`` under
+   the sidecar fleet (no synthetic forcing: the cost model fed to the
+   live controller is built from THIS phase's measured medians).
+2. **Re-pick within the cooldown** — with the static table pinned to
+   the quiescent winner (``qrd``) and the sidecars injected mid-run,
+   the armed controller detects the drift and the epoch rendezvous
+   installs the new table within ``MPI4JAX_TPU_LIVE_COOLDOWN_OPS``
+   operations of the contention onset, with the swap report naming
+   ``qrd -> ring``.
+3. **Throughput recovers** — post-swap per-op medians beat the
+   live-off run (same pinned table, same sidecar schedule) over the
+   same op range by >= 5%: the static cache stays wrong, the live
+   plane does not.
+4. **Quiescent = zero swaps** — the armed controller over the same
+   model with no sidecars records zero table swaps (no epoch ever
+   advances): the brain does nothing when nothing drifts.
+"""
+
+import argparse
+import json
+import os
+import re
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LAUNCHER = os.path.join(REPO, "mpi4jax_tpu", "runtime", "launch.py")
+ARTIFACT = os.path.join(REPO, "BENCH_live_retune.json")
+
+NBYTES = 16 * 1024 * 1024          # the contested band
+N_SIDECARS = 6
+# cooldown budgets the TWO-PHASE detection latency: ~per_key ops to the
+# first (mixed-regime) crossing that arms suspicion, a fresh per_key
+# window to confirm, then the rendezvous period (cooldown // 4)
+WINDOW, DRIFT_PCT, COOLDOWN = 32, 50, 24
+OPS, SIDECAR_AT = 70, 20
+
+_port = [48700 + (os.getpid() * 13) % 300]
+
+#: each sidecar ping-pongs two 64 MiB buffers through the memory bus —
+#: the same resource loopback TCP and the quantize/dequantize passes
+#: contend for
+SIDECAR_SRC = (
+    "import numpy as np\n"
+    "a = np.ones(1 << 26, dtype=np.uint8)\n"
+    "b = np.empty_like(a)\n"
+    "while True:\n"
+    "    np.copyto(b, a)\n"
+    "    np.copyto(a, b)\n"
+)
+
+_PROBE_SRC = r"""
+import os, statistics, sys, time, types
+REPO = os.environ["LIVE_BENCH_REPO"]
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+import numpy as np
+from mpi4jax_tpu.runtime import bridge, transport
+c = transport.get_world_comm()
+h = c.handle
+x = np.ones(int(os.environ["LIVE_BENCH_NBYTES"]) // 4, dtype=np.float32)
+for _ in range(3):
+    bridge.allreduce(h, x, 0)
+ts = []
+for _ in range(10):
+    t0 = time.perf_counter()
+    bridge.allreduce(h, x, 0)
+    ts.append(time.perf_counter() - t0)
+if c.rank() == 0:
+    print("probe_med_ms %.3f" % (statistics.median(ts) * 1e3), flush=True)
+"""
+
+_LIVE_SRC = r"""
+import json, os, subprocess, sys, time, types
+REPO = os.environ["LIVE_BENCH_REPO"]
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+import numpy as np
+from mpi4jax_tpu import live
+from mpi4jax_tpu.runtime import bridge, transport
+
+c = transport.get_world_comm()
+h = c.handle
+rank = c.rank()
+ops = int(os.environ["LIVE_BENCH_OPS"])
+at = int(os.environ["LIVE_BENCH_SIDECAR_AT"])     # -1 = never
+nside = int(os.environ["LIVE_BENCH_SIDECARS"])
+side_src = os.environ["LIVE_BENCH_SIDECAR_SRC"]
+x = np.ones(int(os.environ["LIVE_BENCH_NBYTES"]) // 4, dtype=np.float32)
+side, times, epochs = [], [], []
+try:
+    for it in range(ops):
+        if it == at and rank == 0:
+            side = [subprocess.Popen([sys.executable, "-c", side_src])
+                    for _ in range(nside)]
+            time.sleep(0.3)   # let the fleet saturate before timing
+        t0 = time.perf_counter()
+        bridge.allreduce(h, x, 0)
+        times.append((time.perf_counter() - t0) * 1e3)
+        epochs.append(int(live.status().get("epoch", 0)))
+finally:
+    for p in side:
+        p.kill()
+st = live.status()
+if rank == 0:
+    out = {
+        "times_ms": [round(t, 3) for t in times],
+        "epochs": epochs,
+        "errors": int(st.get("errors", 0)),
+        "swaps": [{"epoch": s["epoch"], "boundary": s["boundary"],
+                   "changes": (s.get("report") or {}).get("changes", [])}
+                  for s in st.get("swaps", [])],
+    }
+    sys.stdout.write("live_bench_json " + json.dumps(out) + "\n")
+    sys.stdout.flush()
+"""
+
+
+def _launch(src, env_extra, sidecars_for_whole_run=0, timeout=240):
+    _port[0] += 11
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        # TCP path: the same-host shm arena would shadow the table
+        "MPI4JAX_TPU_DISABLE_SHM": "1",
+        "MPI4JAX_TPU_TIMEOUT_S": "120",
+        "LIVE_BENCH_REPO": REPO,
+        "LIVE_BENCH_NBYTES": str(NBYTES),
+    })
+    env.update(env_extra)
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_m4j_live_bench.py", delete=False
+    ) as f:
+        f.write(src)
+        prog = f.name
+    side = [subprocess.Popen([sys.executable, "-c", SIDECAR_SRC])
+            for _ in range(sidecars_for_whole_run)]
+    try:
+        if side:
+            time.sleep(0.5)
+        res = subprocess.run(
+            [sys.executable, LAUNCHER, "-n", "2",
+             "--port", str(_port[0]), prog],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=REPO)
+    finally:
+        for p in side:
+            p.kill()
+        os.unlink(prog)
+    return res
+
+
+def probe(algo, sidecars):
+    res = _launch(_PROBE_SRC,
+                  {"MPI4JAX_TPU_COLL_ALGO": f"allreduce={algo}"},
+                  sidecars_for_whole_run=sidecars)
+    m = re.search(r"probe_med_ms ([\d.]+)", res.stdout)
+    assert res.returncode == 0 and m, (
+        f"probe {algo}/side={sidecars} failed:\n"
+        + (res.stderr or res.stdout)[-1500:])
+    return float(m.group(1))
+
+
+def live_run(mode, model_path):
+    """mode: 'armed' (sidecar mid-run), 'static' (live off, same
+    sidecar), 'quiescent' (armed, no sidecar)."""
+    env = {
+        "MPI4JAX_TPU_COLL_ALGO": "allreduce=qrd",   # the static pick
+        "MPI4JAX_TPU_TUNE_MODEL": model_path,
+        "MPI4JAX_TPU_LIVE": "off" if mode == "static" else "auto",
+        "MPI4JAX_TPU_LIVE_WINDOW": str(WINDOW),
+        "MPI4JAX_TPU_LIVE_DRIFT_PCT": str(DRIFT_PCT),
+        "MPI4JAX_TPU_LIVE_COOLDOWN_OPS": str(COOLDOWN),
+        "LIVE_BENCH_OPS": str(OPS),
+        "LIVE_BENCH_SIDECAR_AT":
+            "-1" if mode == "quiescent" else str(SIDECAR_AT),
+        "LIVE_BENCH_SIDECARS": str(N_SIDECARS),
+        "LIVE_BENCH_SIDECAR_SRC": SIDECAR_SRC,
+    }
+    res = _launch(_LIVE_SRC, env)
+    m = re.search(r"live_bench_json (\{.*\})", res.stdout)
+    assert res.returncode == 0 and m, (
+        f"live run {mode} failed:\n" + (res.stderr or res.stdout)[-1500:])
+    return json.loads(m.group(1)), res.stderr
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="benchmarks/live_retune_bench.py")
+    ap.add_argument("--write", action="store_true",
+                    help=f"write {os.path.basename(ARTIFACT)}")
+    args = ap.parse_args(argv)
+
+    # ---- phase A: the flip, measured with pinned algorithms ----------
+    ladder = {}
+    for side in (0, N_SIDECARS):
+        for algo in ("ring", "qrd"):
+            ladder[(algo, side)] = probe(algo, side)
+            print(f"probe: algo={algo:<5} sidecars={side} "
+                  f"med={ladder[(algo, side)]:.2f} ms", flush=True)
+    q_ring, q_qrd = ladder[("ring", 0)], ladder[("qrd", 0)]
+    c_ring, c_qrd = ladder[("ring", N_SIDECARS)], ladder[("qrd", N_SIDECARS)]
+    assert q_qrd < q_ring, (
+        f"gate 1a: quiescent winner at 16 MiB is not qrd "
+        f"(qrd={q_qrd} ring={q_ring} ms) — no crossover on this host")
+    assert c_ring < c_qrd, (
+        f"gate 1b: sidecar fleet did not flip the 16 MiB winner to ring "
+        f"(ring={c_ring} qrd={c_qrd} ms)")
+    print(f"gate 1 OK: sidecars flip the 16 MiB winner "
+          f"(quiescent qrd {q_qrd:.1f} < ring {q_ring:.1f} ms; "
+          f"contended ring {c_ring:.1f} < qrd {c_qrd:.1f} ms)", flush=True)
+
+    # ---- the cost model the controller trusts = phase A's medians ----
+    model = {
+        "version": 1, "world_size": 2, "topology": None,
+        "dtype": "float32", "knobs": {},
+        "source": "live_retune_bench quiescent ladder",
+        "samples": {
+            # small-size anchors keep the interpolation sane; the 16 MiB
+            # band carries this host's measured quiescent medians
+            "allreduce/ring": {"1024": 30e-6, str(NBYTES): q_ring / 1e3},
+            "allreduce/qrd": {"1024": 60e-6, str(NBYTES): q_qrd / 1e3},
+        },
+        "wire_frac": {}, "dispatch_frac": {},
+    }
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_m4j_live_bench_model.json", delete=False
+    ) as f:
+        json.dump(model, f)
+        model_path = f.name
+
+    try:
+        armed, armed_err = live_run("armed", model_path)
+        static, _ = live_run("static", model_path)
+        quiet, _ = live_run("quiescent", model_path)
+    finally:
+        os.unlink(model_path)
+
+    # ---- gate 2: re-pick within the cooldown -------------------------
+    swap_ops = [i for i, e in enumerate(armed["epochs"]) if e > 0]
+    assert swap_ops, f"armed run never swapped: {armed['swaps']}"
+    ops_to_swap = swap_ops[0] - SIDECAR_AT
+    changes = ";".join(c for s in armed["swaps"] for c in s["changes"])
+    assert armed["errors"] == 0, f"controller errors: {armed['errors']}"
+    assert "qrd -> ring" in changes, (
+        f"swap report does not name the re-pick: {armed['swaps']}")
+    # exactly ONE swap: candidate adoption must stop the controller from
+    # ping-ponging back once the new pick also runs slower contended
+    assert len(armed["swaps"]) == 1, (
+        f"controller thrashed ({len(armed['swaps'])} swaps): "
+        f"{armed['swaps']}")
+    assert 0 < ops_to_swap <= COOLDOWN, (
+        f"gate 2: swap landed {ops_to_swap} ops after contention onset "
+        f"(cooldown budget {COOLDOWN})")
+    assert "[live] epoch 1 committed" in armed_err, armed_err[-800:]
+    print(f"gate 2 OK: drift -> rendezvous -> '{changes}' "
+          f"{ops_to_swap} ops after onset (budget {COOLDOWN})", flush=True)
+
+    # ---- gate 3: throughput recovers vs the static cache -------------
+    post = slice(swap_ops[0] + 2, OPS)
+    armed_post = statistics.median(armed["times_ms"][post])
+    static_post = statistics.median(static["times_ms"][post])
+    recovery = static_post / armed_post
+    assert not any(e > 0 for e in static["epochs"]), static["swaps"]
+    assert recovery >= 1.05, (
+        f"gate 3: post-swap armed {armed_post:.1f} ms vs static "
+        f"{static_post:.1f} ms — recovery {recovery:.2f}x < 1.05x")
+    print(f"gate 3 OK: post-swap {armed_post:.1f} ms vs static "
+          f"{static_post:.1f} ms ({recovery:.2f}x)", flush=True)
+
+    # ---- gate 4: quiescent armed run swaps nothing -------------------
+    assert not quiet["swaps"] and not any(e > 0 for e in quiet["epochs"]), (
+        f"gate 4: quiescent run swapped: {quiet['swaps']}")
+    assert quiet["errors"] == 0, quiet["errors"]
+    print("gate 4 OK: quiescent armed run recorded zero swaps", flush=True)
+
+    artifact = {
+        "note": (
+            "Live re-tuning acceptance (benchmarks/live_retune_bench.py). "
+            "flip_ladder: 2-rank loopback TCP 16 MiB allreduce, pinned "
+            "algorithm, median of 10 after 3 warmup, quiescent vs a "
+            f"{N_SIDECARS}-process memory-copy sidecar fleet — the fleet "
+            "flips the winner (quiescent: qrd's 4x-smaller wire wins; "
+            "contended: the codec's own memory passes lose to ring). "
+            "armed_run: static table pinned to the quiescent winner "
+            "(qrd), cost model = the quiescent ladder's own medians, "
+            f"sidecars injected at op {SIDECAR_AT} of {OPS}; the armed "
+            "controller detects the drift and the epoch rendezvous "
+            "installs ring within the cooldown budget, after which "
+            "per-op medians beat the live-off run (same table, same "
+            "sidecar schedule) over the same op range.  quiescent_run: "
+            "the armed controller over the same model with no sidecars "
+            "records ZERO swaps.  All four gates are asserted in-driver "
+            "before this file is written."
+        ),
+        "config": {
+            "nbytes": NBYTES, "np": 2, "sidecars": N_SIDECARS,
+            "ops": OPS, "sidecar_at": SIDECAR_AT,
+            "live_window": WINDOW, "live_drift_pct": DRIFT_PCT,
+            "live_cooldown_ops": COOLDOWN,
+            "static_pick": "qrd",
+            "env": {"JAX_PLATFORMS": "cpu",
+                    "MPI4JAX_TPU_DISABLE_SHM": "1"},
+        },
+        "flip_ladder": {
+            "quiescent": {"ring_ms": q_ring, "qrd_ms": q_qrd},
+            "contended": {"ring_ms": c_ring, "qrd_ms": c_qrd},
+        },
+        "armed_run": {
+            "swap_op": swap_ops[0],
+            "ops_after_onset": ops_to_swap,
+            "cooldown_budget": COOLDOWN,
+            "swaps": armed["swaps"],
+            "post_swap_med_ms": armed_post,
+            "times_ms": armed["times_ms"],
+            "epochs": armed["epochs"],
+        },
+        "static_run": {
+            "post_swap_range_med_ms": static_post,
+            "times_ms": static["times_ms"],
+        },
+        "quiescent_run": {
+            "swaps": quiet["swaps"],
+            "med_ms": statistics.median(quiet["times_ms"]),
+        },
+        "recovery_vs_static": round(recovery, 3),
+    }
+    if args.write:
+        with open(ARTIFACT, "w") as f:
+            json.dump(artifact, f, indent=1)
+            f.write("\n")
+        print(f"wrote {ARTIFACT}")
+    else:
+        print("all gates green (use --write to commit the artifact)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

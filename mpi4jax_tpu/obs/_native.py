@@ -24,7 +24,8 @@ OBS_OP_NAMES = (
 
 #: TpuCollAlgo codes -> names (keep in sync with mpi4jax_tpu/tune)
 ALGO_NAMES = {0: "auto", 1: "ring", 2: "rd", 3: "tree", 4: "shm",
-              5: "qring", 6: "qrd", 7: "hring", 8: "htree"}
+              5: "qring", 6: "qrd", 7: "hring", 8: "htree",
+              9: "qalltoall", 10: "halltoall", 11: "hqalltoall"}
 
 #: TpuObsTier codes -> names (0 = flat / whole-op, omitted from the
 #: canonical events; hierarchical per-leg events carry intra/inter)
@@ -134,22 +135,8 @@ def clock(lib) -> float:
     return float(fn())
 
 
-def drain(lib, max_events: int = 1 << 20):
-    """Pull and clear the held events, oldest first, as raw dicts with
-    the native clock's timestamps (seconds): op/peer/tag/bytes/algo/
-    t/dur_s/wait_s/queue_s (the dispatch phase: post -> native start,
-    0 for inline execution).  Events the buffer cannot take (appended
-    between the count probe and the drain, or beyond ``max_events``)
-    are counted as dropped by the native side, never silently lost."""
-    held, _ = counts(lib)
-    # headroom for events appended after the count probe (the native
-    # drain clamps to what is actually held)
-    n = min(held + 64, max_events)
-    if n <= 0 or held <= 0:
-        return []
-    buf = (TpuObsEvent * n)()
-    got = lib.tpucomm_obs_drain(buf, ctypes.c_int64(n))
-    syscalls_ok = syscalls_available(lib)
+def _decode(buf, got, syscalls_ok):
+    """Struct slots -> raw event dicts (shared by drain and peek)."""
     out = []
     for i in range(got):
         e = buf[i]
@@ -178,6 +165,52 @@ def drain(lib, max_events: int = 1 << 20):
             ev["retries"] = e.retries
         out.append(ev)
     return out
+
+
+def drain(lib, max_events: int = 1 << 20):
+    """Pull and clear the held events, oldest first, as raw dicts with
+    the native clock's timestamps (seconds): op/peer/tag/bytes/algo/
+    t/dur_s/wait_s/queue_s (the dispatch phase: post -> native start,
+    0 for inline execution).  Events the buffer cannot take (appended
+    between the count probe and the drain, or beyond ``max_events``)
+    are counted as dropped by the native side, never silently lost."""
+    held, _ = counts(lib)
+    # headroom for events appended after the count probe (the native
+    # drain clamps to what is actually held)
+    n = min(held + 64, max_events)
+    if n <= 0 or held <= 0:
+        return []
+    buf = (TpuObsEvent * n)()
+    got = lib.tpucomm_obs_drain(buf, ctypes.c_int64(n))
+    return _decode(buf, got, syscalls_available(lib))
+
+
+def peek_available(lib) -> bool:
+    """True when the loaded .so carries the non-destructive cursor read
+    (``tpucomm_obs_peek``) — the live controller's follow path.  A
+    library predating it still records and drains; only the second
+    consumer is unavailable."""
+    return available(lib) and hasattr(lib, "tpucomm_obs_peek")
+
+
+def peek(lib, cursor: int, max_events: int = 4096):
+    """Non-destructive follow of the native ring from an absolute
+    per-enable sequence ``cursor`` (0 = the oldest held event).
+    Returns ``(events, next_cursor, skipped)`` — the same raw dicts as
+    :func:`drain`, the cursor to resume from, and how many events
+    between ``cursor`` and the oldest still readable were lost to ring
+    overflow or a destructive drain.  Never touches the held/dropped
+    counts, so the end-of-run :func:`drain` still sees every held
+    event (the two-consumer contract the live controller relies on)."""
+    n = max(int(max_events), 1)
+    buf = (TpuObsEvent * n)()
+    cur = ctypes.c_int64(int(cursor))
+    skipped = ctypes.c_int64(0)
+    lib.tpucomm_obs_peek.restype = ctypes.c_int64
+    got = lib.tpucomm_obs_peek(buf, ctypes.c_int64(n), ctypes.byref(cur),
+                               ctypes.byref(skipped))
+    return (_decode(buf, got, syscalls_available(lib)), cur.value,
+            skipped.value)
 
 
 def link_counters(lib):

@@ -204,20 +204,21 @@ def record_span(name: str, t_unix: float, dur_s: float, *, peer=-1,
     _state.spans.append(ev)
 
 
-def _pull_native() -> None:
-    """Drain the native ring into the canonical accumulator."""
-    if _state.lib is None or _state.native_acc is None:
-        return
-    _, dropped = _native.counts(_state.lib)
-    raw = _native.drain(_state.lib)
-    _state.native_dropped = dropped
-    to_unix = _state.unix0 - _state.steady0
+def canonicalize_native(raw, to_unix: float = 0.0,
+                        clock_offset_us: float = 0.0):
+    """Raw native drain/peek dicts -> canonical events (the dump/stats
+    schema).  Shared by the recorder's destructive drain path and the
+    live controller's cursor follow, so both consumers speak the one
+    schema ``tune.measurements_from_events`` understands.  ``to_unix``
+    maps the native monotonic clock onto the unix epoch (0 leaves
+    timestamps on the native clock — fine for consumers that only read
+    durations)."""
     canon = []
     for e in raw:
         ev = {
             "name": e["name"],
             "src": "native",
-            "ts_us": (e["t"] + to_unix) * 1e6 + _state.clock_offset_us,
+            "ts_us": (e["t"] + to_unix) * 1e6 + clock_offset_us,
             "dur_us": e["dur_s"] * 1e6,
             "wait_us": e["wait_s"] * 1e6,
             "dispatch_us": e.get("queue_s", 0.0) * 1e6,
@@ -250,7 +251,18 @@ def _pull_native() -> None:
         if e.get("retries"):
             ev["retries"] = e["retries"]
         canon.append(ev)
-    _state.native_acc.extend(canon)
+    return canon
+
+
+def _pull_native() -> None:
+    """Drain the native ring into the canonical accumulator."""
+    if _state.lib is None or _state.native_acc is None:
+        return
+    _, dropped = _native.counts(_state.lib)
+    raw = _native.drain(_state.lib)
+    _state.native_dropped = dropped
+    _state.native_acc.extend(canonicalize_native(
+        raw, _state.unix0 - _state.steady0, _state.clock_offset_us))
 
 
 def events():

@@ -305,6 +305,47 @@ def set_coll_table(coded_table) -> bool:
     return True
 
 
+def stage_coll_table(coded_table) -> bool:
+    """Park candidate decision tables in the native staging slots
+    WITHOUT touching dispatch (same coding as :func:`set_coll_table`);
+    :func:`commit_coll_tables` promotes them atomically.  False when
+    the loaded .so predates the live re-tuning entry points."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_stage_coll_table"):
+        return False
+    for op_kind, entries in coded_table.items():
+        n = len(entries)
+        mins = (ctypes.c_int64 * n)(*[int(e[0]) for e in entries])
+        algos = (ctypes.c_int32 * n)(*[int(e[1]) for e in entries])
+        lib.tpucomm_stage_coll_table(int(op_kind), mins, algos, n)
+    return True
+
+
+def commit_coll_tables(handle, epoch: int) -> bool:
+    """Promote every staged table to live under the comm lock with the
+    progress engine quiesced, stamping ``epoch`` — the swap half of the
+    live re-tuning protocol (all ranks call this at the same collective
+    boundary).  False when the .so predates it."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_commit_coll_tables"):
+        return False
+    rc = lib.tpucomm_commit_coll_tables(_i64(handle), _i64(epoch))
+    if rc != 0:
+        raise ValueError(f"bad comm handle {handle}")
+    return True
+
+
+def coll_epoch():
+    """The live decision-table epoch (0 = the offline-installed table),
+    or None when the loaded .so predates live re-tuning."""
+    lib = get_lib()
+    if not hasattr(lib, "tpucomm_coll_epoch"):
+        return None
+    fn = lib.tpucomm_coll_epoch
+    fn.restype = ctypes.c_int64
+    return int(fn())
+
+
 def coll_algo_for(handle, op_kind: int, nbytes: int):
     """The TpuCollAlgo code that would serve (comm, op kind, payload) —
     including the shm code when the arena path wins.  None when the
@@ -520,6 +561,25 @@ def _post_init_setup(lib, handle, rank: int, size: int, *,
 
     if config.trace_path() is not None or obs.enabled():
         _install_obs(lib, handle, rank, size)
+    # live re-tuning: arm the drift controller + boundary hook when
+    # MPI4JAX_TPU_LIVE=auto.  Arming MUST AGREE ACROSS RANKS (the epoch
+    # rendezvous bcasts at agreed boundaries — a rank without the hook
+    # would pair another rank's rendezvous against its next user op);
+    # the launcher exports the knob uniformly.  Knob parse errors stay
+    # fail-fast; infrastructure problems degrade soft like the tune
+    # install above — a live-plane hiccup must never take down a
+    # healthy transport.
+    if config.live_mode() == "auto":
+        try:
+            from .. import live
+
+            live.arm(lib, handle, rank, size)
+        except ValueError:
+            raise
+        except Exception as e:  # pragma: no cover - defensive
+            import warnings
+
+            warnings.warn(f"live re-tuning arm failed: {e}")
     # schedule-plan execution: when MPI4JAX_TPU_PLAN names a verified
     # plan file (launch --plan), attach this rank's schedule to the
     # world comm.  Soft like the tune install above: a bad plan file
@@ -548,6 +608,21 @@ _topo_handles: dict = {}
 _topo_subcomms: dict = {}
 
 _ici_leg_mod = None
+
+#: live re-tuning collective-boundary hook (``mpi4jax_tpu.live`` sets it
+#: while armed, None otherwise): called with the comm handle at the TOP
+#: of every collective wrapper, before dispatch — the point where all
+#: ranks of an SPMD program are at the same per-comm collective index,
+#: so an epoch rendezvous injected here lands at the same boundary
+#: everywhere.  The None default keeps MPI4JAX_TPU_LIVE=off at one
+#: module-global load per collective — pre-live behavior bit-for-bit.
+_live_boundary = None
+
+
+def set_live_boundary(fn) -> None:
+    """Install (or clear, ``None``) the live boundary hook."""
+    global _live_boundary
+    _live_boundary = fn
 
 
 def _ici_leg_hook(handle, buf, out, dtype_code, op_code, algo) -> bool:
@@ -708,6 +783,7 @@ def rebuild(old_handle, new_rank: int, new_size: int, base_port: int,
     # island cleanly re-derives the (possibly now flat) map
     if old_handle:
         _teardown_topology(old_handle)
+        _live_disarm()
     handle = lib.tpucomm_shrink(
         _i64(old_handle or 0), int(new_rank), int(new_size),
         int(base_port), (hosts or "").encode())
@@ -731,9 +807,25 @@ def rebuild(old_handle, new_rank: int, new_size: int, base_port: int,
     return handle
 
 
+def _live_disarm(handle=None) -> None:
+    """Stop the live controller + clear the boundary hook, if armed
+    (a dying world's hook must not rendezvous on a dead handle).
+    ``handle`` restricts the disarm to that comm's controller — closing
+    an unrelated sub-comm leaves the world's controller running."""
+    if _live_boundary is None:
+        return
+    try:
+        from .. import live
+
+        live.disarm(handle=handle)
+    except Exception:  # pragma: no cover - defensive teardown
+        set_live_boundary(None)
+
+
 def comm_finalize(handle) -> None:
     """Close one native communicator (drains its engine first; cached
     topology sub-comms go first — they borrow its sockets)."""
+    _live_disarm(handle)
     _teardown_topology(handle)
     get_lib().tpucomm_finalize(_i64(handle))
 
@@ -1165,6 +1257,8 @@ def shift2(handle, buf, lo: int, hi: int, tag: int) -> np.ndarray:
 
 
 def barrier(handle):
+    if _live_boundary is not None:
+        _live_boundary(handle)
     if _exec_fn is not None:
         hc, _, ref = _exec_desc(handle, _K_BARRIER)
         _check("Barrier", _exec_fn(hc, ref))
@@ -1173,6 +1267,8 @@ def barrier(handle):
 
 
 def bcast(handle, buf, root) -> np.ndarray:
+    if _live_boundary is not None:
+        _live_boundary(handle)
     out = _contig(buf).copy()
     if _exec_fn is not None:
         hc, d, ref = _exec_desc(handle, _K_BCAST, ("peer", root))
@@ -1198,6 +1294,8 @@ def allreduce_raw(handle, buf: np.ndarray, out: np.ndarray, dtype_code: int,
     comm runs its intra-island phase over the Pallas ring instead of
     the native shm/TCP legs (quiet fallthrough otherwise — the knob
     parser is the loud guard)."""
+    if _live_boundary is not None:
+        _live_boundary(handle)
     if _ici_leg_hook(handle, buf, out, dtype_code, op_code, algo):
         return
     if _exec_fn is not None:
@@ -1259,6 +1357,10 @@ def allreduce(handle, buf, op_code: int, out: Optional[np.ndarray] = None,
             cache[key] = ent
         hc, ref, res = ent[0], ent[1], ent[2]
         if res is not buf:
+            # the fused path returns before allreduce_raw, so it pays
+            # the boundary hook itself (exactly once per collective)
+            if _live_boundary is not None:
+                _live_boundary(handle)
             ent[3].sbuf = _data_ptr(buf)
             _check("Allreduce", _exec_fn(hc, ref))
             return res
@@ -1276,6 +1378,8 @@ def allreduce(handle, buf, op_code: int, out: Optional[np.ndarray] = None,
 
 def reduce(handle, buf, op_code: int, root: int,
            reuse: bool = False) -> np.ndarray:
+    if _live_boundary is not None:
+        _live_boundary(handle)
     buf = _contig(buf)
     optr = None
     if reuse:
@@ -1302,6 +1406,8 @@ def reduce(handle, buf, op_code: int, root: int,
 
 
 def scan(handle, buf, op_code: int, reuse: bool = False) -> np.ndarray:
+    if _live_boundary is not None:
+        _live_boundary(handle)
     buf = _contig(buf)
     optr = None
     if reuse:
@@ -1331,6 +1437,8 @@ def allgather_raw(handle, buf: np.ndarray, out: np.ndarray,
                   algo: Optional[int] = None):
     """Zero-marshalling allgather (tuner/benchmark inner loop); ``algo``
     as in :func:`allreduce_raw` (raises on a pre-engine .so)."""
+    if _live_boundary is not None:
+        _live_boundary(handle)
     if _exec_fn is not None:
         hc, d, ref = _exec_desc(handle, _K_ALLGATHER,
                                 ("algo", int(algo or 0)))
@@ -1363,6 +1471,9 @@ def allgather(handle, buf, size: int, algo: Optional[int] = None,
         out, optr = _reused_out(handle, _K_ALLGATHER, (size,) + buf.shape,
                                 buf.dtype)
         if _exec_fn is not None:
+            # returns before allgather_raw: pay the boundary hook here
+            if _live_boundary is not None:
+                _live_boundary(handle)
             hc, d, ref = _exec_desc(handle, _K_ALLGATHER,
                                     ("algo", int(algo or 0)))
             d.sbuf = _data_ptr(buf)
@@ -1377,6 +1488,8 @@ def allgather(handle, buf, size: int, algo: Optional[int] = None,
 
 
 def gather(handle, buf, size: int, root: int, rank: int) -> np.ndarray:
+    if _live_boundary is not None:
+        _live_boundary(handle)
     buf = _contig(buf)
     # non-root only sends (the native call ignores recvbuf off-root) and
     # gets its input back — the exact reference contract
@@ -1398,6 +1511,8 @@ def gather(handle, buf, size: int, root: int, rank: int) -> np.ndarray:
 
 
 def scatter(handle, buf, root: int) -> np.ndarray:
+    if _live_boundary is not None:
+        _live_boundary(handle)
     buf = _contig(buf)
     out = np.empty(buf.shape[1:], buf.dtype)
     if _exec_fn is not None:
@@ -1427,6 +1542,8 @@ def alltoall_raw(handle, buf: np.ndarray, out: np.ndarray,
     exchange.  ``dtype_code`` overrides the wire code derived from
     ``buf.dtype`` (bf16 payloads carried as uint16 bit views).
     """
+    if _live_boundary is not None:
+        _live_boundary(handle)
     count = buf.size // buf.shape[0]
     if dtype_code is None:
         dtype_code = _dtypes.wire_code(buf.dtype)

@@ -641,6 +641,135 @@ def check_serving(port):
                   f"over-cap submit shed loudly in {dt:.1f}s")
 
 
+def check_live_retune(port):
+    """The live re-tuning brain end to end on a loopback 2-rank job:
+    drift is forced by pointing ``MPI4JAX_TPU_TUNE_MODEL`` at a synthetic
+    cost model that predicts the pinned ``ring`` algorithm absurdly fast
+    (so real loopback timings drift immediately) while ``rd`` stays
+    modest (so the candidate overlay re-picks it), and the check asserts
+    the armed controller detects the drift, rank 0 proposes, the epoch
+    rendezvous installs the new table on BOTH ranks at the same epoch,
+    and the swap report names the old -> new winner."""
+    import re
+    import tempfile
+
+    from ..utils import config
+
+    window, cooldown = 32, 8
+    knobs = (f"window={window} cooldown={cooldown} "
+             f"drift_pct=50 quant={config.quant_mode()}")
+    model = json.dumps({
+        "version": 1, "world_size": 2, "topology": None,
+        "dtype": "float32", "knobs": {}, "source": "diag-forced",
+        "samples": {
+            # ring predicted ~1us at 256 KiB: any real loopback timing
+            # drifts; rd modest so the overlaid candidate re-picks it
+            "allreduce/ring": {"1024": 1e-7, "262144": 1e-6},
+            "allreduce/rd": {"1024": 2e-6, "262144": 5e-6},
+        },
+        "wire_frac": {}, "dispatch_frac": {},
+    })
+    code = (
+        "import sys, types, os, time; sys.path.insert(0, %r)\n"
+        # parent-package shim: bridge-level ranks must work even where
+        # the package's jax gate blocks the full import
+        "pkg = types.ModuleType('mpi4jax_tpu')\n"
+        "pkg.__path__ = [os.path.join(%r, 'mpi4jax_tpu')]\n"
+        "sys.modules['mpi4jax_tpu'] = pkg\n"
+        "import numpy as np\n"
+        "from mpi4jax_tpu import live\n"
+        "from mpi4jax_tpu.runtime import bridge, transport\n"
+        "c = transport.get_world_comm()\n"
+        "h = c.handle\n"
+        "assert live.armed(), 'live controller failed to arm'\n"
+        "x = np.zeros(65536, dtype=np.float32)\n"  # 256 KiB payload
+        "deadline = time.time() + 45\n"
+        "ops = 0\n"
+        "while time.time() < deadline:\n"
+        "    bridge.allreduce(h, x, 0)\n"
+        "    ops += 1\n"
+        "    if live.status().get('epoch', 0) > 0:\n"
+        "        break\n"
+        "    time.sleep(0.002)\n"
+        "st = live.status()\n"
+        "sw = st.get('swaps', [])\n"
+        "changes = ';'.join(sw[0]['report'].get('changes', [])) if sw "
+        "else ''\n"
+        # one write() so the two ranks' report lines can't interleave
+        "sys.stdout.write('diag_live %%d epoch %%d ops %%d errors %%d "
+        "changes %%r\\n' %% (\n"
+        "    c.rank(), st.get('epoch', 0), ops, st.get('errors', -1),\n"
+        "    changes))\n"
+        "sys.stdout.flush()\n"
+        % (REPO, REPO)
+    )
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_m4j_diag_live.py", delete=False
+    ) as f:
+        f.write(code)
+        prog = f.name
+    with tempfile.NamedTemporaryFile(
+        "w", suffix="_m4j_diag_live_model.json", delete=False
+    ) as f:
+        f.write(model)
+        model_path = f.name
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        # TCP path so the installed table actually dispatches (the
+        # same-host shm arena would shadow the algorithm choice)
+        "MPI4JAX_TPU_DISABLE_SHM": "1",
+        "MPI4JAX_TPU_TIMEOUT_S": "60",
+        "MPI4JAX_TPU_TUNE_MODEL": model_path,
+        "MPI4JAX_TPU_COLL_ALGO": "allreduce=ring",
+        "MPI4JAX_TPU_LIVE": "auto",
+        "MPI4JAX_TPU_LIVE_WINDOW": str(window),
+        "MPI4JAX_TPU_LIVE_DRIFT_PCT": "50",
+        "MPI4JAX_TPU_LIVE_COOLDOWN_OPS": str(cooldown),
+    }
+    t0 = time.perf_counter()
+    try:
+        res = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "mpi4jax_tpu", "runtime", "launch.py"),
+             "-n", "2", "--port", str(port), prog],
+            capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"{knobs}; live retune run hung"
+    finally:
+        os.unlink(prog)
+        os.unlink(model_path)
+    dt = time.perf_counter() - t0
+    lines = {
+        int(m.group(1)): (int(m.group(2)), int(m.group(3)),
+                          int(m.group(4)), m.group(5))
+        for m in re.finditer(
+            r"diag_live (\d+) epoch (\d+) ops (\d+) errors (\d+) "
+            r"changes '([^']*)'", res.stdout)
+    }
+    ok = (
+        res.returncode == 0
+        and len(lines) == 2
+        # agreement: BOTH ranks installed the same nonzero epoch
+        and lines[0][0] == lines[1][0] >= 1
+        # the re-pick: report names the old -> new winner
+        and all("ring -> rd" in v[3] for v in lines.values())
+        # the commit really went through the rendezvous
+        and "[live] epoch 1 committed" in res.stderr
+        # controller thread never swallowed an exception
+        and all(v[2] == 0 for v in lines.values())
+    )
+    if not ok:
+        tail = (res.stderr.strip() or res.stdout.strip())[-220:]
+        return False, f"{knobs}; live retune failed: {tail}"
+    ops = max(v[1] for v in lines.values())
+    return True, (f"{knobs}; forced model drift detected, epoch "
+                  f"{lines[0][0]} rendezvous re-picked "
+                  f"'{lines[0][3]}' on both ranks after {ops} ops "
+                  f"in {dt:.1f}s")
+
+
 def check_topology(port):
     """The topology subsystem end to end on a loopback 4-rank job
     virtually partitioned into two islands (MPI4JAX_TPU_FAKE_HOSTS):
@@ -981,6 +1110,7 @@ def main(argv=None):
         ("self_healing", lambda: check_self_healing(args.port + 53)),
         ("elasticity", lambda: check_elasticity(args.port + 29)),
         ("serving", lambda: check_serving(args.port + 43)),
+        ("live_retune", lambda: check_live_retune(args.port + 61)),
     ]
     if args.device:
         checks += [

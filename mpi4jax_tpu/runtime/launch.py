@@ -467,6 +467,12 @@ def main(argv=None):
                              "after the job ends; per-rank recordings stay "
                              "next to it as OUT.json.rank<r>.json "
                              "(docs/observability.md)")
+    parser.add_argument("--live", action="store_true",
+                        help="arm live drift detection + collective "
+                             "re-tuning in every rank "
+                             "(MPI4JAX_TPU_LIVE=auto; thresholds via "
+                             "MPI4JAX_TPU_LIVE_WINDOW / _DRIFT_PCT / "
+                             "_COOLDOWN_OPS — docs/usage.md)")
     parser.add_argument("prog", help="python program to run")
     parser.add_argument("args", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
@@ -592,6 +598,8 @@ def main(argv=None):
             env.setdefault("MPI4JAX_TPU_CONNECT_TIMEOUT_S", "60")
         if args.trace:
             env["MPI4JAX_TPU_TRACE"] = os.path.abspath(args.trace)
+        if args.live:
+            env["MPI4JAX_TPU_LIVE"] = "auto"
         if plan_path:
             env["MPI4JAX_TPU_PLAN"] = plan_path
         if args.hosts:

@@ -473,6 +473,15 @@ class Server:
             if verdict:
                 print(f"[serving] SLO: {verdict}", file=sys.stderr,
                       flush=True)
+            # max-batch floor hit: translate the scheduler's re-tune
+            # request into a live-controller poke (an immediate drift
+            # evaluation).  The flag is consumed every step — it never
+            # sticks, and with the controller disarmed the request is
+            # still counted for the operator (live.status()).
+            if self.scheduler.retune_requested:
+                from .. import live
+
+                live.consume_retune(self.scheduler)
         self.requests = [r for r in self.requests if not r.done]
         return done_now
 

@@ -474,6 +474,37 @@ Knobs (all prefixed ``MPI4JAX_TPU_``):
                                 mismatched frames
                                 (serving/_roles.py).
 
+- ``MPI4JAX_TPU_LIVE``         — live drift detection + collective
+                                re-tuning (``mpi4jax_tpu.live``):
+                                ``off`` (default: no controller thread,
+                                no collective-boundary hook — pre-live
+                                behavior bit-for-bit) or ``auto`` (a
+                                controller follows the native obs
+                                stream through the non-destructive
+                                cursor, flags drift from the cost
+                                model's predictions, and swaps the
+                                decision table at an epoch rendezvous
+                                all ranks reach together).  Strict:
+                                ranks disagreeing on the mode would
+                                rendezvous on different collective
+                                sequences and deadlock.
+- ``MPI4JAX_TPU_LIVE_WINDOW``  — rolling event window the controller
+                                keeps over the obs stream (positive
+                                int, default 256); drift medians and
+                                the refit model use only the freshest
+                                window (live/_controller.py).
+- ``MPI4JAX_TPU_LIVE_DRIFT_PCT`` — percent deviation of an observed
+                                per-(op, size band, algorithm) median
+                                from the model prediction that counts
+                                as drift (positive float, default 30)
+                                (live/_drift.py).
+- ``MPI4JAX_TPU_LIVE_COOLDOWN_OPS`` — minimum world-collective
+                                boundaries between table swaps
+                                (positive int, default 64); also paces
+                                the epoch-rendezvous probe at
+                                cooldown/4 boundaries
+                                (live/_swap.py).
+
 There is intentionally no token/notoken routing knob (the reference's
 ``MPI4JAX_PREFER_NOTOKEN``, utils.py:167-169 there): ordered effects ARE
 the core here, and reference-style explicit-token signatures live in
@@ -548,6 +579,10 @@ KNOBS = {
     "MPI4JAX_TPU_SERVE_QUEUE_CAP": "serving: bounded admission queue size",
     "MPI4JAX_TPU_SERVE_SLO_MS": "serving: decode p99 SLO target (ms)",
     "MPI4JAX_TPU_SERVE_ROLES": "serving: auto / colocated / disagg",
+    "MPI4JAX_TPU_LIVE": "live drift detection + re-tuning: off/auto",
+    "MPI4JAX_TPU_LIVE_WINDOW": "live controller rolling window (events)",
+    "MPI4JAX_TPU_LIVE_DRIFT_PCT": "drift threshold vs model (percent)",
+    "MPI4JAX_TPU_LIVE_COOLDOWN_OPS": "min collective boundaries between swaps",
 }
 
 _TRUTHY = frozenset(("1", "true", "on", "yes", "y"))
@@ -1049,3 +1084,64 @@ def serve_roles() -> str:
     raise ValueError(
         f"cannot parse MPI4JAX_TPU_SERVE_ROLES={raw!r} "
         "(expected auto, colocated, or disagg)")
+
+
+def live_mode() -> str:
+    """``MPI4JAX_TPU_LIVE`` as "off" | "auto" — the live re-tuning
+    subsystem (``mpi4jax_tpu.live``): a controller thread that watches
+    the native obs stream for drift from the cost model's predictions
+    and swaps the collective decision table at an agreed boundary.
+    Strict like the other cross-rank gates: ranks disagreeing on the
+    mode would rendezvous on different collective sequences and
+    deadlock, so a typo aborts loudly.  The "off" default arms nothing
+    — no thread, no boundary hook, no obs-ring enable — pinning
+    pre-live behavior bit-for-bit."""
+    raw = os.environ.get("MPI4JAX_TPU_LIVE")
+    if raw is None or not raw.strip():
+        return "off"
+    v = raw.strip()
+    if v in ("off", "auto"):
+        return v
+    raise ValueError(
+        f"cannot parse MPI4JAX_TPU_LIVE={raw!r} (expected off or auto)")
+
+
+def live_window() -> int:
+    """``MPI4JAX_TPU_LIVE_WINDOW``: the live controller's rolling
+    window over the native obs stream, in events (strict positive int,
+    default 256).  Drift medians and the refit model both come from
+    the freshest ``window`` events only — stale timings never pool
+    with the current contention regime's."""
+    return _positive_int_knob("MPI4JAX_TPU_LIVE_WINDOW", 256)
+
+
+def live_drift_pct() -> float:
+    """``MPI4JAX_TPU_LIVE_DRIFT_PCT``: how far (percent) an observed
+    per-(op, size band, algorithm) median may deviate from the cost
+    model's prediction before the controller declares drift and
+    prepares a candidate table (strict positive float, default 30).
+    Strict: a typo'd threshold silently never (or always) firing would
+    defeat the loop."""
+    raw = os.environ.get("MPI4JAX_TPU_LIVE_DRIFT_PCT")
+    if raw is None or not raw.strip():
+        return 30.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"cannot parse MPI4JAX_TPU_LIVE_DRIFT_PCT={raw!r} as percent")
+    if v <= 0:
+        raise ValueError(
+            f"MPI4JAX_TPU_LIVE_DRIFT_PCT={raw!r} must be > 0")
+    return v
+
+
+def live_cooldown_ops() -> int:
+    """``MPI4JAX_TPU_LIVE_COOLDOWN_OPS``: minimum world-collective
+    boundaries between table swaps (strict positive int, default 64).
+    Also paces the epoch rendezvous itself — ranks compare epochs every
+    ``cooldown / 4`` boundaries (at least every boundary), so a
+    proposed table is installed well within one cooldown of drift
+    detection while a quiescent run pays a 16-byte bcast at most every
+    few boundaries."""
+    return _positive_int_knob("MPI4JAX_TPU_LIVE_COOLDOWN_OPS", 64)

@@ -460,6 +460,9 @@ std::mutex g_obs_mu;
 std::vector<TpuObsEvent> g_obs_ring;  // fixed capacity once enabled
 int64_t g_obs_total = 0;              // appended since enable (kept + dropped)
 int64_t g_obs_dropped = 0;            // overwritten by overflow
+int64_t g_obs_seq = 0;                // appended since enable, NEVER reset by
+                                      // drain — the absolute sequence space
+                                      // tpucomm_obs_peek cursors live in
 thread_local double g_obs_wait_acc = 0.0;
 
 /* Self-healing link counters (process totals; see tpucomm_link_counters
@@ -496,6 +499,7 @@ void obs_append(const TpuObsEvent& ev) {
   g_obs_ring[(size_t)(g_obs_total % cap)] = ev;
   if (g_obs_total >= cap) g_obs_dropped++;
   g_obs_total++;
+  g_obs_seq++;
 }
 
 /* RAII event record for one transport op.  Constructed where the op
@@ -4597,6 +4601,14 @@ struct CollTable {
 CollTable g_coll_table[3];  // indexed by TpuCollOpKind
 std::mutex g_coll_table_mu;
 
+/* Live re-tuning staging area (mpi4jax_tpu/live): candidate tables park
+ * here without touching dispatch until every rank commits them at an
+ * agreed collective boundary.  g_coll_epoch stamps the live table's
+ * generation (0 = the offline-installed table). */
+CollTable g_coll_staged[3];
+bool g_coll_staged_set[3] = {false, false, false};
+int64_t g_coll_epoch = 0;
+
 int coll_table_lookup(int op_kind, int64_t nbytes) {
   std::lock_guard<std::mutex> lock(g_coll_table_mu);
   int algo = TPU_COLL_AUTO;
@@ -8061,6 +8073,46 @@ void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
   g_coll_table[op_kind].entries = std::move(entries);
 }
 
+void tpucomm_stage_coll_table(int op_kind, const int64_t* min_bytes,
+                              const int32_t* algos, int n) {
+  if (op_kind < 0 || op_kind > 2) return;
+  std::vector<std::pair<int64_t, int32_t>> entries;
+  for (int i = 0; i < n; i++) {
+    int32_t a = algos[i];
+    if (a < TPU_COLL_AUTO || a > TPU_COLL_HQA2A || a == TPU_COLL_SHM)
+      continue;  // same validation as the direct install
+    entries.emplace_back(min_bytes[i], a);
+  }
+  std::sort(entries.begin(), entries.end());
+  std::lock_guard<std::mutex> lock(g_coll_table_mu);
+  g_coll_staged[op_kind].entries = std::move(entries);
+  g_coll_staged_set[op_kind] = true;
+}
+
+int tpucomm_commit_coll_tables(int64_t h, int64_t epoch) {
+  Comm* c = get_comm(h);
+  if (!c) return 1;
+  /* the tpucomm_set_topology swap discipline: comm lock + engine
+   * quiesced, so no op resolved against the old table is mid-flight
+   * when the table changes under it */
+  std::lock_guard<std::mutex> lock(comm_mu(c));
+  engine_quiesce(c->lock_root);
+  std::lock_guard<std::mutex> tlock(g_coll_table_mu);
+  for (int k = 0; k < 3; k++) {
+    if (!g_coll_staged_set[k]) continue;  // never-staged kinds keep theirs
+    g_coll_table[k].entries = g_coll_staged[k].entries;
+    g_coll_staged[k].entries.clear();
+    g_coll_staged_set[k] = false;
+  }
+  g_coll_epoch = epoch;
+  return 0;
+}
+
+int64_t tpucomm_coll_epoch(void) {
+  std::lock_guard<std::mutex> lock(g_coll_table_mu);
+  return g_coll_epoch;
+}
+
 int tpucomm_coll_algo_for(int64_t h, int op_kind, int64_t nbytes) {
   Comm* c = get_comm(h);
   if (!c || op_kind < 0 || op_kind > 2) return -1;
@@ -8109,6 +8161,7 @@ void tpucomm_obs_enable(int enabled, int64_t capacity) {
   }
   g_obs_total = 0;
   g_obs_dropped = 0;
+  g_obs_seq = 0;
   /* flip the hot-path flag LAST on enable (an op racing this call may
    * observe on=1 with the ring already sized, never a stale ring) */
   g_obs_on.store(enabled ? 1 : 0, std::memory_order_release);
@@ -8138,6 +8191,39 @@ int64_t tpucomm_obs_drain(TpuObsEvent* out, int64_t max_n) {
    * silently — the exact-drop-accounting contract */
   g_obs_dropped += held - n;
   g_obs_total = 0;  // drain clears held events; dropped survives
+  return n;
+}
+
+int64_t tpucomm_obs_peek(TpuObsEvent* out, int64_t max_n, int64_t* cursor,
+                         int64_t* out_skipped) {
+  std::lock_guard<std::mutex> lock(g_obs_mu);
+  if (out_skipped) *out_skipped = 0;
+  if (!cursor) return 0;
+  const int64_t cap = (int64_t)g_obs_ring.size();
+  if (cap == 0 || max_n <= 0) return 0;
+  int64_t held = g_obs_total < cap ? g_obs_total : cap;
+  /* the held events occupy the absolute sequence range
+   * [g_obs_seq - held, g_obs_seq); anything older was overwritten by
+   * overflow or cleared by a destructive drain */
+  int64_t oldest = g_obs_seq - held;
+  int64_t cur = *cursor;
+  if (cur < 0) cur = 0;
+  if (cur > g_obs_seq) cur = oldest;  // cursor from before a re-enable
+  if (cur < oldest) {
+    if (out_skipped) *out_skipped = oldest - cur;
+    cur = oldest;
+  }
+  int64_t avail = g_obs_seq - cur;
+  int64_t n = avail < max_n ? avail : max_n;
+  for (int64_t i = 0; i < n; i++) {
+    /* slot of sequence number s: the newest held event (s = seq-1)
+     * sits at (g_obs_total - 1) % cap and slots run backwards from
+     * there — valid for every s >= oldest because drain resets
+     * g_obs_total and g_obs_seq never moves backwards */
+    int64_t s = cur + i;
+    out[i] = g_obs_ring[(size_t)((g_obs_total - (g_obs_seq - s)) % cap)];
+  }
+  *cursor = cur + n;
   return n;
 }
 

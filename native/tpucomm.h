@@ -311,6 +311,32 @@ int tpucomm_alltoall_algo(int64_t h, const void* sendbuf, void* recvbuf,
 void tpucomm_set_coll_table(int op_kind, const int64_t* min_bytes,
                             const int32_t* algos, int n);
 
+/* ---- live re-tuning (mpi4jax_tpu/live is the owner) ----
+ *
+ * Stage-then-commit twin of tpucomm_set_coll_table, so every rank can
+ * prepare a candidate table asynchronously and install it at an agreed
+ * collective boundary.  tpucomm_stage_coll_table validates and parks
+ * one op kind's entries in a staging slot WITHOUT touching dispatch;
+ * tpucomm_commit_coll_tables atomically promotes every staged kind to
+ * the live table under the comm lock with the progress engine quiesced
+ * (the tpucomm_set_topology swap discipline — no op may be mid-flight
+ * while the decision table it resolved against changes), and stamps
+ * the process-wide table epoch.  Ranks that commit the same staged
+ * tables at the same collective boundary therefore keep algorithm
+ * agreement; the epoch is readable (tpucomm_coll_epoch) so the Python
+ * controller and diag can assert which generation is live. */
+void tpucomm_stage_coll_table(int op_kind, const int64_t* min_bytes,
+                              const int32_t* algos, int n);
+
+/* Promote all staged tables under comm `h`'s lock (engine quiesced) and
+ * set the table epoch.  Kinds never staged since the last commit keep
+ * their live table.  Returns 0 on success, 1 for a bad handle. */
+int tpucomm_commit_coll_tables(int64_t h, int64_t epoch);
+
+/* The live decision-table epoch: 0 at load (the offline-installed
+ * table), then whatever the last successful commit stamped. */
+int64_t tpucomm_coll_epoch(void);
+
 /* Resolution probe for diag/tracing: the TpuCollAlgo code that WOULD
  * run for (comm, op kind, payload bytes) — including TPU_COLL_SHM when
  * the same-host arena path serves the call.  -1 for a bad handle.
@@ -420,6 +446,19 @@ void tpucomm_obs_counts(int64_t* out_recorded, int64_t* out_dropped);
  * fit `out` are added to the drop counter — never silently lost; the
  * drop counter survives until re-enable.  Returns the number copied. */
 int64_t tpucomm_obs_drain(struct TpuObsEvent* out, int64_t max_n);
+
+/* Non-destructive cursor read: copy up to max_n events appended at or
+ * after `*cursor` (an absolute per-enable sequence number; pass 0 to
+ * start from the oldest held) into `out`, oldest first, WITHOUT
+ * clearing the ring or touching the drop counter — a second consumer
+ * (the live controller) can follow the stream while the end-of-run
+ * tpucomm_obs_drain still sees every held event.  On return `*cursor`
+ * points one past the last copied event; `*out_skipped` (may be NULL)
+ * counts events between the old cursor and the oldest still readable
+ * (lost to ring overflow or a destructive drain).  A cursor from
+ * before the last re-enable is clamped.  Returns the number copied. */
+int64_t tpucomm_obs_peek(struct TpuObsEvent* out, int64_t max_n,
+                         int64_t* cursor, int64_t* out_skipped);
 
 /* The recorder's clock (monotonic seconds, arbitrary per-process
  * epoch — the same clock TpuObsEvent.t_start uses), so the Python side

@@ -23,7 +23,8 @@ def test_diag_cpu_checks():
     assert names == {"native_build", "ffi_fast_path", "coll_algo_engine",
                      "observability", "static_verify", "schedule_plan",
                      "topology", "transport_loopback", "failure_detection",
-                     "self_healing", "elasticity", "serving"}
+                     "self_healing", "elasticity", "serving",
+                     "live_retune"}
     # the topology probe renders the island map and the live pick
     topo_check = next(r for r in data["results"] if r["check"] == "topology")
     assert "island0[" in topo_check["detail"]
@@ -66,3 +67,9 @@ def test_diag_cpu_checks():
     assert "digests bit-identical" in sh["detail"]
     assert "dup_dropped=" in sh["detail"]
     assert "obs.stats()" in sh["detail"]
+    # the live-retune probe proves forced drift flows through detection,
+    # the epoch rendezvous, and the swap report on both ranks
+    lr = next(r for r in data["results"] if r["check"] == "live_retune")
+    assert "drift detected" in lr["detail"]
+    assert "ring -> rd" in lr["detail"]
+    assert "on both ranks" in lr["detail"]

@@ -1,0 +1,491 @@
+"""Live re-tuning subsystem (mpi4jax_tpu/live): the drift detector's
+flag/no-flag behavior on contended vs quiescent phases, the epoch
+rendezvous' agreement and reentrancy properties against a fake bridge
+(two simulated ranks in lockstep), the controller's candidate-table
+build (baseline overlay -> winner flip), the strict LIVE_* knob
+parsers, the serving retune-flag consumption, and — against the real
+native transport on a size-1 loopback comm — the two-consumer obs-ring
+contract: the peek cursor never steals events from the destructive
+drain, so a run with an armed controller still dumps a byte-complete
+trace.
+
+No ranks, no sockets (except the loopback self-sends the native ring
+tests use); loads under an ALIAS package name like test_serving.py
+does, so old-jax containers run everything."""
+
+import ctypes
+import importlib
+import json
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+try:
+    from mpi4jax_tpu import live, tune
+    from mpi4jax_tpu.live import _controller, _drift, _swap
+    from mpi4jax_tpu.obs import _native as obs_native
+    from mpi4jax_tpu.utils import config
+except ImportError:
+    _ALIAS = "m4j_lv"
+    if _ALIAS not in sys.modules:
+        _pkg = types.ModuleType(_ALIAS)
+        _pkg.__path__ = [str(REPO / "mpi4jax_tpu")]
+        sys.modules[_ALIAS] = _pkg
+    live = importlib.import_module(_ALIAS + ".live")
+    tune = importlib.import_module(_ALIAS + ".tune")
+    _controller = importlib.import_module(_ALIAS + ".live._controller")
+    _drift = importlib.import_module(_ALIAS + ".live._drift")
+    _swap = importlib.import_module(_ALIAS + ".live._swap")
+    obs_native = importlib.import_module(_ALIAS + ".obs._native")
+    config = importlib.import_module(_ALIAS + ".utils.config")
+
+_model = tune._submodule("_model")
+
+
+def _ev(op="Allreduce", nbytes=262144, dur_s=1e-4, algo="ring"):
+    return {"name": op, "src": "native", "ts_us": 0.0,
+            "dur_us": dur_s * 1e6, "wait_us": 0.0, "dispatch_us": 0.0,
+            "bytes": int(nbytes), "peer": -1, "tag": 0, "algo": algo}
+
+
+def _baseline_model(tmp_path=None):
+    """ring predicted fast, rd a known modest alternative."""
+    m = _model.CostModel(world_size=2, source="test")
+    m.add_sample("allreduce", "ring", 1024, 1e-6)
+    m.add_sample("allreduce", "ring", 262144, 1e-5)
+    m.add_sample("allreduce", "rd", 1024, 5e-6)
+    m.add_sample("allreduce", "rd", 262144, 1e-4)
+    return m
+
+
+# ---------------- knobs ----------------
+
+
+def test_live_knob_defaults(monkeypatch):
+    for k in ("MPI4JAX_TPU_LIVE", "MPI4JAX_TPU_LIVE_WINDOW",
+              "MPI4JAX_TPU_LIVE_DRIFT_PCT",
+              "MPI4JAX_TPU_LIVE_COOLDOWN_OPS"):
+        monkeypatch.delenv(k, raising=False)
+    assert config.live_mode() == "off"
+    assert config.live_window() == 256
+    assert config.live_drift_pct() == 30.0
+    assert config.live_cooldown_ops() == 64
+
+
+def test_live_knob_parsers_are_strict_and_loud(monkeypatch):
+    monkeypatch.setenv("MPI4JAX_TPU_LIVE", "auto")
+    assert config.live_mode() == "auto"
+    monkeypatch.setenv("MPI4JAX_TPU_LIVE", "yes")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_LIVE"):
+        config.live_mode()
+    monkeypatch.setenv("MPI4JAX_TPU_LIVE_WINDOW", "0")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_LIVE_WINDOW"):
+        config.live_window()
+    monkeypatch.setenv("MPI4JAX_TPU_LIVE_DRIFT_PCT", "-3")
+    with pytest.raises(ValueError, match="MPI4JAX_TPU_LIVE_DRIFT_PCT"):
+        config.live_drift_pct()
+    monkeypatch.setenv("MPI4JAX_TPU_LIVE_COOLDOWN_OPS", "many")
+    with pytest.raises(ValueError,
+                       match="MPI4JAX_TPU_LIVE_COOLDOWN_OPS"):
+        config.live_cooldown_ops()
+
+
+# ---------------- drift detector ----------------
+
+
+def test_contention_phase_is_flagged():
+    """A quiescent-calibrated model + a contended phase -> exactly the
+    drifted (op, band, algo) is flagged, with the right direction.
+
+    Two-phase: the first crossing only arms suspicion (the window
+    straddles the onset); a fresh post-onset window confirms."""
+    det = _drift.DriftDetector(_baseline_model(), drift_pct=30.0,
+                               min_samples=6)
+    det.observe([_ev(dur_s=8e-5) for _ in range(8)])  # 8x the model
+    assert det.drifts() == []          # phase 1: suspect, window cleared
+    det.observe([_ev(dur_s=8e-5) for _ in range(8)])  # pure post-onset
+    found = det.drifts()
+    assert len(found) == 1
+    d = found[0]
+    assert (d.op, d.band, d.algo) == ("allreduce", 262144, "ring")
+    assert d.deviation_pct > 30.0 and d.samples == 8
+    assert d.predicted_s == pytest.approx(1e-5)
+
+
+def test_transient_spike_never_confirms():
+    """A suspect whose FRESH window comes back inside the threshold was
+    a transient, not a regime change — suspicion is dropped and the key
+    can re-arm later (no sticky state)."""
+    det = _drift.DriftDetector(_baseline_model(), drift_pct=30.0,
+                               min_samples=6)
+    det.observe([_ev(dur_s=8e-5) for _ in range(8)])   # spike
+    assert det.drifts() == []                          # armed
+    det.observe([_ev(dur_s=1e-5) for _ in range(8)])   # back to normal
+    assert det.drifts() == []                          # disarmed
+    # a genuine regime change afterwards still takes two phases
+    det.observe([_ev(dur_s=8e-5) for _ in range(8)])
+    assert det.drifts() == []
+    det.observe([_ev(dur_s=8e-5) for _ in range(8)])
+    assert len(det.drifts()) == 1
+
+
+def test_quiescent_run_raises_zero_flags():
+    """Timings matching the model (within the threshold) never flag —
+    the ZERO-swap guarantee's detector half."""
+    det = _drift.DriftDetector(_baseline_model(), drift_pct=30.0,
+                               min_samples=6)
+    det.observe([_ev(dur_s=1.1e-5) for _ in range(50)])     # +10%
+    det.observe([_ev(nbytes=1024, dur_s=0.9e-6) for _ in range(50)])
+    assert det.drifts() == []
+    assert det.events_used == 100
+
+
+def test_faster_than_predicted_also_drifts():
+    det = _drift.DriftDetector(_baseline_model(), drift_pct=30.0,
+                               min_samples=6)
+    det.observe([_ev(dur_s=1e-6) for _ in range(8)])  # 10x faster
+    assert det.drifts() == []                         # armed
+    det.observe([_ev(dur_s=1e-6) for _ in range(8)])
+    found = det.drifts()
+    assert len(found) == 1 and found[0].deviation_pct < -30.0
+
+
+def test_detector_needs_min_samples_and_a_model():
+    det = _drift.DriftDetector(None, drift_pct=30.0, min_samples=6)
+    det.observe([_ev(dur_s=1.0) for _ in range(8)])
+    assert det.drifts() == []                    # no model, no drift
+    det.set_model(_baseline_model())
+    det2 = _drift.DriftDetector(_baseline_model(), min_samples=6)
+    det2.observe([_ev(dur_s=1.0) for _ in range(5)])
+    assert det2.drifts() == []                   # below min_samples
+    assert det.drifts() == []                    # armed only
+    det.observe([_ev(dur_s=1.0) for _ in range(8)])
+    assert len(det.drifts()) == 1
+
+
+def test_detector_applies_tuner_event_filter():
+    """Events the offline fit ignores (shm, per-leg tiers, ops spans,
+    unknown algos) never feed drift — the model could not have learned
+    them, so there is nothing to drift FROM."""
+    det = _drift.DriftDetector(_baseline_model(), min_samples=2)
+    shm = _ev(dur_s=1.0)
+    shm["algo"] = "shm"
+    tiered = _ev(dur_s=1.0)
+    tiered["tier"] = "intra"
+    span = _ev(dur_s=1.0)
+    span["src"] = "ops"
+    unseen = _ev(dur_s=1.0)
+    unseen["algo"] = None
+    det.observe([shm, tiered, span, unseen] * 4)
+    assert det.events_used == 0 and det.drifts() == []
+
+
+# ---------------- swap protocol (fake bridge) ----------------
+
+
+class FakeBridge:
+    """Two lockstep instances sharing ``channel`` emulate a 2-rank
+    bcast: rank 0 appends its buffer, rank 1 reads in order."""
+
+    def __init__(self, rank, channel):
+        self.rank = rank
+        self.channel = channel
+        self._read = 0
+        self.staged = []
+        self.commits = []
+        self.proto = None        # set for the reentrancy test
+        self.stage_ok = True
+
+    def coll_epoch(self):
+        return self.commits[-1][1] if self.commits else 0
+
+    def bcast(self, handle, buf, root):
+        # a real bcast re-enters the boundary hook; emulate that
+        if self.proto is not None:
+            self.proto.on_boundary(handle)
+        if self.rank == 0:
+            self.channel.append(np.array(buf, copy=True))
+            return buf
+        out = self.channel[self._read]
+        self._read += 1
+        return out
+
+    def stage_coll_table(self, coded):
+        if not self.stage_ok:
+            return False
+        self.staged.append(coded)
+        return True
+
+    def commit_coll_tables(self, handle, epoch):
+        self.commits.append((int(handle), int(epoch)))
+        return True
+
+
+def _pair(period=4):
+    chan = []
+    b0, b1 = FakeBridge(0, chan), FakeBridge(1, chan)
+    p0 = _swap.SwapProtocol(b0, 7, 0, 2, period)
+    p1 = _swap.SwapProtocol(b1, 7, 1, 2, period)
+    b0.proto, b1.proto = p0, p1
+    return (b0, p0), (b1, p1)
+
+
+def _drive(p0, p1, n, handle=7):
+    for _ in range(n):
+        p0.on_boundary(handle)
+        p1.on_boundary(handle)
+
+
+def test_steady_state_is_header_only_and_swap_free():
+    (b0, p0), (b1, p1) = _pair(period=4)
+    _drive(p0, p1, 20)
+    assert p0.boundaries == p1.boundaries == 20
+    assert p0.epoch == p1.epoch == 0
+    assert b0.commits == b1.commits == []
+    # 5 rendezvous, each exactly ONE header bcast (16 bytes), no payload
+    assert len(b0.channel) == 5
+    assert all(c.nbytes == 16 and c[1] == 0 for c in b0.channel)
+
+
+def test_proposal_commits_on_both_ranks_at_same_boundary():
+    (b0, p0), (b1, p1) = _pair(period=4)
+    _drive(p0, p1, 2)
+    ep = p0.propose({"tables": {"0": [[0, 2]]},
+                     "named": {"allreduce": [[0, "rd"]]},
+                     "report": {"changes": ["allreduce@0: ring -> rd"],
+                                "note": "test"}})
+    assert ep == 1
+    _drive(p0, p1, 2)                      # boundary 4: rendezvous
+    assert p0.epoch == p1.epoch == 1
+    assert b0.staged == b1.staged == [{0: [(0, 2)]}]
+    assert b0.commits == b1.commits == [(7, 1)]
+    assert [s["boundary"] for s in p0.swaps] \
+        == [s["boundary"] for s in p1.swaps] == [4]
+    assert not p0.pending()
+    # cooldown accounting restarts at the swap boundary
+    _drive(p0, p1, 3)
+    assert p0.boundaries_since_swap() == 3
+
+
+def test_rendezvous_bcasts_do_not_advance_the_boundary_clock():
+    """The rendezvous' own bcasts re-enter the hook (FakeBridge.bcast
+    calls on_boundary, like the real bridge); the _in_rv guard must
+    keep them out of the counter or ranks desynchronize."""
+    (b0, p0), (b1, p1) = _pair(period=2)
+    p0.propose({"tables": {"0": [[0, 3]]}, "named": {}, "report": {}})
+    _drive(p0, p1, 10)
+    # exactly the 10 application collectives counted, nothing else
+    assert p0.boundaries == p1.boundaries == 10
+    assert p0.epoch == p1.epoch == 1
+
+
+def test_off_comm_collectives_are_invisible():
+    (b0, p0), (b1, p1) = _pair(period=4)
+    for _ in range(9):
+        p0.on_boundary(12345)              # some sub-comm's handle
+    assert p0.boundaries == 0 and b0.channel == []
+
+
+def test_newer_proposal_supersedes_unserved_one():
+    (b0, p0), (b1, p1) = _pair(period=4)
+    p0.propose({"tables": {"0": [[0, 2]]}, "named": {}, "report": {}})
+    ep2 = p0.propose({"tables": {"0": [[0, 3]]}, "named": {},
+                      "report": {}})
+    _drive(p0, p1, 4)
+    assert p0.epoch == p1.epoch == ep2 == 2
+    assert b1.staged == [{0: [(0, 3)]}]    # only the latest installed
+    assert len(b0.commits) == 1
+
+
+def test_commit_failure_is_loud_not_silent():
+    (b0, p0), (b1, p1) = _pair(period=2)
+    b1.stage_ok = False                    # rank 1 cannot stage
+    p0.propose({"tables": {"0": [[0, 2]]}, "named": {}, "report": {}})
+    with pytest.raises(RuntimeError, match="stage_coll_table"):
+        _drive(p0, p1, 2)
+
+
+# ---------------- controller candidate build ----------------
+
+
+class FakeSwap:
+    def __init__(self):
+        self.proposed = []
+        self.epoch = 0
+
+    def pending(self):
+        return False
+
+    def boundaries_since_swap(self):
+        return 10**9
+
+    def propose(self, payload):
+        self.proposed.append(payload)
+        self.epoch += 1
+        return self.epoch
+
+
+def test_candidate_overlay_flips_drifted_winner(tmp_path, monkeypatch):
+    """The tentpole decision: observed ring timings overlay the
+    baseline, alternatives keep their baseline predictions, and the
+    ladder's winner at the drifted band flips ring -> rd."""
+    mp = tmp_path / "model.json"
+    mp.write_text(json.dumps(_baseline_model().to_json()))
+    monkeypatch.setenv("MPI4JAX_TPU_TUNE_MODEL", str(mp))
+    ctrl = _controller.Controller(
+        None, 7, 0, 2, FakeSwap(), window=64, drift_pct=30.0,
+        cooldown_ops=8)
+    assert ctrl.status()["baseline"].startswith("model-file")
+    slow_ring = [_ev(dur_s=5e-4) for _ in range(10)]   # 50x the model
+    ctrl._events.extend(slow_ring)
+    ctrl._detector.observe(slow_ring)
+    assert ctrl._detector.drifts() == []     # phase 1: suspect only
+    ctrl._events.extend(slow_ring)
+    ctrl._detector.observe(slow_ring)        # pure post-onset window
+    drifts = ctrl._detector.drifts()
+    assert drifts
+    tables, changes = ctrl._candidate(drifts)
+    assert "allreduce" in tables
+    assert _controller._lookup(tables["allreduce"], 262144) == "rd"
+    assert "allreduce@262144: ring -> rd" in changes
+    payload = ctrl._payload(tables, changes)
+    coded = payload["tables"][str(tune.OP_KIND["allreduce"])]
+    assert [0, tune.ALGO_CODES["ring"]] not in \
+        [e for e in coded if e[0] >= 262144]
+    # after the commit lands, the candidate IS the current table:
+    # proposing it again would be a no-op (convergence, not flapping)
+    ctrl.note_commit({"named": payload["named"]})
+    ctrl._events.extend(slow_ring)
+    ctrl._detector.observe(slow_ring)
+    tables2, _ = ctrl._candidate(ctrl._detector.drifts() or drifts)
+    assert "allreduce" not in tables2
+
+
+def test_candidate_respects_quant_deny(tmp_path, monkeypatch):
+    m = _baseline_model()
+    m.add_sample("allreduce", "qring", 262144, 1e-7)  # tempting, lossy
+    mp = tmp_path / "model.json"
+    mp.write_text(json.dumps(m.to_json()))
+    monkeypatch.setenv("MPI4JAX_TPU_TUNE_MODEL", str(mp))
+    monkeypatch.setenv("MPI4JAX_TPU_COLL_QUANT", "deny")
+    ctrl = _controller.Controller(
+        None, 7, 0, 2, FakeSwap(), window=64, drift_pct=30.0,
+        cooldown_ops=8)
+    slow_ring = [_ev(dur_s=5e-4) for _ in range(10)]
+    ctrl._events.extend(slow_ring)
+    ctrl._detector.observe(slow_ring)
+    assert ctrl._detector.drifts() == []     # phase 1: suspect only
+    ctrl._events.extend(slow_ring)
+    ctrl._detector.observe(slow_ring)
+    tables, _ = ctrl._candidate(ctrl._detector.drifts())
+    assert _controller._lookup(tables["allreduce"], 262144) == "rd"
+
+
+# ---------------- serving retune flag ----------------
+
+
+def test_consume_retune_resets_flag_and_counts():
+    sched = types.SimpleNamespace(retune_requested=True)
+    before = live.status()["retune_requests"]
+    assert live.consume_retune(sched) is True
+    assert sched.retune_requested is False
+    assert live.status()["retune_requests"] == before + 1
+    # idle flag: nothing consumed, nothing counted
+    assert live.consume_retune(sched) is False
+    assert live.status()["retune_requests"] == before + 1
+
+
+# ---------------- native ring: the two-consumer contract ----------------
+
+
+@pytest.fixture(scope="session")
+def native_lib(tmp_path_factory):
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which(cxx) is None:
+        pytest.skip(f"no C++ compiler ({cxx}) available")
+    so = tmp_path_factory.mktemp("live_native") / "libtpucomm_live.so"
+    src = REPO / "native" / "tpucomm.cc"
+    res = subprocess.run(
+        [cxx, "-O1", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+         "-shared", "-o", str(so), str(src), "-lrt"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert res.returncode == 0, f"native build failed:\n{res.stderr[-2000:]}"
+    lib = ctypes.CDLL(str(so))
+    lib.tpucomm_init.restype = ctypes.c_int64
+    lib.tpucomm_init.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                                 ctypes.c_char_p]
+    h = lib.tpucomm_init(0, 1, 47319, b"")
+    assert h > 0, "size-1 comm init failed"
+    yield lib, h
+    lib.tpucomm_finalize(ctypes.c_int64(h))
+
+
+def _self_send_recv(lib, h, tag):
+    buf = np.arange(8.0)
+    out = np.empty_like(buf)
+    p = lambda a: a.ctypes.data_as(ctypes.c_void_p)  # noqa: E731
+    assert lib.tpucomm_send(ctypes.c_int64(h), p(buf),
+                            ctypes.c_int64(buf.nbytes), 0, tag) == 0
+    assert lib.tpucomm_recv(ctypes.c_int64(h), p(out),
+                            ctypes.c_int64(out.nbytes), 0, tag) == 0
+
+
+def test_peek_consumer_leaves_drain_byte_complete(native_lib):
+    """THE two-consumer contract: an armed live controller (peek
+    cursor) interleaved with recording must not cost the end-of-run
+    trace a single event."""
+    lib, h = native_lib
+    assert obs_native.peek_available(lib)
+    obs_native.enable(lib, 64)
+    cursor, peeked = 0, []
+    for tag in range(70, 75):
+        _self_send_recv(lib, h, tag)
+        got, cursor, skipped = obs_native.peek(lib, cursor)
+        assert skipped == 0
+        peeked.extend(got)
+    drained = obs_native.drain(lib)
+    obs_native.disable(lib)
+    # the follower saw every event AND the drain still owns every event
+    assert len(peeked) == len(drained) == 10
+    assert [(e["name"], e["tag"]) for e in peeked] \
+        == [(e["name"], e["tag"]) for e in drained]
+
+
+def test_peek_cursor_survives_destructive_drain(native_lib):
+    """The double-consumption hazard the cursor fixes: a drain between
+    two peeks must neither replay old events nor lose new ones."""
+    lib, h = native_lib
+    obs_native.enable(lib, 64)
+    _self_send_recv(lib, h, 80)
+    _self_send_recv(lib, h, 81)
+    got, cursor, skipped = obs_native.peek(lib, 0)
+    assert len(got) == 4 and cursor == 4 and skipped == 0
+    assert len(obs_native.drain(lib)) == 4        # destructive consumer
+    _self_send_recv(lib, h, 82)
+    got, cursor, skipped = obs_native.peek(lib, cursor)
+    obs_native.disable(lib)
+    # exactly the two NEW events, no replay, no gap
+    assert [e["tag"] for e in got] == [82, 82]
+    assert cursor == 6 and skipped == 0
+
+
+def test_peek_reports_overflow_as_skipped(native_lib):
+    lib, h = native_lib
+    obs_native.enable(lib, 16)
+    for tag in range(90, 110):                    # 40 events, cap 16
+        _self_send_recv(lib, h, tag)
+    got, cursor, skipped = obs_native.peek(lib, 0, max_events=64)
+    obs_native.disable(lib)
+    assert len(got) == 16 and skipped == 24 and cursor == 40
+    assert [e["tag"] for e in got] == \
+        [tag for tag in range(102, 110) for _ in (0, 1)]

@@ -692,3 +692,70 @@ def test_asan_loopback_pair(shm, uring):
                  + (37 if uring == "1" else 0)) % 900,
         extra,
     )
+
+
+# ---- live re-tuning under TSan -------------------------------------
+#
+# The live controller is a NEW concurrent reader of the transport's
+# state: its thread walks the obs ring via tpucomm_obs_peek while op
+# threads append, and a mid-run epoch commit promotes staged decision
+# tables (engine quiesced, comm lock held) while the dispatch path
+# reads them per call.  A 2-rank pair runs the full Python stack
+# (bridge + armed controller) against the sanitized .so, proposes a
+# swap mid-loop, and requires 0 reports — shm on and off.
+
+_LIVE_RANK_SRC = r"""
+import os, sys, types
+REPO = os.environ["SAN_REPO"]
+sys.path.insert(0, REPO)
+pkg = types.ModuleType("mpi4jax_tpu")
+pkg.__path__ = [os.path.join(REPO, "mpi4jax_tpu")]
+sys.modules["mpi4jax_tpu"] = pkg
+import numpy as np
+from mpi4jax_tpu import live
+from mpi4jax_tpu.runtime import bridge
+
+rank = int(os.environ["SAN_RANK"])
+port = int(os.environ["SAN_PORT"])
+h = bridge.comm_init(rank, 2, "127.0.0.1:%d" % port)
+assert live.armed(), "controller must arm under MPI4JAX_TPU_LIVE=auto"
+x = np.arange(2048, dtype=np.int32)
+for it in range(60):
+    out = bridge.allreduce(h, x + it, 0)  # SUM
+    assert out[0] == 2 * it, (it, out[0])
+    if it == 20 and rank == 0:
+        live.propose({"allreduce": [(0, "rd")]}, note="tsan")
+st = live.status()
+assert st["epoch"] >= 1, st
+assert st["errors"] == 0, st
+bridge.comm_finalize(h)
+print("san-rank-ok", rank, flush=True)
+"""
+
+
+def _live_env(shm, tag):
+    extra = {
+        "SAN_REPO": REPO,
+        "MPI4JAX_TPU_NATIVE_LIB": os.path.join(
+            SO_DIR, "libtpucomm_tsan.so"),
+        "MPI4JAX_TPU_JOBID": f"{tag}{shm}{os.getpid()}",
+        "MPI4JAX_TPU_LIVE": "auto",
+        "MPI4JAX_TPU_LIVE_WINDOW": "64",
+        "MPI4JAX_TPU_LIVE_COOLDOWN_OPS": "8",
+    }
+    if shm == "off":
+        extra["MPI4JAX_TPU_DISABLE_SHM"] = "1"
+    return extra
+
+
+@pytest.mark.parametrize("shm", ["on", "off"])
+def test_tsan_live_retune_pair(shm):
+    _build("tsan")
+    preload = _preload_path("libtsan.so")
+    so = os.path.join(SO_DIR, "libtpucomm_tsan.so")
+    san = {"TSAN_OPTIONS": "exitcode=66 halt_on_error=0"}
+    _run_group(
+        _LIVE_RANK_SRC, 2, so, preload, san,
+        49500 + (os.getpid() + (19 if shm == "on" else 0)) % 400,
+        _live_env(shm, "tsanlive"),
+    )

@@ -51,8 +51,12 @@ def main():
     comm = transport.get_world_comm()
     state = training.run(step_fn, np.zeros(8), steps=STEPS, save_every=2)
     digest = hashlib.sha256(np.asarray(state).tobytes()).hexdigest()
-    print(f"elastic_train digest r{comm.rank()} {digest}", flush=True)
-    print("elastic_train OK", flush=True)
+    # one write() per line so the ranks' reports can't interleave in
+    # the launcher's multiplexed stdout (print's text + newline are two
+    # writes, and a splice between them corrupts the digest token)
+    sys.stdout.write(f"elastic_train digest r{comm.rank()} {digest}\n")
+    sys.stdout.write("elastic_train OK\n")
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":

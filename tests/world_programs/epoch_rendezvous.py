@@ -1,0 +1,79 @@
+"""Live re-tuning epoch rendezvous — the agreement pattern, proved clean.
+
+Models ``mpi4jax_tpu.live._swap.SwapProtocol`` at the jax op level so the
+match simulator can verify the protocol shape: every rank, at every P-th
+collective boundary, joins a fixed-size header bcast from rank 0; the
+*received* header — not any local state — decides whether a second
+(payload) bcast follows.  Because the branch condition is itself the
+product of a collective, every rank takes the same branch at the same
+boundary: the rendezvous can never split the world.  The analyzer must
+find nothing (kinds []).
+
+The divergent variant (epoch_rendezvous_divergent.py) breaks exactly this
+invariant — one rank consults local state instead of the header — and must
+be flagged.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+PERIOD = 4      # rendezvous every 4th collective boundary
+STEPS = 16
+PROPOSE_AT = 8  # rank 0 has a pending table at this boundary
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size >= 2, "run under the launcher with -n >= 2"
+
+    epoch = 0
+    installed = None
+    x = jnp.arange(8, dtype=jnp.int32) + 1
+    for step in range(1, STEPS + 1):
+        out = m4j.allreduce(x + step, op=m4j.SUM, comm=comm)
+        np.testing.assert_array_equal(
+            np.asarray(out), (np.arange(8) + 1 + step) * size)
+        if step % PERIOD:
+            continue
+
+        # --- header bcast: (proposed_epoch, payload_len), root 0 ---
+        if rank == 0 and step == PROPOSE_AT and epoch == 0:
+            payload = np.frombuffer(
+                json.dumps({"allreduce": [[0, "rd"]]}).encode(),
+                dtype=np.uint8)
+            hdr = jnp.asarray([epoch + 1, payload.size], dtype=jnp.int32)
+        else:
+            payload = None
+            hdr = jnp.asarray([epoch, 0], dtype=jnp.int32)
+        hdr = m4j.bcast(hdr, root=0, comm=comm)
+        new_epoch, nbytes = int(hdr[0]), int(hdr[1])
+        if new_epoch <= epoch or nbytes <= 0:
+            continue
+
+        # --- payload bcast: every rank decided from the SAME header ---
+        buf = (jnp.asarray(payload) if rank == 0
+               else jnp.zeros((nbytes,), dtype=jnp.uint8))
+        buf = m4j.bcast(buf, root=0, comm=comm)
+        installed = json.loads(np.asarray(buf).tobytes().decode())
+        epoch = new_epoch
+
+    assert epoch == 1, epoch
+    assert installed == {"allreduce": [[0, "rd"]]}, installed
+    print(f"epoch_rendezvous rank {rank} epoch {epoch}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Broken epoch rendezvous — payload branch decided from LOCAL state.
+
+The one rule of the live swap protocol (epoch_rendezvous.py, and the real
+implementation in ``mpi4jax_tpu.live._swap``) is that the payload-bcast
+branch is decided by the *received* header, so every rank takes it
+together.  This variant has non-root ranks consult a local "I have seen
+no proposal" flag instead: rank 0 proceeds into the payload bcast while
+everyone else moves on to the next allreduce.  The analyzer must flag the
+split (collective_mismatch) — the native transport would abort here, and
+a build without fail-fast would deadlock or silently corrupt the table.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+import mpi4jax_tpu as m4j
+
+PERIOD = 4
+STEPS = 16
+PROPOSE_AT = 8
+
+
+def main():
+    comm = m4j.get_default_comm()
+    rank, size = comm.rank(), comm.size()
+    assert size >= 2, "run under the launcher with -n >= 2"
+
+    epoch = 0
+    local_saw_proposal = False  # the bug: never updated off the wire
+    x = jnp.arange(8, dtype=jnp.int32) + 1
+    for step in range(1, STEPS + 1):
+        m4j.allreduce(x + step, op=m4j.SUM, comm=comm)
+        if step % PERIOD:
+            continue
+
+        if rank == 0 and step == PROPOSE_AT and epoch == 0:
+            payload = np.frombuffer(
+                json.dumps({"allreduce": [[0, "rd"]]}).encode(),
+                dtype=np.uint8)
+            hdr = jnp.asarray([epoch + 1, payload.size], dtype=jnp.int32)
+        else:
+            payload = None
+            hdr = jnp.asarray([epoch, 0], dtype=jnp.int32)
+        hdr = m4j.bcast(hdr, root=0, comm=comm)
+        new_epoch, nbytes = int(hdr[0]), int(hdr[1])
+
+        # BUG: non-root ranks ignore the header they just received and
+        # gate the payload bcast on local state -> rank 0 enters the
+        # payload collective alone.
+        take = (new_epoch > epoch and nbytes > 0) if rank == 0 \
+            else local_saw_proposal
+        if not take:
+            continue
+        buf = (jnp.asarray(payload) if rank == 0
+               else jnp.zeros((nbytes,), dtype=jnp.uint8))
+        m4j.bcast(buf, root=0, comm=comm)
+        epoch = new_epoch
+
+    print("UNREACHABLE" if rank == 0 else "UNREACHABLE-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,15 @@ Env:
     HEAL_OPS_SLEEP_S  idle window between the phases (default 0) —
                       the heartbeat test parks the wire here so the
                       progress thread, not an op, finds the dead link
+    HEAL_OPS_LIVE_SWAP  when "1" (and the live plane is armed via
+                      MPI4JAX_TPU_LIVE=auto), rank 0 proposes a table
+                      swap early in phase 2 so the epoch rendezvous
+                      lands WHILE the link layer is healing the
+                      injected fault — the chaos matrix's swap-during-
+                      reconnect cell.  np=2 float64 SUM is a single
+                      addition under every algorithm, so the digest
+                      contract is unchanged: a swap that altered
+                      results would be a dispatch bug
 """
 
 import os
@@ -72,21 +81,34 @@ def main():
 
         time.sleep(sleep_s)
 
+    live_swap = os.environ.get("HEAL_OPS_LIVE_SWAP", "0") == "1"
+
     # phase 2: collectives over the healed wire (the one-shot fault
     # has fired by now; these must run exactly as on a fresh link)
     for it in range(rounds):
         out = bridge.allreduce(h, x + it, 0)  # 0 = SUM (tpucomm.h wire code)
         np.testing.assert_allclose(out, (np.arange(n) * 2) + 1 + 2 * it)
         digest += float(out.sum())
+        if live_swap and it == 2 and rank == 0:
+            from mpi4jax_tpu import live
+
+            if live.armed():
+                live.propose({"allreduce": [(0, "rd")]}, note="chaos-swap")
+
+    epoch = 0
+    if live_swap:
+        from mpi4jax_tpu import live
+
+        epoch = live.status().get("epoch", 0)
 
     sh = obs.stats().get("self_healing", {})
     # one write() so the two ranks' report lines can't interleave in
     # the launcher's multiplexed stdout
     sys.stdout.write(
         "heal_ops %d digest %r reconnects %d dup_dropped %d "
-        "crc_errors %d replayed %d\n"
+        "crc_errors %d replayed %d epoch %d\n"
         % (rank, digest, sh.get("reconnects", 0), sh.get("dup_dropped", 0),
-           sh.get("crc_errors", 0), sh.get("replayed", 0)))
+           sh.get("crc_errors", 0), sh.get("replayed", 0), epoch))
     sys.stdout.flush()
 
 

@@ -55,7 +55,7 @@ _port = [49500 + (os.getpid() * 11) % 300]
 
 _LINE_RE = re.compile(
     r"heal_ops (\d+) digest (\S+) reconnects (\d+) dup_dropped (\d+) "
-    r"crc_errors (\d+) replayed (\d+)")
+    r"crc_errors (\d+) replayed (\d+) epoch (\d+)")
 
 
 def run_cell(env_extra, timeout):
@@ -81,7 +81,7 @@ def heal_lines(stdout):
     out = {}
     for m in _LINE_RE.finditer(stdout):
         out[int(m.group(1))] = (m.group(2),) + tuple(
-            int(m.group(i)) for i in range(3, 7))
+            int(m.group(i)) for i in range(3, 8))
     return out
 
 
@@ -196,6 +196,45 @@ def main():
                     if not ok:
                         failures += 1
                         sys.stdout.write(stderr[-1500:] + "\n")
+    # swap-during-reconnect: the live plane's epoch rendezvous must land
+    # while the link layer is healing an injected reset — the table swap
+    # may neither corrupt results (digests stay baseline: np=2 float64
+    # SUM is one addition under every algorithm) nor wedge the heal.
+    # Cell 1 fires the reset mid-phase-2 so the replay and the
+    # rendezvous genuinely overlap on the TCP data path; cell 2 is the
+    # shm/heartbeat variant (the reset lands on the idle TCP link and
+    # only the progress thread's heartbeats find it, right before the
+    # swap commits).
+    if "reset" in faults:
+        live_cells = [
+            ("off", "off", "rank=0,point=send,after=13,action=reset"),
+            ("on", "on", "rank=0,point=send,after=5,action=reset"),
+        ]
+        for shm, engine, fault_spec in live_cells:
+            env = cell_env("reset", "0", shm, engine)
+            env["MPI4JAX_TPU_FAULT"] = fault_spec
+            env.update({
+                "MPI4JAX_TPU_LIVE": "auto",
+                "MPI4JAX_TPU_LIVE_COOLDOWN_OPS": "8",
+                "HEAL_OPS_LIVE_SWAP": "1",
+            })
+            rc, stdout, stderr = run_cell(env, args.cell_timeout)
+            verdict, ok, note = classify(
+                "reset", shm, rc, stdout, stderr, baseline)
+            lines = heal_lines(stdout)
+            epochs = sorted({v[5] for v in lines.values()})
+            if ok and rc == 0 and epochs != [1]:
+                ok, note = False, f"swap epoch(s) {epochs} != [1]"
+            elif ok and rc == 0:
+                note += f" epoch={epochs[0]}"
+            tag = "ok  " if ok else "FAIL"
+            print(f"chaos: [{tag}] fault=reset+swap uring=0 "
+                  f"shm={shm:<3} engine={engine:<3}"
+                  f" -> {verdict:<10} {note}")
+            if not ok:
+                failures += 1
+                sys.stdout.write(stderr[-1500:] + "\n")
+
     if failures:
         print(f"chaos: {failures} cell(s) violated the heal-or-escalate "
               "contract")
